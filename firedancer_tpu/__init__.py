@@ -1,0 +1,23 @@
+"""firedancer_tpu — a TPU-native re-expression of Firedancer's validator dataflow.
+
+Firedancer (the reference, /root/reference) is a from-scratch Solana validator
+built as a fixed topology of core-pinned processes ("tiles") connected by
+lock-free shared-memory fragment streams ("tango"), with SIMD crypto kernels
+on the hot path (reference: src/disco/README.md:1-130).
+
+This package rebuilds those capabilities TPU-first:
+
+* ``ops``      — JAX/Pallas batch kernels: ed25519 verify, sha256/512, blake3,
+                 poh, merkle, reed-solomon (reference: src/ballet/).
+* ``parallel`` — device-mesh sharding of the batch kernels over ICI/DCN via
+                 ``jax.sharding`` + ``shard_map`` (replaces the reference's
+                 horizontal tile sharding, src/disco/verify/fd_verify_tile.c:49-53).
+* ``runtime``  — Python bindings to the native (C++) tango rings, stem run
+                 loop and topology runtime (reference: src/tango/, src/disco/).
+* ``tiles``    — tile implementations: verify (TPU microbatch bridge), dedup,
+                 pack, poh, shred... (reference: src/disco/*_tile.c).
+* ``utils``    — config pod, rng, histogram, logging equivalents
+                 (reference: src/util/).
+"""
+
+__version__ = "0.1.0"

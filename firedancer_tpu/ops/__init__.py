@@ -1,0 +1,1 @@
+"""Batch crypto/protocol kernels (the reference's src/ballet/, TPU-first)."""

@@ -1,0 +1,239 @@
+"""GF(2^255-19) field arithmetic as batched int32 limb vectors (JAX).
+
+TPU-native analog of the reference's field backends
+(ref: src/ballet/ed25519/fd_f25519.h — fiat 64-bit limbs; and
+src/ballet/ed25519/avx512/fd_r43x6.h:10-32 — radix-2^43×6 AVX-512-IFMA).
+
+The TPU VPU has fast int32 multiply but no widening 64-bit multiply, so we
+pick radix 2^13 with 20 limbs: a schoolbook product coefficient is a sum of
+at most 20 terms, each < 2^26.4, so every partial sum stays below 2^31 and
+the whole multiply runs in plain int32 — no carries mid-accumulation, no
+64-bit emulation. (Same "pick the radix so the accumulator never overflows
+the lane type" move as r43x6 on IFMA's 52-bit lanes.)
+
+Field elements are arrays of shape (..., 20) int32, limbs little-endian with
+weight 2^(13*i). The invariant maintained by `carry` ("loose-normalized"):
+limbs 1..19 in [0, 2^13), limb 0 in [0, 2^13 + 2^10), value < 2^255 + 2^10.
+All functions broadcast over leading batch dimensions; everything is
+jit/vmap/shard_map friendly (static shapes, no data-dependent control flow).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+NLIMB = 20
+BITS = 13
+MASK = (1 << BITS) - 1
+P = (1 << 255) - 19
+# 2^(13*20) = 2^260 = 2^5 * 2^255 ≡ 32 * 19 = 608 (mod p)
+FOLD = 19 << (NLIMB * BITS - 255)  # 608
+
+d = -121665 * pow(121666, P - 2, P) % P  # Edwards curve constant
+SQRT_M1 = pow(2, (P - 1) // 4, P)        # sqrt(-1)
+
+
+def _int_to_limbs(x: int) -> np.ndarray:
+    return np.array([(x >> (BITS * i)) & MASK for i in range(NLIMB)], np.int32)
+
+
+def limbs_to_int(x) -> int:
+    """Host-side helper (tests/debug): limb vector -> python int."""
+    x = np.asarray(x)
+    return sum(int(x[i]) << (BITS * i) for i in range(NLIMB))
+
+
+P_LIMBS = _int_to_limbs(P)
+# 2p = 2^256 - 38 fits in 20 limbs; added before subtraction so the result
+# value stays positive (minuend is loose-normalized: value < 2^255 + 2^10).
+P2_LIMBS = np.array([((2 * P) >> (BITS * i)) & MASK for i in range(NLIMB)],
+                    np.int32)
+assert sum(int(v) << (BITS * i) for i, v in enumerate(P2_LIMBS)) == 2 * P
+
+D_LIMBS = _int_to_limbs(d)
+D2_LIMBS = _int_to_limbs(2 * d % P)
+SQRT_M1_LIMBS = _int_to_limbs(SQRT_M1)
+
+
+def fe(x: int) -> jnp.ndarray:
+    """Constant field element from python int."""
+    return jnp.asarray(_int_to_limbs(x % P))
+
+
+def _digit_pass(x, fold_carry: bool):
+    """One exact sequential base-2^13 digit pass (signed limbs ok).
+
+    Returns digits in [0, 2^13) when the represented value is in
+    [0, 2^260); the carry out of the top limb is folded back into limb 0
+    with weight 608 when `fold_carry` (2^260 ≡ 608 mod p).
+    """
+    outs = []
+    c = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMB):
+        v = x[..., i] + c
+        outs.append(v & MASK)
+        c = v >> BITS  # arithmetic shift: floor division, exact for signed
+    x = jnp.stack(outs, axis=-1)
+    if fold_carry:
+        x = x.at[..., 0].add(c * FOLD)
+    return x
+
+
+def carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce any int32 limb vector (|value| < 2^261) to loose-normalized.
+
+    Two fold passes bring the value into [0, 2^260); the final high-bit fold
+    (bits >= 255, using 2^255 ≡ 19) brings it under 2^255 + 2^10 so a
+    subsequent `sub` can add 2p and stay positive.
+    """
+    x = _digit_pass(x, fold_carry=True)
+    x = _digit_pass(x, fold_carry=True)
+    h = x[..., NLIMB - 1] >> (255 - BITS * (NLIMB - 1))  # bits >= 255
+    x = x.at[..., NLIMB - 1].set(x[..., NLIMB - 1] & ((1 << (255 - BITS * (NLIMB - 1))) - 1))
+    x = x.at[..., 0].add(h * 19)
+    return x
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+def sub(a, b):
+    return carry(a + jnp.asarray(P2_LIMBS) - b)
+
+
+def neg(a):
+    return carry(jnp.asarray(P2_LIMBS) - a)
+
+
+def _mul_core(a, b):
+    """Schoolbook polynomial product + fold, inputs loose-normalized."""
+    # prod[..., i, k] = a_i * b_k ; each < 2^26.5.
+    prod = a[..., :, None] * b[..., None, :]
+    # Anti-diagonal sums: c_j = sum_i prod[i, j-i]; each < 20 * 2^26.5 < 2^31.
+    ncoef = 2 * NLIMB - 1
+    shape = jnp.broadcast_shapes(a.shape, b.shape)[:-1] + (ncoef,)
+    c = jnp.zeros(shape, jnp.int32)
+    for i in range(NLIMB):
+        c = c.at[..., i:i + NLIMB].add(prod[..., i, :])
+    # Exact digit pass over all 39 coefficients so the 608-fold can't overflow.
+    outs = []
+    cr = jnp.zeros_like(c[..., 0])
+    for j in range(ncoef):
+        v = c[..., j] + cr
+        outs.append(v & MASK)
+        cr = v >> BITS
+    outs.append(cr)  # coefficient 39, < 2^13
+    # Fold coefficients j >= 20 into j-20 with weight 608.
+    res = list(outs[:NLIMB])
+    for j in range(NLIMB, ncoef + 1):
+        res[j - NLIMB] = res[j - NLIMB] + outs[j] * FOLD
+    return carry(jnp.stack(res, axis=-1))
+
+
+def mul(a, b):
+    return _mul_core(a, b)
+
+
+def sq(a):
+    return _mul_core(a, a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small (< 2^17) non-negative python-int constant."""
+    assert 0 <= k < (1 << 17)
+    return carry(a * jnp.int32(k))
+
+
+def pow_const(x, e: int):
+    """x^e for a python-int exponent.
+
+    Square-and-multiply driven by a constant bit table through `lax.scan`
+    so the trace stays small (one squaring + one selected multiply per
+    step) — unrolling ~255 multiplies would explode XLA compile time.
+    """
+    assert e >= 1
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+                       jnp.int32)
+
+    one = jnp.zeros_like(x).at[..., 0].set(1)
+
+    def step(acc, bit):
+        acc = sq(acc)
+        return jnp.where(bit == 1, mul(acc, x), acc), None
+
+    acc, _ = jax.lax.scan(step, one, bits)
+    return acc
+
+
+def invert(x):
+    return pow_const(x, P - 2)
+
+
+def canonical(x):
+    """Fully reduce mod p: exact digits with value in [0, p)."""
+    x = carry(x)                      # value < 2^255 + 2^10 < 2p
+    x = _digit_pass(x, fold_carry=False)
+    p = jnp.asarray(P_LIMBS)
+    for _ in range(2):
+        # lexicographic x >= p on exact digits
+        gt = jnp.zeros(x.shape[:-1], bool)
+        eq = jnp.ones(x.shape[:-1], bool)
+        for i in range(NLIMB - 1, -1, -1):
+            gt = gt | (eq & (x[..., i] > p[i]))
+            eq = eq & (x[..., i] == p[i])
+        need = gt | eq
+        x = _digit_pass(x - jnp.where(need[..., None], p, 0), fold_carry=False)
+    return x
+
+
+def is_zero(x):
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(a, b):
+    return is_zero(sub(a, b))
+
+
+# -- byte / bit conversion -------------------------------------------------
+
+# 255-bit little-endian bit -> limb packing matrix: limbs = bits @ _B2L.
+_B2L = np.zeros((255, NLIMB), np.int32)
+for _b in range(255):
+    _B2L[_b, _b // BITS] = 1 << (_b % BITS)
+
+_L2BIT_IDX = np.array([_b // BITS for _b in range(256)])
+_L2BIT_IDX[255] = NLIMB - 1
+_L2BIT_SHIFT = np.array([_b % BITS for _b in range(256)], np.int32)
+_L2BIT_SHIFT[255] = 12  # canonical limb 19 has bits >= 8 clear -> reads 0
+
+
+def bytes_to_bits(b):
+    """(..., n) uint8 -> (..., 8n) little-endian bits (int32 0/1)."""
+    b = b.astype(jnp.int32)
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    bits = (b[..., :, None] >> shifts) & 1
+    return bits.reshape(*b.shape[:-1], b.shape[-1] * 8)
+
+
+def bits_to_bytes(bits):
+    """(..., 8n) little-endian bits -> (..., n) uint8."""
+    n = bits.shape[-1] // 8
+    bits = bits.reshape(*bits.shape[:-1], n, 8).astype(jnp.int32)
+    w = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.int32)
+    return (bits @ w).astype(jnp.uint8)
+
+
+def frombytes(b):
+    """(..., 32) uint8 little-endian -> field element. Bit 255 is ignored."""
+    bits = bytes_to_bits(b)[..., :255]
+    return bits @ jnp.asarray(_B2L)
+
+
+def tobytes(x):
+    """Field element -> canonical (..., 32) uint8 little-endian."""
+    x = canonical(x)
+    bits = (x[..., jnp.asarray(_L2BIT_IDX)] >> jnp.asarray(_L2BIT_SHIFT)) & 1
+    return bits_to_bytes(bits)

@@ -1,0 +1,14 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Real TPU hardware in CI has a single chip; multi-chip sharding paths are
+validated on a virtual 8-device CPU platform, mirroring how the reference
+tests tiles without a cluster (reference: doc/testing.md, fd_tile_unit_test).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

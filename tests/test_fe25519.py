@@ -1,0 +1,99 @@
+"""fe25519 vs python bigint oracle (ref test model: src/ballet/ed25519/test_ed25519.c)."""
+import secrets
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from firedancer_tpu.ops import fe25519 as fe
+
+P = fe.P
+
+
+def rand_ints(n, bound=P):
+    return [secrets.randbelow(bound) for _ in range(n)]
+
+
+def to_limbs(xs):
+    return jnp.asarray(np.stack([fe._int_to_limbs(x) for x in xs]))
+
+
+def from_limbs(arr):
+    arr = np.asarray(arr)
+    return [fe.limbs_to_int(arr[i]) for i in range(arr.shape[0])]
+
+
+def check_loose(arr):
+    arr = np.asarray(arr)
+    assert arr[..., 1:].min() >= 0 and arr[..., 1:].max() < 2 ** 13
+    assert arr[..., 0].min() >= 0 and arr[..., 0].max() < 2 ** 13 + 2 ** 10
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (fe.add, lambda a, b: (a + b) % P),
+    (fe.sub, lambda a, b: (a - b) % P),
+    (fe.mul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, pyop):
+    n = 64
+    a_int = rand_ints(n) + [0, P - 1, P, 2 ** 255 - 1, 1, 0, P - 1, 2 ** 255 - 1]
+    b_int = rand_ints(n) + [0, P - 1, P, 2 ** 255 - 1, 0, 2 ** 255 - 1, 1, 1]
+    a, b = to_limbs(a_int), to_limbs(b_int)
+    out = jax.jit(op)(a, b)
+    check_loose(out)
+    got = from_limbs(out)
+    for g, x, y in zip(got, a_int, b_int):
+        assert g % P == pyop(x, y) % P
+
+
+def test_chained_sub_stays_in_bounds():
+    # worst case: repeated subtraction of large from small
+    a = to_limbs([1, 0, P - 1])
+    b = to_limbs([P - 1, 2 ** 255 - 1, 1])
+    x = a
+    expect = [1, 0, P - 1]
+    for _ in range(5):
+        x = fe.sub(x, b)
+        check_loose(x)
+        expect = [(e - y) % P for e, y in zip(expect, [P - 1, 2 ** 255 - 1, 1])]
+    assert [g % P for g in from_limbs(x)] == expect
+
+
+def test_sq_neg_invert():
+    xs = rand_ints(16) + [1, 2, P - 1]
+    a = to_limbs(xs)
+    assert [g % P for g in from_limbs(fe.sq(a))] == [x * x % P for x in xs]
+    assert [g % P for g in from_limbs(fe.neg(a))] == [(-x) % P for x in xs]
+    inv = fe.invert(a)
+    assert [g % P for g in from_limbs(inv)] == [pow(x, P - 2, P) for x in xs]
+
+
+def test_canonical_and_eq():
+    xs = [0, 1, P - 1, P, P + 1, 2 * P - 1, 2 ** 255 - 1]
+    a = to_limbs(xs)
+    can = fe.canonical(a)
+    assert from_limbs(can) == [x % P for x in xs]
+    assert list(np.asarray(fe.is_zero(to_limbs([0, P, 1, 2 * P])))) == [True, True, False, True]
+    assert bool(fe.eq(to_limbs([P + 3])[0], to_limbs([3])[0]))
+
+
+def test_bytes_roundtrip():
+    xs = rand_ints(8) + [0, 1, P - 1]
+    a = to_limbs(xs)
+    b = fe.tobytes(a)
+    assert b.shape == (len(xs), 32)
+    for i, x in enumerate(xs):
+        assert bytes(np.asarray(b[i]).tobytes()) == (x % P).to_bytes(32, "little")
+    rt = fe.frombytes(b)
+    assert from_limbs(rt) == [x % P for x in xs]
+    # bit 255 ignored on input
+    hi = np.asarray(b).copy()
+    hi[:, 31] |= 0x80
+    assert from_limbs(fe.frombytes(jnp.asarray(hi))) == [x % P for x in xs]
+
+
+def test_constants():
+    assert fe.limbs_to_int(fe.D_LIMBS) == fe.d
+    assert fe.limbs_to_int(fe.SQRT_M1_LIMBS) == fe.SQRT_M1
+    assert pow(fe.SQRT_M1, 2, P) == P - 1
