@@ -1,0 +1,92 @@
+"""Headline benchmark: batched ed25519 sigverify throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's wiredancer FPGA sigverify tile sustains ~1M
+verifies/s on one AWS-F1 card, vs ~30K/s per Skylake core for the C path
+(ref: src/wiredancer/README.md:99-119). BASELINE.json's north star for this
+rebuild is >= 1M ed25519 verifies/s on a single TPU chip, so
+vs_baseline = verifies_per_sec / 1e6.
+
+Methodology mirrors the reference's unit-test self-benchmarks
+(ref: src/ballet/ed25519/test_ed25519.c:26-31 — print throughput from a
+tight loop over pre-generated valid signatures): pre-generate distinct
+signed messages host-side, tile to the microbatch size, jit-compile once,
+then time steady-state iterations end-to-end (device dispatch + compute +
+verdict readback).
+"""
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _gen_vectors(n_unique: int, max_len: int, rng: np.random.Generator):
+    from tests.test_ed25519 import keypair, sign  # pure-python RFC 8032
+
+    sig = np.zeros((n_unique, 64), np.uint8)
+    pub = np.zeros((n_unique, 32), np.uint8)
+    msg = np.zeros((n_unique, max_len), np.uint8)
+    ln = np.zeros((n_unique,), np.int32)
+    for i in range(n_unique):
+        seed = hashlib.sha256(b"bench-key-%d" % (i % 8)).digest()
+        m = rng.integers(0, 256, size=(int(rng.integers(32, max_len)),),
+                         dtype=np.uint8).tobytes()
+        _, _, pk = keypair(seed)
+        s = sign(seed, m)
+        sig[i] = np.frombuffer(s, np.uint8)
+        pub[i] = np.frombuffer(pk, np.uint8)
+        msg[i, :len(m)] = np.frombuffer(m, np.uint8)
+        ln[i] = len(m)
+    return sig, pub, msg, ln
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from firedancer_tpu.ops import ed25519 as ed
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch = int(os.environ.get("FDTPU_BENCH_BATCH", "8192" if on_tpu else "64"))
+    max_len = 128          # typical txn message region fits; MTU path is 1232
+    n_unique = min(batch, 256)
+
+    rng = np.random.default_rng(42)
+    sig, pub, msg, ln = _gen_vectors(n_unique, max_len, rng)
+    reps = -(-batch // n_unique)
+    sig = np.tile(sig, (reps, 1))[:batch]
+    pub = np.tile(pub, (reps, 1))[:batch]
+    msg = np.tile(msg, (reps, 1))[:batch]
+    ln = np.tile(ln, reps)[:batch]
+
+    fn = jax.jit(ed.verify_batch)
+    args = (jnp.asarray(sig), jnp.asarray(pub), jnp.asarray(msg),
+            jnp.asarray(ln))
+    out = fn(*args)
+    out.block_until_ready()
+    assert bool(np.asarray(out).all()), "bench vectors failed to verify"
+
+    iters = int(os.environ.get("FDTPU_BENCH_ITERS", "8" if on_tpu else "2"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    vps = batch * iters / dt
+    print(json.dumps({
+        "metric": "ed25519_verifies_per_sec",
+        "value": round(vps, 1),
+        "unit": "verifies/s/chip",
+        "vs_baseline": round(vps / 1.0e6, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,379 @@
+/* fdtpu native runtime — see fdtpu.h for the design contract. */
+#include "fdtpu.h"
+
+#include <atomic>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kAlign = 64;  /* cacheline */
+
+inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+inline uint8_t *at(void *base, uint64_t off) {
+  return static_cast<uint8_t *>(base) + off;
+}
+
+/* Ring header: one cacheline of producer state, then depth slots. */
+struct RingHdr {
+  uint64_t magic;
+  uint64_t depth;          /* power of two */
+  std::atomic<uint64_t> seq;  /* next seq to publish (producer-owned) */
+  uint64_t pad[5];
+};
+static_assert(sizeof(RingHdr) == 64, "ring header is one cacheline");
+
+constexpr uint64_t kRingMagic = 0xfd79a9f07a960001ULL;
+
+struct Slot {
+  std::atomic<uint64_t> seq;
+  uint64_t sig;
+  uint32_t off;
+  uint32_t sz;
+  uint16_t ctl;
+  uint16_t orig;
+  uint32_t tspub;
+};
+static_assert(sizeof(Slot) == 32, "slot is 32 bytes");
+
+inline RingHdr *ring_hdr(void *base, uint64_t off) {
+  return reinterpret_cast<RingHdr *>(at(base, off));
+}
+inline Slot *ring_slots(void *base, uint64_t off) {
+  return reinterpret_cast<Slot *>(at(base, off + sizeof(RingHdr)));
+}
+
+struct Fseq {
+  std::atomic<uint64_t> seq;
+  uint64_t pad[7];
+};
+
+struct Cnc {
+  std::atomic<uint32_t> state;
+  uint32_t pad0;
+  std::atomic<uint64_t> heartbeat;
+  uint64_t pad[6];
+};
+
+/* tcache: ring of most-recent tags + open-address presence map sized 2x
+ * depth (power of two). Same dedup contract as the reference's tcache
+ * (src/tango/fd_tcache.h:4-21) with a simpler eviction map. */
+struct TcacheHdr {
+  uint64_t depth;
+  uint64_t map_cnt;        /* power of two, >= 2*depth */
+  uint64_t next;           /* ring cursor */
+  uint64_t pad[5];
+  /* followed by: uint64_t ring[depth]; uint64_t map[map_cnt] */
+};
+
+inline uint64_t tmix(uint64_t x) {
+  /* 64-bit finalizer-style mixer for map indexing */
+  x ^= x >> 33; x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33; x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33; return x;
+}
+
+}  // namespace
+
+extern "C" {
+
+/* ---- workspace ------------------------------------------------------- */
+
+void *fdtpu_wksp_join(const char *name, uint64_t sz, int create) {
+  int flags = O_RDWR | (create ? O_CREAT : 0);
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  if (create && ftruncate(fd, (off_t)sz) != 0) { close(fd); return nullptr; }
+  void *p = mmap(nullptr, sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  return p == MAP_FAILED ? nullptr : p;
+}
+
+int fdtpu_wksp_leave(void *base, uint64_t sz) { return munmap(base, sz); }
+
+int fdtpu_wksp_unlink(const char *name) { return shm_unlink(name); }
+
+/* ---- ring ------------------------------------------------------------- */
+
+uint64_t fdtpu_ring_footprint(uint64_t depth) {
+  return align_up(sizeof(RingHdr) + depth * sizeof(Slot));
+}
+
+int fdtpu_ring_init(void *base, uint64_t off, uint64_t depth) {
+  if (!depth || (depth & (depth - 1))) return -1;
+  RingHdr *h = ring_hdr(base, off);
+  h->magic = kRingMagic;
+  h->depth = depth;
+  h->seq.store(0, std::memory_order_relaxed);
+  Slot *s = ring_slots(base, off);
+  for (uint64_t i = 0; i < depth; i++) {
+    /* sentinel: "this slot last held seq i - depth", never a valid seq */
+    s[i].seq.store(i - depth, std::memory_order_relaxed);
+  }
+  std::atomic_thread_fence(std::memory_order_release);
+  return 0;
+}
+
+uint64_t fdtpu_ring_depth(void *base, uint64_t off) {
+  return ring_hdr(base, off)->depth;
+}
+
+uint64_t fdtpu_ring_seq(void *base, uint64_t off) {
+  return ring_hdr(base, off)->seq.load(std::memory_order_acquire);
+}
+
+/* bit 63 marks a slot as write-in-progress; real seqs stay below 2^63 */
+constexpr uint64_t kWip = 1ULL << 63;
+
+uint64_t fdtpu_ring_prepare(void *base, uint64_t ring_off) {
+  RingHdr *h = ring_hdr(base, ring_off);
+  uint64_t seq = h->seq.load(std::memory_order_relaxed);
+  Slot *s = ring_slots(base, ring_off) + (seq & (h->depth - 1));
+  /* Invalidate BEFORE the payload chunk is overwritten: a speculative
+   * reader of the old frag re-checks the slot seq after its copy and now
+   * sees the wip marker instead of the old seq -> rejects torn data. */
+  s->seq.store(seq | kWip, std::memory_order_release);
+  return seq;
+}
+
+uint64_t fdtpu_ring_publish(void *base, uint64_t ring_off, uint64_t sig,
+                            uint64_t payload_off, uint32_t sz, uint16_t ctl,
+                            uint16_t orig) {
+  RingHdr *h = ring_hdr(base, ring_off);
+  uint64_t seq = h->seq.load(std::memory_order_relaxed);
+  Slot *s = ring_slots(base, ring_off) + (seq & (h->depth - 1));
+  s->sig = sig;
+  s->off = (uint32_t)(payload_off >> 6);  /* 64B chunk index */
+  s->sz = sz;
+  s->ctl = ctl;
+  s->orig = orig;
+  s->tspub = (uint32_t)fdtpu_ticks();
+  s->seq.store(seq, std::memory_order_release);
+  h->seq.store(seq + 1, std::memory_order_release);
+  return seq;
+}
+
+uint64_t fdtpu_ring_publish_buf(void *base, uint64_t ring_off, uint64_t sig,
+                                const uint8_t *data, uint32_t sz,
+                                uint64_t arena_off, uint64_t mtu,
+                                uint16_t ctl, uint16_t orig) {
+  RingHdr *h = ring_hdr(base, ring_off);
+  uint64_t seq = fdtpu_ring_prepare(base, ring_off);
+  uint64_t chunk = arena_off + (seq & (h->depth - 1)) * mtu;
+  std::memcpy(at(base, chunk), data, sz);
+  return fdtpu_ring_publish(base, ring_off, sig, chunk, sz, ctl, orig);
+}
+
+int fdtpu_ring_consume(void *base, uint64_t ring_off, uint64_t seq,
+                       fdtpu_frag_t *out) {
+  RingHdr *h = ring_hdr(base, ring_off);
+  Slot *s = ring_slots(base, ring_off) + (seq & (h->depth - 1));
+  uint64_t found = s->seq.load(std::memory_order_acquire);
+  if (found != seq) {
+    /* signed distance: slot behind us -> unpublished; ahead -> overrun */
+    return ((int64_t)(found - seq) < 0) ? 1 : -1;
+  }
+  out->sig = s->sig;
+  out->off = (uint64_t)s->off << 6;  /* chunk index -> byte offset */
+  out->sz = s->sz;
+  out->ctl = s->ctl;
+  out->orig = s->orig;
+  out->tspub = s->tspub;
+  std::atomic_thread_fence(std::memory_order_acquire);
+  uint64_t check = s->seq.load(std::memory_order_relaxed);
+  if (check != seq) return -1; /* torn: producer lapped mid-copy */
+  out->seq = seq;
+  return 0;
+}
+
+/* ---- fseq ------------------------------------------------------------- */
+
+uint64_t fdtpu_fseq_footprint(void) { return sizeof(Fseq); }
+
+int fdtpu_fseq_init(void *base, uint64_t off, uint64_t seq0) {
+  reinterpret_cast<Fseq *>(at(base, off))
+      ->seq.store(seq0, std::memory_order_release);
+  return 0;
+}
+
+uint64_t fdtpu_fseq_query(void *base, uint64_t off) {
+  return reinterpret_cast<Fseq *>(at(base, off))
+      ->seq.load(std::memory_order_acquire);
+}
+
+void fdtpu_fseq_update(void *base, uint64_t off, uint64_t seq) {
+  reinterpret_cast<Fseq *>(at(base, off))
+      ->seq.store(seq, std::memory_order_release);
+}
+
+/* ---- fctl ------------------------------------------------------------- */
+
+int64_t fdtpu_fctl_credits(void *base, uint64_t ring_off,
+                           const uint64_t *fseq_offs, int n_fseq) {
+  RingHdr *h = ring_hdr(base, ring_off);
+  uint64_t seq = h->seq.load(std::memory_order_relaxed);
+  int64_t credits = (int64_t)h->depth;
+  for (int i = 0; i < n_fseq; i++) {
+    uint64_t cseq = fdtpu_fseq_query(base, fseq_offs[i]);
+    int64_t c = (int64_t)h->depth - (int64_t)(seq - cseq);
+    if (c < credits) credits = c;
+  }
+  return credits < 0 ? 0 : credits;
+}
+
+/* ---- cnc -------------------------------------------------------------- */
+
+uint64_t fdtpu_cnc_footprint(void) { return sizeof(Cnc); }
+
+int fdtpu_cnc_init(void *base, uint64_t off) {
+  Cnc *c = reinterpret_cast<Cnc *>(at(base, off));
+  c->state.store(FDTPU_CNC_BOOT, std::memory_order_relaxed);
+  c->heartbeat.store(0, std::memory_order_release);
+  return 0;
+}
+
+uint32_t fdtpu_cnc_state(void *base, uint64_t off) {
+  return reinterpret_cast<Cnc *>(at(base, off))
+      ->state.load(std::memory_order_acquire);
+}
+
+void fdtpu_cnc_set_state(void *base, uint64_t off, uint32_t st) {
+  reinterpret_cast<Cnc *>(at(base, off))
+      ->state.store(st, std::memory_order_release);
+}
+
+void fdtpu_cnc_heartbeat(void *base, uint64_t off, uint64_t now) {
+  reinterpret_cast<Cnc *>(at(base, off))
+      ->heartbeat.store(now, std::memory_order_release);
+}
+
+uint64_t fdtpu_cnc_last_heartbeat(void *base, uint64_t off) {
+  return reinterpret_cast<Cnc *>(at(base, off))
+      ->heartbeat.load(std::memory_order_acquire);
+}
+
+/* ---- tcache ----------------------------------------------------------- */
+
+uint64_t fdtpu_tcache_footprint(uint64_t depth) {
+  uint64_t map_cnt = 1;
+  while (map_cnt < 4 * depth) map_cnt <<= 1;
+  return align_up(sizeof(TcacheHdr) + (depth + map_cnt) * sizeof(uint64_t));
+}
+
+int fdtpu_tcache_init(void *base, uint64_t off, uint64_t depth) {
+  if (!depth) return -1;
+  TcacheHdr *h = reinterpret_cast<TcacheHdr *>(at(base, off));
+  uint64_t map_cnt = 1;
+  while (map_cnt < 4 * depth) map_cnt <<= 1;
+  h->depth = depth;
+  h->map_cnt = map_cnt;
+  h->next = 0;
+  uint64_t *ring = reinterpret_cast<uint64_t *>(h + 1);
+  uint64_t *map = ring + depth;
+  std::memset(ring, 0, depth * sizeof(uint64_t));
+  std::memset(map, 0, map_cnt * sizeof(uint64_t));
+  return 0;
+}
+
+int fdtpu_tcache_insert(void *base, uint64_t off, uint64_t tag) {
+  /* tag 0 is reserved as the map's empty marker; remap (rare, and fine
+   * for dedup purposes: 0 and 1 alias) */
+  if (!tag) tag = 1;
+  TcacheHdr *h = reinterpret_cast<TcacheHdr *>(at(base, off));
+  uint64_t *ring = reinterpret_cast<uint64_t *>(h + 1);
+  uint64_t *map = ring + h->depth;
+  uint64_t mask = h->map_cnt - 1;
+
+  uint64_t idx = tmix(tag) & mask;
+  while (map[idx]) {
+    if (map[idx] == tag) return 1; /* duplicate */
+    idx = (idx + 1) & mask;
+  }
+  /* insert; evict oldest if ring full */
+  uint64_t victim = ring[h->next % h->depth];
+  ring[h->next % h->depth] = tag;
+  h->next++;
+  map[idx] = tag;
+  if (victim && h->next > h->depth) {
+    /* delete victim from map with backward-shift deletion */
+    uint64_t vi = tmix(victim) & mask;
+    while (map[vi] != victim) {
+      if (!map[vi]) return 0; /* already gone (aliased remap) */
+      vi = (vi + 1) & mask;
+    }
+    map[vi] = 0;
+    uint64_t hole = vi, scan = (vi + 1) & mask;
+    while (map[scan]) {
+      uint64_t home = tmix(map[scan]) & mask;
+      /* can map[scan] legally move into the hole? */
+      bool movable = ((scan - home) & mask) >= ((scan - hole) & mask);
+      if (movable) {
+        map[hole] = map[scan];
+        map[scan] = 0;
+        hole = scan;
+      }
+      scan = (scan + 1) & mask;
+    }
+  }
+  return 0;
+}
+
+/* ---- batch gather ------------------------------------------------------ */
+
+int64_t fdtpu_ring_gather(void *base, uint64_t ring_off, uint64_t *seq_io,
+                          int64_t max_n, uint8_t *out_buf,
+                          uint64_t out_stride, uint32_t *out_sz,
+                          uint64_t *out_sig, uint64_t *overrun_cnt) {
+  int64_t n = 0;
+  uint64_t seq = *seq_io;
+  fdtpu_frag_t frag;
+  while (n < max_n) {
+    int rc = fdtpu_ring_consume(base, ring_off, seq, &frag);
+    if (rc == 1) break; /* caught up */
+    if (rc == -1) {
+      /* lapped: resync to oldest plausibly-live seq */
+      uint64_t prod = fdtpu_ring_seq(base, ring_off);
+      uint64_t depth = fdtpu_ring_depth(base, ring_off);
+      uint64_t resync = prod > depth ? prod - depth : 0;
+      if (overrun_cnt) *overrun_cnt += resync - seq;
+      seq = resync;
+      continue;
+    }
+    uint8_t *dst = out_buf + (uint64_t)n * out_stride;
+    uint32_t sz = frag.sz <= out_stride ? frag.sz : (uint32_t)out_stride;
+    std::memcpy(dst, at(base, frag.off), sz);
+    /* re-validate after payload copy: payload bytes are only stable while
+     * the slot seq is unchanged (speculative read contract) */
+    fdtpu_frag_t check;
+    if (fdtpu_ring_consume(base, ring_off, seq, &check) != 0) {
+      uint64_t prod = fdtpu_ring_seq(base, ring_off);
+      uint64_t depth = fdtpu_ring_depth(base, ring_off);
+      uint64_t resync = prod > depth ? prod - depth : 0;
+      if (resync <= seq) resync = seq + 1;  /* always make progress */
+      if (overrun_cnt) *overrun_cnt += resync - seq;
+      seq = resync;
+      continue;
+    }
+    if (sz < out_stride) std::memset(dst + sz, 0, out_stride - sz);
+    if (out_sz) out_sz[n] = sz;
+    if (out_sig) out_sig[n] = frag.sig;
+    n++;
+    seq++;
+  }
+  *seq_io = seq;
+  return n;
+}
+
+uint64_t fdtpu_ticks(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+}  /* extern "C" */

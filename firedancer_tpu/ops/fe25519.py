@@ -178,15 +178,24 @@ def canonical(x):
     x = _digit_pass(x, fold_carry=False)
     p = jnp.asarray(P_LIMBS)
     for _ in range(2):
-        # lexicographic x >= p on exact digits
-        gt = jnp.zeros(x.shape[:-1], bool)
-        eq = jnp.ones(x.shape[:-1], bool)
-        for i in range(NLIMB - 1, -1, -1):
-            gt = gt | (eq & (x[..., i] > p[i]))
-            eq = eq & (x[..., i] == p[i])
-        need = gt | eq
+        need = ~digits_lt(x, P_LIMBS)   # x >= p
         x = _digit_pass(x - jnp.where(need[..., None], p, 0), fold_carry=False)
     return x
+
+
+def digits_lt(d, const_digits):
+    """Lexicographic (d < const) on exact digit vectors; broadcasts over
+    leading dims. Returns bool (...,). Shared by field/scalar canonicality
+    checks (value-vs-p and value-vs-l comparisons)."""
+    c = jnp.asarray(const_digits)
+    n = d.shape[-1]
+    lt = jnp.zeros(d.shape[:-1], bool)
+    eq = jnp.ones(d.shape[:-1], bool)
+    for i in range(n - 1, -1, -1):
+        ci = c[i] if i < c.shape[0] else jnp.int32(0)
+        lt = lt | (eq & (d[..., i] < ci))
+        eq = eq & (d[..., i] == ci)
+    return lt
 
 
 def is_zero(x):

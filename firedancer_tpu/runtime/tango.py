@@ -1,0 +1,241 @@
+"""ctypes bindings for the native fdtpu runtime (see native/fdtpu.h).
+
+Layout convention: a Workspace is a named shm segment; objects (rings,
+fseqs, cncs, tcaches, payload arenas) are carved out of it at 64-byte
+aligned offsets by the topology builder. Offsets — not pointers — are the
+inter-process currency, mirroring the reference's gaddr/chunk discipline
+(ref: src/util/wksp/fd_wksp.h:27-47, src/tango/fd_tango_base.h:105-112).
+"""
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libfdtpu.so"))
+
+CNC_BOOT, CNC_RUN, CNC_HALT, CNC_FAIL = 0, 1, 2, 3
+
+
+def _build():
+    # always invoke make: its dependency check is a no-op when fresh, and
+    # this prevents a stale .so from shadowing edited C++ source
+    subprocess.run(["make", "-s", "-C", os.path.abspath(_NATIVE_DIR)],
+                   check=True)
+
+
+def _load():
+    _build()
+    lib = ct.CDLL(_LIB_PATH)
+    u64, i64, u32, u16, vp, cp = (ct.c_uint64, ct.c_int64, ct.c_uint32,
+                                  ct.c_uint16, ct.c_void_p, ct.c_char_p)
+    sigs = {
+        "fdtpu_wksp_join": (vp, [cp, u64, ct.c_int]),
+        "fdtpu_wksp_leave": (ct.c_int, [vp, u64]),
+        "fdtpu_wksp_unlink": (ct.c_int, [cp]),
+        "fdtpu_ring_footprint": (u64, [u64]),
+        "fdtpu_ring_init": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_ring_depth": (u64, [vp, u64]),
+        "fdtpu_ring_seq": (u64, [vp, u64]),
+        "fdtpu_ring_prepare": (u64, [vp, u64]),
+        "fdtpu_ring_publish": (u64, [vp, u64, u64, u64, u32, u16, u16]),
+        "fdtpu_ring_consume": (ct.c_int, [vp, u64, u64, vp]),
+        "fdtpu_fseq_footprint": (u64, []),
+        "fdtpu_fseq_init": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_fseq_query": (u64, [vp, u64]),
+        "fdtpu_fseq_update": (None, [vp, u64, u64]),
+        "fdtpu_fctl_credits": (i64, [vp, u64, ct.POINTER(u64), ct.c_int]),
+        "fdtpu_cnc_footprint": (u64, []),
+        "fdtpu_cnc_init": (ct.c_int, [vp, u64]),
+        "fdtpu_cnc_state": (u32, [vp, u64]),
+        "fdtpu_cnc_set_state": (None, [vp, u64, u32]),
+        "fdtpu_cnc_heartbeat": (None, [vp, u64, u64]),
+        "fdtpu_cnc_last_heartbeat": (u64, [vp, u64]),
+        "fdtpu_tcache_footprint": (u64, [u64]),
+        "fdtpu_tcache_init": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_tcache_insert": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_ring_gather": (i64, [vp, u64, ct.POINTER(u64), i64,
+                                    ct.POINTER(ct.c_uint8), u64,
+                                    ct.POINTER(u32), ct.POINTER(u64),
+                                    ct.POINTER(u64)]),
+        "fdtpu_ticks": (u64, []),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+    return lib
+
+
+lib = _load()
+
+
+class Frag(ct.Structure):
+    _fields_ = [("seq", ct.c_uint64), ("sig", ct.c_uint64),
+                ("off", ct.c_uint64), ("sz", ct.c_uint32),
+                ("ctl", ct.c_uint16), ("orig", ct.c_uint16),
+                ("tspub", ct.c_uint32)]
+
+
+class Workspace:
+    """Named shared-memory workspace with a bump allocator for layout.
+
+    The bump cursor is Python-side state used only at topology-build time;
+    joiners reconstruct offsets from the topology description, never from
+    the cursor (offsets are the ABI).
+    """
+
+    def __init__(self, name: str, size: int, create: bool = True):
+        self.name, self.size = name, size
+        self.base = lib.fdtpu_wksp_join(name.encode(), size, 1 if create else 0)
+        if not self.base:
+            raise OSError(f"wksp join failed: {name}")
+        self._cursor = 64
+
+    def alloc(self, footprint: int, align: int = 64) -> int:
+        off = (self._cursor + align - 1) & ~(align - 1)
+        if off + footprint > self.size:
+            raise MemoryError("workspace exhausted")
+        self._cursor = off + footprint
+        return off
+
+    def view(self, off: int, sz: int) -> np.ndarray:
+        """uint8 numpy view of [off, off+sz) — zero-copy payload access."""
+        buf = (ct.c_uint8 * sz).from_address(self.base + off)
+        return np.ctypeslib.as_array(buf)
+
+    def close(self):
+        if self.base:
+            lib.fdtpu_wksp_leave(self.base, self.size)
+            self.base = None
+
+    def unlink(self):
+        lib.fdtpu_wksp_unlink(self.name.encode())
+
+
+class Ring:
+    """Single-producer frag ring + payload arena inside a workspace."""
+
+    def __init__(self, wksp: Workspace, off: int, depth: int,
+                 arena_off: int = 0, mtu: int = 0, init: bool = False):
+        self.wksp, self.off, self.depth = wksp, off, depth
+        self.arena_off, self.mtu = arena_off, mtu
+        if init:
+            rc = lib.fdtpu_ring_init(wksp.base, off, depth)
+            if rc:
+                raise ValueError("ring init failed (depth power of 2?)")
+
+    @classmethod
+    def create(cls, wksp: Workspace, depth: int, mtu: int = 0) -> "Ring":
+        mtu = (mtu + 63) & ~63  # chunk-index addressing needs 64B alignment
+        off = wksp.alloc(lib.fdtpu_ring_footprint(depth))
+        arena_off = wksp.alloc(depth * mtu) if mtu else 0
+        return cls(wksp, off, depth, arena_off, mtu, init=True)
+
+    @property
+    def seq(self) -> int:
+        return lib.fdtpu_ring_seq(self.wksp.base, self.off)
+
+    def publish(self, payload: bytes | np.ndarray, sig: int = 0,
+                ctl: int = 3, orig: int = 0) -> int:
+        """Prepare (invalidate slot), copy payload into the slot's arena
+        chunk, publish. ctl=3 is SOM|EOM (single-frag message)."""
+        assert self.mtu, "ring has no payload arena"
+        seq = lib.fdtpu_ring_prepare(self.wksp.base, self.off)
+        slot_off = self.arena_off + (seq % self.depth) * self.mtu
+        assert slot_off % 64 == 0 and slot_off < (1 << 38), \
+            "arena offset outside 32-bit chunk-index range"
+        data = np.frombuffer(payload, np.uint8) if isinstance(
+            payload, (bytes, bytearray)) else payload
+        assert data.nbytes <= self.mtu
+        self.wksp.view(slot_off, data.nbytes)[:] = data
+        return lib.fdtpu_ring_publish(self.wksp.base, self.off, sig,
+                                      slot_off, data.nbytes, ctl, orig)
+
+    def consume(self, seq: int):
+        """-> (rc, Frag). rc 0=ok, 1=not yet, -1=overrun."""
+        frag = Frag()
+        rc = lib.fdtpu_ring_consume(self.wksp.base, self.off, seq,
+                                    ct.byref(frag))
+        return rc, frag
+
+    def payload(self, frag: Frag) -> np.ndarray:
+        return self.wksp.view(frag.off, frag.sz)
+
+    def gather(self, seq: int, max_n: int, stride: int):
+        """Drain up to max_n frags into a fresh (max_n, stride) buffer.
+
+        Returns (n, new_seq, buf, sizes, sigs, overruns) — the microbatch
+        assembly step of the TPU bridge tile."""
+        buf = np.zeros((max_n, stride), np.uint8)
+        sizes = np.zeros(max_n, np.uint32)
+        sigs = np.zeros(max_n, np.uint64)
+        seq_io = ct.c_uint64(seq)
+        ovr = ct.c_uint64(0)
+        n = lib.fdtpu_ring_gather(
+            self.wksp.base, self.off, ct.byref(seq_io), max_n,
+            buf.ctypes.data_as(ct.POINTER(ct.c_uint8)), stride,
+            sizes.ctypes.data_as(ct.POINTER(ct.c_uint32)),
+            sigs.ctypes.data_as(ct.POINTER(ct.c_uint64)), ct.byref(ovr))
+        return n, seq_io.value, buf, sizes, sigs, ovr.value
+
+    def credits(self, fseqs: list["Fseq"]) -> int:
+        offs = (ct.c_uint64 * len(fseqs))(*[f.off for f in fseqs])
+        return lib.fdtpu_fctl_credits(self.wksp.base, self.off, offs,
+                                      len(fseqs))
+
+
+class Fseq:
+    def __init__(self, wksp: Workspace, off: int | None = None,
+                 seq0: int = 0):
+        self.wksp = wksp
+        if off is None:
+            off = wksp.alloc(lib.fdtpu_fseq_footprint())
+            lib.fdtpu_fseq_init(wksp.base, off, seq0)
+        self.off = off
+
+    def query(self) -> int:
+        return lib.fdtpu_fseq_query(self.wksp.base, self.off)
+
+    def update(self, seq: int):
+        lib.fdtpu_fseq_update(self.wksp.base, self.off, seq)
+
+
+class Cnc:
+    def __init__(self, wksp: Workspace, off: int | None = None):
+        self.wksp = wksp
+        if off is None:
+            off = wksp.alloc(lib.fdtpu_cnc_footprint())
+            lib.fdtpu_cnc_init(wksp.base, off)
+        self.off = off
+
+    @property
+    def state(self) -> int:
+        return lib.fdtpu_cnc_state(self.wksp.base, self.off)
+
+    @state.setter
+    def state(self, st: int):
+        lib.fdtpu_cnc_set_state(self.wksp.base, self.off, st)
+
+    def heartbeat(self):
+        lib.fdtpu_cnc_heartbeat(self.wksp.base, self.off, lib.fdtpu_ticks())
+
+    @property
+    def last_heartbeat(self) -> int:
+        return lib.fdtpu_cnc_last_heartbeat(self.wksp.base, self.off)
+
+
+class Tcache:
+    def __init__(self, wksp: Workspace, depth: int, off: int | None = None):
+        self.wksp, self.depth = wksp, depth
+        if off is None:
+            off = wksp.alloc(lib.fdtpu_tcache_footprint(depth))
+            lib.fdtpu_tcache_init(wksp.base, off, depth)
+        self.off = off
+
+    def insert(self, tag: int) -> bool:
+        """True iff tag was already present (duplicate)."""
+        return bool(lib.fdtpu_tcache_insert(self.wksp.base, self.off, tag))

@@ -1,14 +1,26 @@
-"""Test harness config: force an 8-device virtual CPU mesh.
+"""Test harness config: force CPU with an 8-device virtual mesh.
 
-Real TPU hardware in CI has a single chip; multi-chip sharding paths are
-validated on a virtual 8-device CPU platform, mirroring how the reference
-tests tiles without a cluster (reference: doc/testing.md, fd_tile_unit_test).
+Real TPU hardware in CI is a single chip reached through a slow exclusive
+tunnel (the "axon" PJRT plugin, registered by sitecustomize with
+JAX_PLATFORMS=axon); unit tests must not touch it. Multi-chip sharding
+paths are validated on a virtual 8-device CPU platform, mirroring how the
+reference tests tiles without a cluster (reference: doc/testing.md,
+fd_tile_unit_test).
+
+NOTE: sitecustomize imports jax at interpreter startup, so mutating
+os.environ["JAX_PLATFORMS"] here is too late — jax.config already latched
+"axon,cpu". Use jax.config.update. XLA_FLAGS is still read at (lazy) CPU
+backend creation, so setting it here works.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
