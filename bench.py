@@ -25,7 +25,7 @@ import numpy as np
 
 
 def _gen_vectors(n_unique: int, max_len: int, rng: np.random.Generator):
-    from tests.test_ed25519 import keypair, sign  # pure-python RFC 8032
+    from firedancer_tpu.utils.ed25519_ref import keypair, sign
 
     sig = np.zeros((n_unique, 64), np.uint8)
     pub = np.zeros((n_unique, 32), np.uint8)
@@ -50,6 +50,12 @@ def main():
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from firedancer_tpu.ops import ed25519 as ed
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"

@@ -11,9 +11,26 @@ the whole multiply runs in plain int32 — no carries mid-accumulation, no
 64-bit emulation. (Same "pick the radix so the accumulator never overflows
 the lane type" move as r43x6 on IFMA's 52-bit lanes.)
 
-Field elements are arrays of shape (..., 20) int32, limbs little-endian with
-weight 2^(13*i). The invariant maintained by `carry` ("loose-normalized"):
-limbs 1..19 in [0, 2^13), limb 0 in [0, 2^13 + 2^10), value < 2^255 + 2^10.
+Field elements are arrays of shape (..., 20) int32, limbs little-endian
+with weight 2^(13*i), all limbs non-negative.
+
+Carry propagation is fully PARALLEL (no sequential limb chains): `carry`
+runs 3 relaxed passes of (lo = x & mask) + (shifted hi = x >> 13) with the
+top spill folded into limb 0 via 2^260 ≡ 608 (mod p). Bound analysis for
+inputs with limbs < 2^28 (the mul path): pass 1 leaves limbs
+< 2^13 + 2^15 (limb 0 < 2^13 + 608·2^15 < 2^24.3); pass 2 leaves limbs
+1..19 < 2^13 + 2^11.3 and limb 0 < 2^13 + 608·4 = 10624; pass 3 (hi of
+every limb <= 1, top spill <= 1) reaches the steady-state invariant:
+**limbs < 2^13 + 608 = 8800** ("loose-normalized"). Products of
+two loose elements: 8800^2 * 20 < 2^30.6 < int32 max, so schoolbook
+accumulation never overflows. Subtraction adds a per-limb-large constant
+C ≡ 0 (mod p) (limbs >= 22752) so a + C - b stays non-negative limb-wise.
+This costs ~9 cheap full-width ops per reduction instead of a 20-step
+dependency chain — the same accumulate-then-carry-late discipline the
+reference's AVX-512 backend uses across IFMA lanes
+(ref: src/ballet/ed25519/avx512/fd_r43x6.h:10-32), re-derived for 13-bit
+limbs so XLA emits short, wide, fusable graphs.
+
 All functions broadcast over leading batch dimensions; everything is
 jit/vmap/shard_map friendly (static shapes, no data-dependent control flow).
 """
@@ -45,11 +62,29 @@ def limbs_to_int(x) -> int:
 
 
 P_LIMBS = _int_to_limbs(P)
-# 2p = 2^256 - 38 fits in 20 limbs; added before subtraction so the result
-# value stays positive (minuend is loose-normalized: value < 2^255 + 2^10).
-P2_LIMBS = np.array([((2 * P) >> (BITS * i)) & MASK for i in range(NLIMB)],
-                    np.int32)
-assert sum(int(v) << (BITS * i) for i, v in enumerate(P2_LIMBS)) == 2 * P
+
+
+def _sub_const() -> np.ndarray:
+    """Per-limb-large C ≡ 0 (mod p): C_i >= 22752 > any loose limb, so
+    a + C - b is non-negative limb-wise. Built from 128p by moving 2*2^13
+    of weight from each limb i+1 down to limb i (value-preserving), and
+    folding the digit-20 overflow into limb 0 via 2^260 ≡ 608."""
+    v = 128 * P
+    d = [(v >> (BITS * i)) & MASK for i in range(21)]
+    c = np.zeros(NLIMB, np.int64)
+    c[0] = d[0] + 16384
+    for i in range(1, NLIMB):
+        c[i] = d[i] + 16384 - 2
+    d20 = d[20] - 2            # weight moved into limb 19
+    assert d20 >= 0
+    c[0] += 608 * d20
+    total = sum(int(c[i]) << (BITS * i) for i in range(NLIMB))
+    assert total % P == 0
+    assert c.min() >= 22752 and c.max() < (1 << 16)
+    return c.astype(np.int32)
+
+
+SUB_C = _sub_const()
 
 D_LIMBS = _int_to_limbs(d)
 D2_LIMBS = _int_to_limbs(2 * d % P)
@@ -81,17 +116,19 @@ def _digit_pass(x, fold_carry: bool):
 
 
 def carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Reduce any int32 limb vector (|value| < 2^261) to loose-normalized.
+    """Parallel reduction to loose-normalized (limbs < 2^13 + 608).
 
-    Two fold passes bring the value into [0, 2^260); the final high-bit fold
-    (bits >= 255, using 2^255 ≡ 19) brings it under 2^255 + 2^10 so a
-    subsequent `sub` can add 2p and stay positive.
-    """
-    x = _digit_pass(x, fold_carry=True)
-    x = _digit_pass(x, fold_carry=True)
-    h = x[..., NLIMB - 1] >> (255 - BITS * (NLIMB - 1))  # bits >= 255
-    x = x.at[..., NLIMB - 1].set(x[..., NLIMB - 1] & ((1 << (255 - BITS * (NLIMB - 1))) - 1))
-    x = x.at[..., 0].add(h * 19)
+    Input: 20 non-negative int32 limbs (any values < 2^31). Three relaxed
+    passes; each pass is (x & mask) + (x >> 13 shifted up one limb) with
+    the top spill folded into limb 0 at weight 608 (2^260 ≡ 608 mod p).
+    No sequential dependency across limbs. See module docstring for the
+    bound analysis."""
+    for _ in range(3):
+        lo = x & MASK
+        hi = x >> BITS
+        x = lo + jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+        x = x.at[..., 0].add(hi[..., -1] * FOLD)
     return x
 
 
@@ -100,36 +137,37 @@ def add(a, b):
 
 
 def sub(a, b):
-    return carry(a + jnp.asarray(P2_LIMBS) - b)
+    return carry(a + jnp.asarray(SUB_C) - b)
 
 
 def neg(a):
-    return carry(jnp.asarray(P2_LIMBS) - a)
+    return carry(jnp.asarray(SUB_C) - a)
+
+
+# anti-diagonal gather map: coefficient j collects prod[i, j-i]; invalid
+# (i, j-i) pairs point at a trailing zero slot
+_CONV_IDX = np.full((NLIMB, 2 * NLIMB - 1), NLIMB * NLIMB, np.int32)
+for _i in range(NLIMB):
+    for _j in range(2 * NLIMB - 1):
+        if 0 <= _j - _i < NLIMB:
+            _CONV_IDX[_i, _j] = _i * NLIMB + (_j - _i)
 
 
 def _mul_core(a, b):
-    """Schoolbook polynomial product + fold, inputs loose-normalized."""
-    # prod[..., i, k] = a_i * b_k ; each < 2^26.5.
-    prod = a[..., :, None] * b[..., None, :]
-    # Anti-diagonal sums: c_j = sum_i prod[i, j-i]; each < 20 * 2^26.5 < 2^31.
-    ncoef = 2 * NLIMB - 1
-    shape = jnp.broadcast_shapes(a.shape, b.shape)[:-1] + (ncoef,)
-    c = jnp.zeros(shape, jnp.int32)
-    for i in range(NLIMB):
-        c = c.at[..., i:i + NLIMB].add(prod[..., i, :])
-    # Exact digit pass over all 39 coefficients so the 608-fold can't overflow.
-    outs = []
-    cr = jnp.zeros_like(c[..., 0])
-    for j in range(ncoef):
-        v = c[..., j] + cr
-        outs.append(v & MASK)
-        cr = v >> BITS
-    outs.append(cr)  # coefficient 39, < 2^13
-    # Fold coefficients j >= 20 into j-20 with weight 608.
-    res = list(outs[:NLIMB])
-    for j in range(NLIMB, ncoef + 1):
-        res[j - NLIMB] = res[j - NLIMB] + outs[j] * FOLD
-    return carry(jnp.stack(res, axis=-1))
+    """Schoolbook product via one outer product + static gather + sum —
+    no per-limb python loops, so the XLA graph stays small and wide."""
+    prod = a[..., :, None] * b[..., None, :]          # (...,20,20) < 2^26.6
+    flat = prod.reshape(*prod.shape[:-2], NLIMB * NLIMB)
+    flat = jnp.concatenate(
+        [flat, jnp.zeros_like(flat[..., :1])], axis=-1)
+    c = flat[..., jnp.asarray(_CONV_IDX)].sum(axis=-2)  # (...,39) < 2^30.6
+    # one relaxed pass so the 608-fold below cannot overflow int32
+    lo = c & MASK
+    hi = c >> BITS
+    c = jnp.concatenate([lo, jnp.zeros_like(lo[..., :1])], axis=-1)
+    c = c.at[..., 1:].add(hi)                         # (...,40) < 2^18.1
+    # fold coefficients j >= 20 into j-20 at weight 608 -> limbs < 2^27.7
+    return carry(c[..., :NLIMB] + c[..., NLIMB:] * FOLD)
 
 
 def mul(a, b):
@@ -173,8 +211,17 @@ def invert(x):
 
 
 def canonical(x):
-    """Fully reduce mod p: exact digits with value in [0, p)."""
-    x = carry(x)                      # value < 2^255 + 2^10 < 2p
+    """Fully reduce mod p: exact digits with value in [0, p).
+
+    Sequential digit passes are fine here — canonical is only used at
+    kernel boundaries (encode, equality), not in the mul-heavy loops."""
+    x = carry(x)                        # loose: value < (8800/8192)·2^260
+    x = _digit_pass(x, fold_carry=True)
+    x = _digit_pass(x, fold_carry=True)  # exact digits, value < 2^260
+    hb = 255 - BITS * (NLIMB - 1)        # high-bit split within limb 19
+    h = x[..., NLIMB - 1] >> hb
+    x = x.at[..., NLIMB - 1].set(x[..., NLIMB - 1] & ((1 << hb) - 1))
+    x = x.at[..., 0].add(h * 19)         # 2^255 ≡ 19 -> value < 2^255 + 2^11
     x = _digit_pass(x, fold_carry=False)
     p = jnp.asarray(P_LIMBS)
     for _ in range(2):

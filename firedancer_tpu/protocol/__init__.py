@@ -1,0 +1,3 @@
+"""Wire-protocol parsing and construction (the reference's src/ballet/txn,
+shred, gossip wire structs — host-side, feeding TPU microbatches)."""
+from .txn import TxnParseError, parse_txn, ParsedTxn  # noqa: F401

@@ -1,0 +1,10 @@
+"""Tile implementations over the native ring runtime.
+
+The reference runs every pipeline stage as a core-pinned process driven by
+the stem loop (ref: src/disco/stem/fd_stem.c:1-168); tiles here follow the
+same shape — join rings, poll, housekeep, publish — with the TPU verify
+tile playing the role the wiredancer FPGA tile plays in the reference
+(async offload behind the ring ABI, src/wiredancer/README.md:12).
+"""
+from .verify import VerifyTile  # noqa: F401
+from .synth import SynthTile  # noqa: F401

@@ -1,0 +1,55 @@
+"""Synthetic transaction load generator (the reference's benchg tile,
+ref: src/app/shared_dev/commands/bench/fd_benchg_tile.c — pre-signed txn
+spam for end-to-end TPS measurement)."""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..protocol.txn import build_message, build_txn
+from ..runtime import Ring
+
+
+def make_signed_txns(n: int, seed: int = 0,
+                     signer=None) -> list[bytes]:
+    """Build n distinct valid single-signer transactions.
+
+    `signer(seed_bytes, msg) -> (pub, sig)` defaults to the pure-python
+    RFC 8032 reference signer."""
+    if signer is None:
+        from ..utils.ed25519_ref import keypair, sign
+
+        def signer(seed_bytes, msg):
+            _, _, pub = keypair(seed_bytes)
+            return pub, sign(seed_bytes, msg)
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        key_seed = hashlib.sha256(b"synth-%d" % (i % 16)).digest()
+        blockhash = hashlib.sha256(b"hash-%d" % seed).digest()
+        dest = hashlib.sha256(b"dest-%d" % i).digest()
+        # system-transfer-shaped instruction: prog=2, 8B data
+        data = int(rng.integers(1, 1 << 31)).to_bytes(8, "little")
+        pub, _ = signer(key_seed, b"")
+        msg = build_message([pub], [dest, bytes(32)], blockhash,
+                            [(2, bytes([0, 1]), data)], n_ro_unsigned=1)
+        _, sig = signer(key_seed, msg)
+        out.append(build_txn([sig], msg))
+    return out
+
+
+class SynthTile:
+    """Publishes pre-built txns into a ring as fast as credits allow."""
+
+    def __init__(self, out_ring: Ring, txns: list[bytes]):
+        self.out_ring, self.txns = out_ring, txns
+
+    def run(self, count: int, fseqs=None):
+        for i in range(count):
+            if fseqs:
+                while self.out_ring.credits(fseqs) <= 0:
+                    pass
+            t = self.txns[i % len(self.txns)]
+            self.out_ring.publish(t, sig=i)

@@ -1,0 +1,2 @@
+"""Host-side utilities: reference crypto impls, config, rng, histograms
+(the reference's src/util/ equivalents that live Python-side)."""

@@ -2,107 +2,20 @@
 
 Mirrors the reference's test strategy (ref: src/ballet/ed25519/test_ed25519.c,
 test_ed25519_signature_malleability.c, fuzz_ed25519_sigverify_diff.c):
-self-generated sign/verify vectors from an independent pure-python RFC 8032
-implementation, plus malleability / non-canonical-encoding edge cases.
+sign/verify vectors from the independent pure-python RFC 8032 oracle
+(firedancer_tpu/utils/ed25519_ref.py — bigint math, no shared code with
+the limb kernel), plus malleability / non-canonical-encoding edge cases.
 """
-import hashlib
-
 import numpy as np
 import jax.numpy as jnp
 import pytest
 
 from firedancer_tpu.ops import ed25519 as ed
 from firedancer_tpu.ops import fe25519 as fe
-
-P = (1 << 255) - 19
-L = ed.L
-D = -121665 * pow(121666, P - 2, P) % P
-
-
-# --- independent pure-python RFC 8032 reference ----------------------------
-
-def _pt_add(p, q):
-    x1, y1, z1, t1 = p
-    x2, y2, z2, t2 = q
-    a = (y1 - x1) * (y2 - x2) % P
-    b = (y1 + x1) * (y2 + x2) % P
-    c = t1 * (2 * D) % P * t2 % P
-    dd = 2 * z1 * z2 % P
-    e, f, g, h = (b - a) % P, (dd - c) % P, (dd + c) % P, (b + a) % P
-    return (e * f % P, g * h % P, f * g % P, e * h % P)
-
-
-def _pt_mul(k, p):
-    q = (0, 1, 1, 0)
-    while k:
-        if k & 1:
-            q = _pt_add(q, p)
-        p = _pt_add(p, p)
-        k >>= 1
-    return q
-
-
-def _pt_compress(p):
-    x, y, z, _ = p
-    zi = pow(z, P - 2, P)
-    x, y = x * zi % P, y * zi % P
-    return ((y | ((x & 1) << 255)).to_bytes(32, "little"))
-
-
-def _pt_decompress(b):
-    v = int.from_bytes(b, "little")
-    sign, y = v >> 255, v & ((1 << 255) - 1)
-    if y >= P:
-        return None
-    u, vv = (y * y - 1) % P, (D * y * y + 1) % P
-    x = u * pow(vv, 3, P) % P * pow(u * pow(vv, 7, P) % P, (P - 5) // 8, P) % P
-    if vv * x * x % P == u:
-        pass
-    elif vv * x * x % P == P - u:
-        x = x * pow(2, (P - 1) // 4, P) % P
-    else:
-        return None
-    if x == 0 and sign:
-        return None
-    if x & 1 != sign:
-        x = P - x
-    return (x, y, 1, x * y % P)
-
-
-BX, BY = ed.BASEPOINT
-BPT = (BX, BY, 1, BX * BY % P)
-
-
-def keypair(seed: bytes):
-    h = hashlib.sha512(seed).digest()
-    a = int.from_bytes(h[:32], "little")
-    a &= (1 << 254) - 8
-    a |= 1 << 254
-    pub = _pt_compress(_pt_mul(a, BPT))
-    return a, h[32:], pub
-
-
-def sign(seed: bytes, msg: bytes) -> bytes:
-    a, prefix, pub = keypair(seed)
-    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
-    rb = _pt_compress(_pt_mul(r, BPT))
-    k = int.from_bytes(hashlib.sha512(rb + pub + msg).digest(), "little") % L
-    s = (r + k * a) % L
-    return rb + s.to_bytes(32, "little")
-
-
-def ref_verify(sig: bytes, pub: bytes, msg: bytes) -> bool:
-    if int.from_bytes(sig[32:], "little") >= L:
-        return False
-    a = _pt_decompress(pub)
-    if a is None:
-        return False
-    s = int.from_bytes(sig[32:], "little")
-    k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(),
-                       "little") % L
-    neg_a = (P - a[0], a[1], a[2], P - a[3])
-    rp = _pt_add(_pt_mul(s, BPT), _pt_mul(k, neg_a))
-    return _pt_compress(rp) == sig[:32]
+from firedancer_tpu.utils.ed25519_ref import (
+    keypair, sign, verify as ref_verify, pt_mul as _pt_mul,
+    pt_compress as _pt_compress, pt_decompress as _pt_decompress,
+    BASEPOINT as BPT, P, L)
 
 
 def _batch(cases, max_len=128):

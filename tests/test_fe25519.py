@@ -25,9 +25,10 @@ def from_limbs(arr):
 
 
 def check_loose(arr):
+    # loose-normalized invariant: non-negative limbs < 2^13 + 608
+    # (see fe25519 module docstring bound analysis)
     arr = np.asarray(arr)
-    assert arr[..., 1:].min() >= 0 and arr[..., 1:].max() < 2 ** 13
-    assert arr[..., 0].min() >= 0 and arr[..., 0].max() < 2 ** 13 + 2 ** 10
+    assert arr.min() >= 0 and arr.max() < 2 ** 13 + 608
 
 
 @pytest.mark.parametrize("op,pyop", [

@@ -1,0 +1,96 @@
+"""Transaction parser tests (ref test model: src/ballet/txn/test_txn.c —
+constructed vectors incl. malformed truncations)."""
+import pytest
+
+from firedancer_tpu.protocol.txn import (
+    parse_txn, build_message, build_txn, TxnParseError, MTU, _cu16,
+    _cu16_enc)
+
+
+def _mk(n_signers=1, n_extra=2, version=-1, n_instr=1):
+    signers = [bytes([i]) * 32 for i in range(1, n_signers + 1)]
+    extras = [bytes([0x40 + i]) * 32 for i in range(n_extra)]
+    instrs = [(n_signers + n_extra - 1, bytes([0, 1]), b"data%d" % k)
+              for k in range(n_instr)]
+    msg = build_message(signers, extras, b"\xbb" * 32, instrs,
+                        n_ro_unsigned=1, version=version)
+    sigs = [bytes([0x70 + i]) * 64 for i in range(n_signers)]
+    return build_txn(sigs, msg), sigs, signers, msg
+
+
+def test_compact_u16_roundtrip():
+    for v in [0, 1, 0x7F, 0x80, 0x3FFF, 0x4000, 0xFFFF]:
+        enc = _cu16_enc(v)
+        got, off = _cu16(enc + b"rest", 0)
+        assert got == v and off == len(enc)
+
+
+def test_compact_u16_nonminimal_rejected():
+    with pytest.raises(TxnParseError):
+        _cu16(bytes([0x80, 0x00]), 0)   # 0 encoded in 2 bytes
+
+
+def test_parse_legacy():
+    payload, sigs, signers, msg = _mk()
+    t = parse_txn(payload)
+    assert t.version == -1
+    assert t.sig_cnt == 1
+    assert t.signatures(payload) == sigs
+    assert t.signer_pubkeys(payload) == signers
+    assert t.message(payload) == msg
+    assert t.acct_cnt == 3
+    assert len(t.instrs) == 1
+    assert t.instrs[0].prog_idx == 2
+    # fee payer writable; extras: first writable, last readonly
+    assert t.is_writable(0) and t.is_writable(1) and not t.is_writable(2)
+
+
+def test_parse_v0():
+    payload, sigs, signers, msg = _mk(version=0)
+    t = parse_txn(payload)
+    assert t.version == 0
+    assert t.alut_cnt == 0
+    assert t.message(payload) == msg
+
+
+def test_parse_multisig():
+    payload, sigs, signers, _ = _mk(n_signers=3)
+    t = parse_txn(payload)
+    assert t.sig_cnt == 3
+    assert t.signatures(payload) == sigs
+    assert t.signer_pubkeys(payload) == signers
+
+
+def test_parse_rejects_malformed():
+    payload, *_ = _mk()
+    with pytest.raises(TxnParseError):
+        parse_txn(payload[:-1])          # trailing truncation
+    with pytest.raises(TxnParseError):
+        parse_txn(payload + b"\x00")     # trailing garbage
+    with pytest.raises(TxnParseError):
+        parse_txn(payload[:10])          # truncated sigs
+    with pytest.raises(TxnParseError):
+        parse_txn(b"\x00" + payload[1:])  # zero sigs
+    with pytest.raises(TxnParseError):
+        parse_txn(b"\x00" * (MTU + 1))   # over MTU
+    # header signer count != sig count
+    bad = bytearray(payload)
+    t = parse_txn(payload)
+    bad[t.msg_off] = 2
+    with pytest.raises(TxnParseError):
+        parse_txn(bytes(bad))
+
+
+def test_mtu_sized_txn():
+    # pad instruction data until exactly MTU
+    payload, *_ = _mk()
+    room = MTU - len(payload) - 3  # cu16(len) grows by <=2 bytes
+    signers = [bytes([1]) * 32]
+    extras = [bytes([0x41]) * 32, bytes([0x42]) * 32]
+    msg = build_message(signers, extras, b"\xbb" * 32,
+                        [(2, bytes([0, 1]), b"x" * (room + 5 - 64))],
+                        n_ro_unsigned=1)
+    txn = build_txn([bytes(64)], msg)
+    assert len(txn) <= MTU
+    t = parse_txn(txn)
+    assert t.instrs[0].data_sz >= room - 64
