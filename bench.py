@@ -1,6 +1,6 @@
 """Headline benchmark: batched ed25519 sigverify throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Baseline: the reference's wiredancer FPGA sigverify tile sustains ~1M
 verifies/s on one AWS-F1 card, vs ~30K/s per Skylake core for the C path
@@ -13,15 +13,25 @@ Methodology mirrors the reference's unit-test self-benchmarks
 tight loop over pre-generated valid signatures): pre-generate distinct
 signed messages host-side, tile to the microbatch size, jit-compile once,
 then time steady-state iterations end-to-end (device dispatch + compute +
-verdict readback).
+verdict readback). Per-iteration wall times give p99 dispatch latency.
+
+Resilience: the TPU backend ("axon" PJRT plugin over a tunnel) can fail or
+hang at init. The parent process therefore runs the measurement in a child
+with a bounded deadline; on failure it retries with the CPU backend forced,
+and ALWAYS emits exactly one JSON line (value 0 + "error" when everything
+failed). The recorded "platform" field says what actually ran.
 """
 import hashlib
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE_VPS = 1.0e6
 
 
 def _gen_vectors(n_unique: int, max_len: int, rng: np.random.Generator):
@@ -44,23 +54,31 @@ def _gen_vectors(n_unique: int, max_len: int, rng: np.random.Generator):
     return sig, pub, msg, ln
 
 
-def main():
+def _child_bench():
+    """Run the measurement on whatever backend this process resolves.
+
+    Prints one JSON line on success; any exception propagates (the parent
+    handles fallback + reporting)."""
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("FDTPU_BENCH_FORCE_CPU") == "1":
+        # sitecustomize latched the axon platform before our env mattered
+        jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, HERE)
     from firedancer_tpu.ops import ed25519 as ed
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(HERE, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
-    batch = int(os.environ.get("FDTPU_BENCH_BATCH", "8192" if on_tpu else "64"))
-    max_len = 128          # typical txn message region fits; MTU path is 1232
+    platform = dev.platform
+    on_tpu = platform != "cpu"
+    batch = int(os.environ.get("FDTPU_BENCH_BATCH",
+                               "8192" if on_tpu else "64"))
+    max_len = int(os.environ.get("FDTPU_BENCH_MSG_LEN", "128"))
     n_unique = min(batch, 256)
 
     rng = np.random.default_rng(42)
@@ -74,15 +92,20 @@ def main():
     fn = jax.jit(ed.verify_batch)
     args = (jnp.asarray(sig), jnp.asarray(pub), jnp.asarray(msg),
             jnp.asarray(ln))
+    t0 = time.perf_counter()
     out = fn(*args)
     out.block_until_ready()
+    compile_s = time.perf_counter() - t0
     assert bool(np.asarray(out).all()), "bench vectors failed to verify"
 
     iters = int(os.environ.get("FDTPU_BENCH_ITERS", "8" if on_tpu else "2"))
+    lat = []
     t0 = time.perf_counter()
     for _ in range(iters):
+        t1 = time.perf_counter()
         out = fn(*args)
-    out.block_until_ready()
+        out.block_until_ready()
+        lat.append(time.perf_counter() - t1)
     dt = time.perf_counter() - t0
 
     vps = batch * iters / dt
@@ -90,8 +113,62 @@ def main():
         "metric": "ed25519_verifies_per_sec",
         "value": round(vps, 1),
         "unit": "verifies/s/chip",
-        "vs_baseline": round(vps / 1.0e6, 4),
+        "vs_baseline": round(vps / BASELINE_VPS, 4),
+        "platform": platform,
+        "batch": batch,
+        "iters": iters,
+        "msg_len": max_len,
+        "p99_batch_ms": round(sorted(lat)[min(len(lat) - 1,
+                                              -(-len(lat) * 99 // 100) - 1)]
+                              * 1e3, 2),
+        "compile_s": round(compile_s, 1),
     }))
+    sys.stdout.flush()
+
+
+def _run_child(env_extra: dict, timeout_s: float):
+    """-> parsed JSON dict or raises."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["FDTPU_BENCH_CHILD"] = "1"
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       capture_output=True, text=True, timeout=timeout_s,
+                       cwd=HERE, env=env)
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+            if isinstance(d, dict) and "metric" in d:
+                return d
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(
+        f"child rc={r.returncode}: {(r.stderr or r.stdout)[-300:]}")
+
+
+def main():
+    if os.environ.get("FDTPU_BENCH_CHILD") == "1":
+        _child_bench()
+        return
+
+    result = {"metric": "ed25519_verifies_per_sec", "value": 0.0,
+              "unit": "verifies/s/chip", "vs_baseline": 0.0}
+    errors = []
+    t_tpu = float(os.environ.get("FDTPU_BENCH_TPU_TIMEOUT", "900"))
+    t_cpu = float(os.environ.get("FDTPU_BENCH_CPU_TIMEOUT", "900"))
+    try:
+        result = _run_child({}, t_tpu)
+    except Exception as e:  # noqa: BLE001 — must always emit JSON
+        errors.append(f"default-backend: {e!r}"[:300])
+        try:
+            result = _run_child(
+                {"JAX_PLATFORMS": "cpu", "FDTPU_BENCH_FORCE_CPU": "1"},
+                t_cpu)
+            result["platform"] = result.get("platform", "cpu") + " (fallback)"
+        except Exception as e2:  # noqa: BLE001
+            errors.append(f"cpu-fallback: {e2!r}"[:300])
+            result["error"] = " | ".join(errors)
+    print(json.dumps(result))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

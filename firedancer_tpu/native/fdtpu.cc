@@ -2,6 +2,7 @@
 #include "fdtpu.h"
 
 #include <atomic>
+#include <cerrno>
 #include <cstring>
 #include <ctime>
 
@@ -86,10 +87,31 @@ extern "C" {
 /* ---- workspace ------------------------------------------------------- */
 
 void *fdtpu_wksp_join(const char *name, uint64_t sz, int create) {
-  int flags = O_RDWR | (create ? O_CREAT : 0);
-  int fd = shm_open(name, flags, 0600);
-  if (fd < 0) return nullptr;
-  if (create && ftruncate(fd, (off_t)sz) != 0) { close(fd); return nullptr; }
+  /* create=0: join existing; create=1: exclusive create (fails on
+   * EEXIST — safe under racing creators); create=2: replace — unlink any
+   * stale segment from a crashed run and create fresh (zero-filled).
+   * Replace mode is single-creator-discipline only: the caller asserts
+   * no live process is using the name (the topology builder is the one
+   * creator; every tile joins with create=0). */
+  int fd;
+  if (create) {
+    fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd < 0 && errno == EEXIST && create == 2) {
+      shm_unlink(name);
+      fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+    }
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, (off_t)sz) != 0) { close(fd); return nullptr; }
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    /* joining: segment must already be at least the requested size */
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < sz) {
+      close(fd);
+      return nullptr;
+    }
+  }
   void *p = mmap(nullptr, sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   return p == MAP_FAILED ? nullptr : p;
@@ -278,6 +300,24 @@ int fdtpu_tcache_init(void *base, uint64_t off, uint64_t depth) {
   uint64_t *map = ring + depth;
   std::memset(ring, 0, depth * sizeof(uint64_t));
   std::memset(map, 0, map_cnt * sizeof(uint64_t));
+  return 0;
+}
+
+int fdtpu_tcache_query(void *base, uint64_t off, uint64_t tag) {
+  /* presence check only — no mutation. The verify path queries before
+   * spending device lanes and inserts only tags that PASSED verification
+   * (reference ordering: src/disco/verify/fd_verify_tile.h:84-101), so a
+   * failed signature can never poison the dedup window. */
+  if (!tag) tag = 1;
+  TcacheHdr *h = reinterpret_cast<TcacheHdr *>(at(base, off));
+  uint64_t *ring = reinterpret_cast<uint64_t *>(h + 1);
+  uint64_t *map = ring + h->depth;
+  uint64_t mask = h->map_cnt - 1;
+  uint64_t idx = tmix(tag) & mask;
+  while (map[idx]) {
+    if (map[idx] == tag) return 1;
+    idx = (idx + 1) & mask;
+  }
   return 0;
 }
 

@@ -134,8 +134,11 @@ uint64_t fdtpu_cnc_last_heartbeat(void *base, uint64_t off);
 
 /* ---- tcache: 64-bit tag dedup (ring + open-address map) --------------- */
 
+
 uint64_t fdtpu_tcache_footprint(uint64_t depth);
 int      fdtpu_tcache_init(void *base, uint64_t off, uint64_t depth);
+/* Query-only presence check; returns 1 if tag present, 0 otherwise. */
+int      fdtpu_tcache_query(void *base, uint64_t off, uint64_t tag);
 /* Insert tag; returns 1 if tag was already present (duplicate), 0 if new.
  * Oldest tag is evicted once more than `depth` distinct tags inserted. */
 int      fdtpu_tcache_insert(void *base, uint64_t off, uint64_t tag);

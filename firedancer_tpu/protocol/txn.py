@@ -114,6 +114,8 @@ def parse_txn(payload: bytes) -> ParsedTxn:
         raise TxnParseError("truncated signatures")
     msg_off = off
 
+    if off >= len(payload):
+        raise TxnParseError("empty message")
     version = -1
     if payload[off] & 0x80:
         version = payload[off] & 0x7F

@@ -56,6 +56,7 @@ def _load():
         "fdtpu_cnc_last_heartbeat": (u64, [vp, u64]),
         "fdtpu_tcache_footprint": (u64, [u64]),
         "fdtpu_tcache_init": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_tcache_query": (ct.c_int, [vp, u64, u64]),
         "fdtpu_tcache_insert": (ct.c_int, [vp, u64, u64]),
         "fdtpu_ring_gather": (i64, [vp, u64, ct.POINTER(u64), i64,
                                     ct.POINTER(ct.c_uint8), u64,
@@ -88,9 +89,17 @@ class Workspace:
     the cursor (offsets are the ABI).
     """
 
-    def __init__(self, name: str, size: int, create: bool = True):
+    def __init__(self, name: str, size: int, create: bool = True,
+                 replace: bool = True):
+        """create=True makes a fresh segment. replace=True (the default)
+        additionally unlinks a stale leftover from a crashed run — callers
+        must follow single-creator discipline (one topology builder
+        creates; every other process joins with create=False), because
+        replacing a name a LIVE process has mapped splits the two onto
+        different memory. Use replace=False for strict exclusive create."""
         self.name, self.size = name, size
-        self.base = lib.fdtpu_wksp_join(name.encode(), size, 1 if create else 0)
+        mode = (2 if replace else 1) if create else 0
+        self.base = lib.fdtpu_wksp_join(name.encode(), size, mode)
         if not self.base:
             raise OSError(f"wksp join failed: {name}")
         self._cursor = 64
@@ -235,6 +244,10 @@ class Tcache:
             off = wksp.alloc(lib.fdtpu_tcache_footprint(depth))
             lib.fdtpu_tcache_init(wksp.base, off, depth)
         self.off = off
+
+    def query(self, tag: int) -> bool:
+        """True iff tag is present (no mutation)."""
+        return bool(lib.fdtpu_tcache_query(self.wksp.base, self.off, tag))
 
     def insert(self, tag: int) -> bool:
         """True iff tag was already present (duplicate)."""

@@ -13,8 +13,23 @@ Batch assembly keeps ONE compiled shape (short batches are padded with
 dead lanes, masked after) so XLA never recompiles in steady state; a txn
 with k signatures occupies k lanes and passes only if all k verify (the
 reference loops sigs the same way, fd_verify_tile.h:94).
+
+Dedup ordering matches the reference exactly: the tag is a per-boot
+seeded hash over the FULL 64-byte first signature (fd_verify_tile.h:82
+`fd_hash(ctx->hashmap_seed, signatures, 64UL)`), queried BEFORE verify
+but inserted only AFTER the signature verifies (fd_verify_tile.h:98-101)
+— so an attacker-crafted garbage txn with a colliding sig prefix cannot
+poison the dedup window and censor the legitimate transaction.
+
+Publishing is credit-gated: when downstream reliable consumers' fseqs are
+attached, the tile spins for credits instead of silently lapping them
+(ref: src/tango/fctl/fd_fctl.h:4-10).
 """
 from __future__ import annotations
+
+import hashlib
+import os
+import time
 
 import numpy as np
 
@@ -25,13 +40,19 @@ from ..runtime import Ring, Tcache
 class VerifyTile:
     def __init__(self, in_ring: Ring, out_ring: Ring, tcache: Tcache,
                  batch: int = 256, max_len: int = MTU,
-                 backend: str = "jax"):
+                 backend: str = "jax", out_fseqs=None,
+                 dedup_seed: bytes | None = None):
         self.in_ring, self.out_ring, self.tcache = in_ring, out_ring, tcache
         self.batch, self.max_len = batch, max_len
+        self.out_fseqs = list(out_fseqs or [])
+        # per-boot random seed: tags are unpredictable to senders
+        self.dedup_seed = dedup_seed if dedup_seed is not None \
+            else os.urandom(16)
         self.seq = 0
+        self._cnc = None
         self.metrics = {
             "rx": 0, "parse_fail": 0, "dedup_drop": 0, "verify_fail": 0,
-            "tx": 0, "overruns": 0, "batches": 0,
+            "tx": 0, "overruns": 0, "batches": 0, "backpressure": 0,
         }
         if backend == "jax":
             import jax
@@ -46,6 +67,12 @@ class VerifyTile:
                        jnp.asarray(msg), jnp.asarray(ln))
         return np.asarray(out)
 
+    def _tag(self, payload: bytes, t) -> int:
+        """Seeded hash of the full 64-byte first signature."""
+        h = hashlib.blake2b(payload[t.sig_off:t.sig_off + 64],
+                            digest_size=8, key=self.dedup_seed)
+        return int.from_bytes(h.digest(), "little")
+
     def poll_once(self) -> int:
         """Gather -> parse -> ha-dedup -> device verify -> publish.
         Returns number of frags CONSUMED (0 only when the ring was idle,
@@ -57,26 +84,27 @@ class VerifyTile:
             return 0
         self.metrics["rx"] += n
 
-        # host parse + ha-dedup on first sig BEFORE spending device lanes
-        # (ref order: src/disco/verify/fd_verify_tile.h:84-94)
+        # host parse + ha-dedup query on first sig BEFORE spending device
+        # lanes (ref order: src/disco/verify/fd_verify_tile.h:84-94)
         lanes = []                   # (txn_idx, sig, pub, msg)
         parsed = {}
         for i in range(n):
             payload = bytes(buf[i, : sizes[i]])
             try:
                 t = parse_txn(payload)
-            except TxnParseError:
+            except (TxnParseError, ValueError, IndexError):
+                # any malformed wire bytes are a drop, never a crash
                 self.metrics["parse_fail"] += 1
                 continue
-            tag = int.from_bytes(payload[t.sig_off:t.sig_off + 8], "little")
-            if self.tcache.insert(tag):
+            tag = self._tag(payload, t)
+            if self.tcache.query(tag):
                 self.metrics["dedup_drop"] += 1
                 continue
             msg = t.message(payload)
             for s, p in zip(t.signatures(payload),
                             t.signer_pubkeys(payload)):
                 lanes.append((i, s, p, msg))
-            parsed[i] = (payload, t)
+            parsed[i] = (payload, tag)
         if not lanes:
             return n
 
@@ -100,20 +128,49 @@ class VerifyTile:
                     txn_ok[ti] = False
 
         fwd = 0
-        for i, (payload, t) in parsed.items():
+        for i, (payload, tag) in parsed.items():
             if not txn_ok[i]:
                 self.metrics["verify_fail"] += 1
                 continue
-            tag = int.from_bytes(payload[t.sig_off:t.sig_off + 8], "little")
+            # insert AFTER verify passed; a racing duplicate between query
+            # and insert is dropped here (insert returns "already present")
+            if self.tcache.insert(tag):
+                self.metrics["dedup_drop"] += 1
+                continue
+            if not self._wait_credits():
+                break               # halted while backpressured
             self.out_ring.publish(payload, sig=tag)
             fwd += 1
         self.metrics["tx"] += fwd
         return n
 
+    def _wait_credits(self) -> bool:
+        """Block until the out ring has credits. Counts one backpressure
+        event (not one per spin), keeps heartbeating, and aborts — returns
+        False — if the tile is halted while waiting, so a dead downstream
+        consumer can never wedge the tile (the reference's stance: stall
+        visibly under fctl backpressure, never lap a reliable consumer,
+        src/tango/fctl/fd_fctl.h:4-10)."""
+        if not self.out_fseqs or self.out_ring.credits(self.out_fseqs) > 0:
+            return True
+        self.metrics["backpressure"] += 1
+        spins = 0
+        while self.out_ring.credits(self.out_fseqs) <= 0:
+            spins += 1
+            if spins % 256 == 0:
+                if self._cnc is not None:
+                    self._cnc.heartbeat()
+                    from ..runtime import CNC_RUN
+                    if self._cnc.state != CNC_RUN:
+                        return False
+                time.sleep(50e-6)
+        return True
+
     def run(self, cnc, spin_limit: int | None = None):
         """Stem-style loop: poll until cnc leaves RUN (or spin budget)."""
         from ..runtime import CNC_RUN
         spins = 0
+        self._cnc = cnc
         cnc.state = CNC_RUN
         while cnc.state == CNC_RUN:
             if not self.poll_once():

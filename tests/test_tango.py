@@ -100,6 +100,54 @@ def test_tcache_dedup(wksp):
     assert tc.insert(13)        # still resident
 
 
+def test_tcache_query_no_mutation(wksp):
+    tc = Tcache(wksp, depth=4)
+    assert not tc.query(42)     # absent
+    assert not tc.query(42)     # query never inserts
+    assert not tc.insert(42)
+    assert tc.query(42)
+    assert tc.insert(42)        # still a dup after queries
+
+
+def test_wksp_create_replaces_stale_segment():
+    """create=True over a leftover segment must produce fresh zeroed
+    memory, not silently reuse stale contents (advisor finding r1)."""
+    name = f"/fdtpu_stale_{os.getpid()}"
+    w1 = Workspace(name, 1 << 20)
+    w1.view(0, 8)[:] = np.arange(1, 9, dtype=np.uint8)
+    w1.close()                  # crash simulation: no unlink
+    w2 = Workspace(name, 1 << 20)   # re-create
+    assert w2.view(0, 8).sum() == 0
+    w2.close()
+    w2.unlink()
+
+
+def test_wksp_exclusive_create_fails_on_existing():
+    """replace=False is a strict O_EXCL create: safe under racing
+    creators (never destroys a live segment)."""
+    name = f"/fdtpu_excl_{os.getpid()}"
+    w1 = Workspace(name, 1 << 16, replace=False)
+    try:
+        with pytest.raises(OSError):
+            Workspace(name, 1 << 16, replace=False)
+    finally:
+        w1.close()
+        w1.unlink()
+
+
+def test_wksp_join_missing_or_small_fails():
+    name = f"/fdtpu_missing_{os.getpid()}"
+    with pytest.raises(OSError):
+        Workspace(name, 1 << 20, create=False)
+    w = Workspace(name, 1 << 16)
+    try:
+        with pytest.raises(OSError):
+            Workspace(name, 1 << 20, create=False)  # larger than segment
+    finally:
+        w.close()
+        w.unlink()
+
+
 def test_tcache_eviction_map_consistency(wksp):
     tc = Tcache(wksp, depth=16)
     rng = np.random.default_rng(3)

@@ -81,6 +81,13 @@ def test_parse_rejects_malformed():
         parse_txn(bytes(bad))
 
 
+def test_parse_payload_ending_after_sigs():
+    # payload that ends immediately after the signatures must raise
+    # TxnParseError, not IndexError (advisor finding r1: remote DoS)
+    with pytest.raises(TxnParseError):
+        parse_txn(b"\x01" + b"\xab" * 64)
+
+
 def test_mtu_sized_txn():
     # pad instruction data until exactly MTU
     payload, *_ = _mk()

@@ -75,3 +75,66 @@ def test_verify_tile_dedup(wksp, txns):
         pass
     assert tile.metrics["tx"] == 4
     assert tile.metrics["dedup_drop"] == 4
+
+
+def test_dedup_not_poisoned_by_invalid_sig(wksp, txns):
+    """A garbage txn carrying a victim's signature bytes must NOT censor
+    the victim: tags are inserted only after verify passes (advisor
+    finding r1; ref ordering src/disco/verify/fd_verify_tile.h:84-101)."""
+    in_ring = Ring.create(wksp, depth=64, mtu=1280)
+    out_ring = Ring.create(wksp, depth=64, mtu=1280)
+    tc = Tcache(wksp, depth=512)
+    tile = VerifyTile(in_ring, out_ring, tc, batch=BATCH)
+
+    victim = txns[0]
+    # attacker copies the victim's signature but alters the message, so
+    # the signature fails; previously its tag still entered the tcache
+    attacker = bytearray(victim)
+    attacker[-1] ^= 0xFF
+    in_ring.publish(bytes(attacker), sig=1)
+    while tile.poll_once():
+        pass
+    assert tile.metrics["verify_fail"] == 1
+
+    in_ring.publish(victim, sig=2)
+    while tile.poll_once():
+        pass
+    assert tile.metrics["dedup_drop"] == 0
+    assert tile.metrics["tx"] == 1    # victim delivered
+
+
+def test_verify_tile_credit_gating(wksp, txns):
+    """With a reliable downstream fseq attached, the tile must not lap
+    the consumer: publishes wait for credits (advisor finding r1)."""
+    from firedancer_tpu.runtime import Fseq
+
+    depth = 8
+    in_ring = Ring.create(wksp, depth=64, mtu=1280)
+    out_ring = Ring.create(wksp, depth=depth, mtu=1280)
+    tc = Tcache(wksp, depth=512)
+    fs = Fseq(wksp)
+
+    import threading
+    tile = VerifyTile(in_ring, out_ring, tc, batch=BATCH, out_fseqs=[fs])
+    n = 16            # 2x out-ring depth: must backpressure without loss
+    SynthTile(in_ring, txns[:n]).run(n)
+
+    got = []
+
+    def consumer():
+        seq = 0
+        while len(got) < n:
+            rc, frag = out_ring.consume(seq)
+            if rc != 0:
+                continue
+            got.append(bytes(out_ring.payload(frag)))
+            seq += 1
+            fs.update(seq)
+
+    th = threading.Thread(target=consumer)
+    th.start()
+    while tile.poll_once():
+        pass
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert got == txns[:n]
