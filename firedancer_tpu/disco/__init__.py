@@ -1,0 +1,11 @@
+"""disco: the tile kernel — topology model/builder, stem run loop,
+process launcher/supervisor, metrics and monitor.
+
+TPU-native re-expression of the reference's disco layer
+(ref: src/disco/topo/fd_topo.h:36-684 — topology model + run vtable;
+src/disco/stem/fd_stem.c:1-168 — the templated tile run loop;
+src/app/shared/commands/monitor/monitor.c — live metrics monitor).
+"""
+from .topo import Topology  # noqa: F401
+from .stem import Stem  # noqa: F401
+from .launch import TopologyRunner, tile_main  # noqa: F401

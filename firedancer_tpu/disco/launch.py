@@ -1,0 +1,140 @@
+"""Process launcher + fail-fast supervisor.
+
+The reference launches one sandboxed process per tile and runs a
+supervisor that tears the whole validator down if ANY tile dies
+(ref: src/disco/topo/fd_topo_run.c:65-190 — per-tile clone + init;
+src/app/shared/commands/run/run.c:229-260,925 — pid-namespace
+supervisor, "one tile dies => everything dies"). Heartbeat liveness is
+observed through each tile's cnc (ref: src/tango/cnc/fd_cnc.h:6-40).
+
+Here tiles are spawned processes (fresh interpreters — the moral
+equivalent of clone: no inherited jax/backends state); the plan dict is
+the only shared contract. The runner writes the plan JSON next to the
+shm segment so an external monitor can attach by topology name.
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import time
+
+from ..runtime import Workspace, Cnc, CNC_RUN, CNC_HALT, CNC_BOOT
+from . import topo as topo_mod
+from .stem import Stem
+from .topo import TileCtx
+
+
+def tile_main(plan: dict, tile_name: str):
+    """Entry point of a tile process (ref: fd_topo_run_tile)."""
+    from .tiles import REGISTRY
+    ctx = TileCtx(plan, tile_name)
+    try:
+        kind = plan["tiles"][tile_name]["kind"]
+        adapter = REGISTRY[kind](ctx, plan["tiles"][tile_name]["args"])
+        Stem(ctx, adapter).run()
+    finally:
+        ctx.close()
+
+
+def plan_path(topology_name: str) -> str:
+    return f"/dev/shm/fdtpu_{topology_name}.plan.json"
+
+
+class TopologyRunner:
+    """Build-products holder + launcher + supervisor."""
+
+    def __init__(self, plan: dict):
+        self.plan = plan
+        self.wksp = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                              create=False)
+        self.procs: dict[str, mp.process.BaseProcess] = {}
+        self._mp = mp.get_context("spawn")
+        with open(plan_path(plan["topology"]), "w") as f:
+            json.dump(plan, f)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, tiles=None):
+        for tn in (tiles or self.plan["tiles"]):
+            p = self._mp.Process(target=tile_main, args=(self.plan, tn),
+                                 name=f"tile:{tn}", daemon=True)
+            p.start()
+            self.procs[tn] = p
+        return self
+
+    def _cnc(self, tn: str) -> Cnc:
+        return Cnc(self.wksp, off=self.plan["tiles"][tn]["cnc_off"])
+
+    def wait_running(self, timeout_s: float = 600.0):
+        """Block until every launched tile reaches RUN (compile warmup
+        for device tiles can dominate; hence the generous default)."""
+        t0 = time.time()
+        for tn in self.procs:
+            while self._cnc(tn).state != CNC_RUN:
+                self.check_failures()
+                if time.time() - t0 > timeout_s:
+                    raise TimeoutError(f"tile {tn} never reached RUN")
+                time.sleep(0.01)
+        return self
+
+    def check_failures(self):
+        """Fail-fast: any dead tile process fails the whole topology
+        (ref: run.c:925 — pid-namespace teardown)."""
+        dead = [tn for tn, p in self.procs.items()
+                if not p.is_alive() and p.exitcode not in (0, None)
+                and self._cnc(tn).state != CNC_HALT]
+        if dead:
+            info = {tn: self.procs[tn].exitcode for tn in dead}
+            self.halt(join_timeout_s=10.0)
+            raise RuntimeError(f"tile process(es) died: {info}")
+
+    def heartbeats(self) -> dict[str, int]:
+        """Ticks since each tile's last heartbeat."""
+        now = topo_mod.now_ticks()
+        return {tn: max(0, now - self._cnc(tn).last_heartbeat)
+                for tn in self.procs}
+
+    def metrics(self, tile_name: str):
+        from .tiles import REGISTRY
+        vals = topo_mod.read_metrics(self.wksp, self.plan, tile_name)
+        kind = self.plan["tiles"][tile_name]["kind"]
+        names = getattr(REGISTRY[kind], "METRICS", [])
+        return {nm: int(vals[i]) for i, nm in enumerate(names)}
+
+    def halt(self, join_timeout_s: float = 30.0):
+        for tn in self.procs:
+            self._cnc(tn).state = CNC_HALT
+        deadline = time.time() + join_timeout_s
+        for tn, p in self.procs.items():
+            p.join(max(0.1, deadline - time.time()))
+            if p.is_alive():
+                p.terminate()
+                p.join(5.0)
+        return self
+
+    def close(self, unlink: bool = True):
+        name = self.plan["wksp"]["name"]
+        self.wksp.close()
+        if unlink:
+            try:
+                os.unlink(plan_path(self.plan["topology"]))
+            except OSError:
+                pass
+            Workspace.unlink_name(name)
+
+    # -- convenience -------------------------------------------------------
+
+    def wait_idle(self, tile_name: str, metric: str, target: int,
+                  timeout_s: float = 600.0, poll_s: float = 0.05):
+        """Poll one tile's metric until it reaches target (test/bench
+        aid — the bencho pattern)."""
+        t0 = time.time()
+        while time.time() - t0 < timeout_s:
+            self.check_failures()
+            if self.metrics(tile_name).get(metric, 0) >= target:
+                return self
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"{tile_name}.{metric} never reached {target}: "
+            f"{self.metrics(tile_name)}")

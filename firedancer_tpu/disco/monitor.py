@@ -1,0 +1,84 @@
+"""Monitor: read every tile's heartbeat + metrics from shared memory.
+
+The reference's `monitor` command attaches to the running validator's
+shm and diff-prints per-tile status snapshots
+(ref: src/app/shared/commands/monitor/monitor.c:61,100,296-338).
+
+Usage:
+  python -m firedancer_tpu.disco.monitor <topology-name> [--watch SECS]
+
+Attaches via the plan JSON the runner drops in /dev/shm, so it works
+from any process with no coordination beyond the topology name.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from ..runtime import Workspace, Cnc, CNC_BOOT, CNC_RUN, CNC_HALT, CNC_FAIL
+from . import topo as topo_mod
+from .launch import plan_path
+
+_STATE = {CNC_BOOT: "boot", CNC_RUN: "run", CNC_HALT: "halt",
+          CNC_FAIL: "FAIL"}
+
+
+def snapshot(plan: dict, wksp: Workspace) -> dict:
+    """{tile: {state, hb_age_ticks, metrics{...}}}"""
+    from .tiles import REGISTRY
+    out = {}
+    now = topo_mod.now_ticks()
+    for tn, spec in plan["tiles"].items():
+        cnc = Cnc(wksp, off=spec["cnc_off"])
+        vals = topo_mod.read_metrics(wksp, plan, tn)
+        names = getattr(REGISTRY.get(spec["kind"], object), "METRICS", [])
+        out[tn] = {
+            "kind": spec["kind"],
+            "state": _STATE.get(cnc.state, f"?{cnc.state}"),
+            # clamp: clock reads race across processes by a few ticks
+            "hb_age_ticks": max(0, now - cnc.last_heartbeat),
+            "metrics": {nm: int(vals[i]) for i, nm in enumerate(names)},
+        }
+    return out
+
+
+def format_table(snap: dict) -> str:
+    lines = [f"{'tile':<14}{'kind':<10}{'state':<7}{'hb_age':>12}  metrics"]
+    for tn, row in snap.items():
+        ms = " ".join(f"{k}={v}" for k, v in row["metrics"].items() if v)
+        lines.append(f"{tn:<14}{row['kind']:<10}{row['state']:<7}"
+                     f"{row['hb_age_ticks']:>12}  {ms}")
+    return "\n".join(lines)
+
+
+def attach(topology_name: str):
+    with open(plan_path(topology_name)) as f:
+        plan = json.load(f)
+    wksp = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                     create=False)
+    return plan, wksp
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 1
+    name = argv[0]
+    watch = float(argv[argv.index("--watch") + 1]) if "--watch" in argv \
+        else None
+    plan, wksp = attach(name)
+    try:
+        while True:
+            print(format_table(snapshot(plan, wksp)))
+            if watch is None:
+                return 0
+            time.sleep(watch)
+            print()
+    finally:
+        wksp.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,98 @@
+"""Stem: the tile run loop.
+
+Re-expression of the reference's templated run loop
+(ref: src/disco/stem/fd_stem.c:1-168 — housekeeping scheduler, credit
+management, frag polling with overrun detection; :240 run1; :385 the
+main for(;;)). The reference specializes the loop with ~8 compile-time
+callbacks; here a tile object supplies the same seams as methods:
+
+  poll_once() -> int      frags consumed this iteration (0 = idle)
+  housekeeping()          optional, called at the lazy interval
+  metrics_items() -> dict optional, name -> int, flushed to shm metrics
+  on_halt()               optional, called once on exit
+
+The stem owns what every tile shares: cnc lifecycle (BOOT -> RUN ->
+HALT/FAIL), heartbeating, the lazy housekeeping interval with jitter
+(ref: fd_stem.c housekeeping randomization — avoids thundering herds),
+consumer-side fseq publication (so upstream producers can credit-gate),
+and flushing tile metrics into the shared-memory metrics region the
+monitor reads (ref: src/disco/metrics/fd_metrics.h:6-40).
+"""
+from __future__ import annotations
+
+import random
+import time
+
+from ..runtime import CNC_RUN, CNC_HALT, CNC_FAIL
+
+
+class Stem:
+    def __init__(self, ctx, tile, hk_interval_s: float = 0.01,
+                 idle_sleep_s: float = 20e-6):
+        """ctx: TileCtx (cnc/metrics/fseqs); tile: the callback object."""
+        self.ctx, self.tile = ctx, tile
+        self.hk_interval_s = hk_interval_s
+        self.idle_sleep_s = idle_sleep_s
+        self._metrics_names: list[str] | None = None
+
+    def _flush_metrics(self):
+        items = getattr(self.tile, "metrics_items", None)
+        if items is None:
+            return
+        d = items()
+        if self._metrics_names is None:
+            self._metrics_names = list(d.keys())
+        view = self.ctx.metrics_view()
+        for i, k in enumerate(self._metrics_names):
+            if i >= len(view):
+                break
+            view[i] = d.get(k, 0)
+
+    def _update_in_fseqs(self):
+        """Publish consumer progress so upstream producers see credits."""
+        seqs = getattr(self.tile, "in_seqs", None)
+        if seqs is None:
+            return
+        for ln, fs in self.ctx.in_fseqs.items():
+            if ln in seqs():
+                fs.update(seqs()[ln])
+
+    def run(self, max_iters: int | None = None):
+        cnc = self.ctx.cnc
+        cnc.heartbeat()
+        cnc.state = CNC_RUN
+        # jittered lazy interval: same reasoning as the reference's
+        # randomized housekeeping (fd_stem.c — avoid phase-locking tiles)
+        next_hk = 0.0
+        iters = 0
+        try:
+            while True:
+                now = time.perf_counter()
+                if now >= next_hk:
+                    cnc.heartbeat()
+                    if cnc.state == CNC_HALT:
+                        break
+                    self._update_in_fseqs()
+                    hk = getattr(self.tile, "housekeeping", None)
+                    if hk is not None:
+                        hk()
+                    self._flush_metrics()
+                    next_hk = now + self.hk_interval_s * (
+                        0.7 + 0.6 * random.random())
+                n = self.tile.poll_once()
+                if not n:
+                    time.sleep(self.idle_sleep_s)
+                iters += 1
+                if max_iters is not None and iters >= max_iters:
+                    break
+        except Exception:
+            cnc.state = CNC_FAIL
+            self._flush_metrics()
+            raise
+        # drain-side bookkeeping before exit
+        self._update_in_fseqs()
+        self._flush_metrics()
+        on_halt = getattr(self.tile, "on_halt", None)
+        if on_halt is not None:
+            on_halt()
+        cnc.state = CNC_HALT

@@ -1,0 +1,209 @@
+"""Tile adapters + registry: kind string -> runnable tile object.
+
+The reference's equivalent is the fd_topo_run_tile_t vtable each tile
+exports (ref: src/disco/topo/fd_topo.h:664-684) and the main()-side
+registry of tiles (ref: src/app/fdctl/main.c:20-117). An adapter is
+constructed inside the tile process from (TileCtx, args) and supplies
+the stem callbacks (poll_once / housekeeping / metrics_items / in_seqs).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..protocol.txn import MTU
+
+REGISTRY: dict[str, type] = {}
+
+
+def register(kind: str):
+    def deco(cls):
+        REGISTRY[kind] = cls
+        cls.kind = kind
+        return cls
+    return deco
+
+
+def _single(d: dict, what: str, tile: str):
+    if len(d) != 1:
+        raise ValueError(f"tile {tile}: expected exactly one {what}, "
+                        f"got {list(d)}")
+    return next(iter(d.values()))
+
+
+def _setup_jax():
+    """Per-process jax config for device-using tiles: honor the test
+    harness's platform override and share the persistent compile cache."""
+    import jax
+    plat = os.environ.get("FDTPU_JAX_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    cache = os.environ.get(
+        "FDTPU_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+@register("synth")
+class SynthAdapter:
+    """Load generator (the reference's benchg tile,
+    ref: src/app/shared_dev/commands/bench/fd_benchg_tile.c).
+    args: count (total txns), seed, burst."""
+
+    METRICS = ["tx", "backpressure"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.synth import make_signed_txns
+        self.ctx = ctx
+        self.count = int(args.get("count", 1024))
+        self.burst = int(args.get("burst", 32))
+        n_unique = min(self.count, int(args.get("unique", 64)))
+        self.txns = make_signed_txns(n_unique, seed=int(args.get("seed", 0)))
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.fseqs = _single(ctx.out_fseqs, "out link", ctx.tile_name)
+        self.sent = 0
+        self.bp = 0
+
+    def poll_once(self) -> int:
+        if self.sent >= self.count:
+            return 0
+        n = 0
+        while n < self.burst and self.sent < self.count:
+            if self.fseqs and self.out.credits(self.fseqs) <= 0:
+                self.bp += 1
+                break
+            t = self.txns[self.sent % len(self.txns)]
+            self.out.publish(t, sig=self.sent)
+            self.sent += 1
+            n += 1
+        return n
+
+    def metrics_items(self):
+        return {"tx": self.sent, "backpressure": self.bp}
+
+
+@register("verify")
+class VerifyAdapter:
+    """TPU sigverify bridge tile (ref: src/disco/verify/fd_verify_tile.h).
+    args: batch, max_len, tcache (name)."""
+
+    METRICS = ["rx", "parse_fail", "dedup_drop", "verify_fail", "tx",
+               "overruns", "batches", "backpressure"]
+
+    def __init__(self, ctx, args):
+        _setup_jax()
+        from ..tiles.verify import VerifyTile
+        self.ctx = ctx
+        in_ring = _single(ctx.in_rings, "in link", ctx.tile_name)
+        out_ring = _single(ctx.out_rings, "out link", ctx.tile_name)
+        tc_name = args.get("tcache")
+        tc = ctx.tcaches[tc_name] if tc_name \
+            else _single(ctx.tcaches, "tcache", ctx.tile_name)
+        seed = bytes.fromhex(ctx.plan["seed"]) if "seed" in ctx.plan \
+            else None
+        self.tile = VerifyTile(
+            in_ring, out_ring, tc,
+            batch=int(args.get("batch", 256)),
+            max_len=int(args.get("max_len", MTU)),
+            out_fseqs=_single(ctx.out_fseqs, "out link", ctx.tile_name),
+            dedup_seed=seed)
+        self.tile._cnc = ctx.cnc
+        self.in_link = next(iter(ctx.in_rings))
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def in_seqs(self):
+        return {self.in_link: self.tile.seq}
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
+@register("dedup")
+class DedupAdapter:
+    """Global dedup stage across verify outs
+    (ref: src/disco/dedup/fd_dedup_tile.c:9-20 — one tcache over all
+    verify tile outputs; tags were computed upstream with the shared
+    per-boot seed, carried in the frag sig field).
+    args: tcache (name), batch."""
+
+    METRICS = ["rx", "dup", "tx", "overruns", "backpressure"]
+
+    def __init__(self, ctx, args):
+        self.ctx = ctx
+        self.batch = int(args.get("batch", 64))
+        tc_name = args.get("tcache")
+        self.tcache = ctx.tcaches[tc_name] if tc_name \
+            else _single(ctx.tcaches, "tcache", ctx.tile_name)
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.out_fseqs = _single(ctx.out_fseqs, "out link", ctx.tile_name)
+        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.mtu = max(ctx.plan["links"][ln]["mtu"] for ln in ctx.in_rings)
+        self.m = {k: 0 for k in self.METRICS}
+
+    def poll_once(self) -> int:
+        total = 0
+        for ln, ring in self.ctx.in_rings.items():
+            n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
+                self.seqs[ln], self.batch, self.mtu)
+            self.m["overruns"] += ovr
+            if not n:
+                continue
+            total += n
+            self.m["rx"] += n
+            for i in range(n):
+                if self.tcache.insert(int(sigs[i])):
+                    self.m["dup"] += 1
+                    continue
+                while self.out_fseqs and \
+                        self.out.credits(self.out_fseqs) <= 0:
+                    self.m["backpressure"] += 1
+                    time.sleep(20e-6)
+                self.out.publish(buf[i, :sizes[i]], sig=int(sigs[i]))
+                self.m["tx"] += 1
+        return total
+
+    def in_seqs(self):
+        return dict(self.seqs)
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("sink")
+class SinkAdapter:
+    """Terminal consumer: counts frags (the reference's bencho TPS
+    observer, ref: src/app/shared_dev/commands/bench/fd_bencho_tile.c).
+    args: batch."""
+
+    METRICS = ["rx", "bytes", "overruns"]
+
+    def __init__(self, ctx, args):
+        self.ctx = ctx
+        self.batch = int(args.get("batch", 64))
+        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.mtu = max(ctx.plan["links"][ln]["mtu"] for ln in ctx.in_rings)
+        self.m = {k: 0 for k in self.METRICS}
+
+    def poll_once(self) -> int:
+        total = 0
+        for ln, ring in self.ctx.in_rings.items():
+            n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
+                self.seqs[ln], self.batch, self.mtu)
+            self.m["overruns"] += ovr
+            if n:
+                total += n
+                self.m["rx"] += n
+                self.m["bytes"] += int(np.sum(sizes[:n]))
+        return total
+
+    def in_seqs(self):
+        return dict(self.seqs)
+
+    def metrics_items(self):
+        return dict(self.m)

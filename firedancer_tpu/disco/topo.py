@@ -1,0 +1,222 @@
+"""Declarative topology: workspaces, links, tiles, objects.
+
+The reference declares its whole dataflow graph up front — workspaces,
+links (mcache+dcache), tiles with in/out link lists, and shared objects —
+then materializes it and launches one process per tile
+(ref: src/disco/topo/fd_topo.h:36-662 — fd_topo_t model;
+src/disco/topo/fd_topob.h — builder; src/app/fdctl/topology.c:88-254 —
+a concrete topology description).
+
+Here the model is plain data: `Topology` is the builder; `build()`
+materializes every object into one shared-memory workspace and returns a
+picklable `plan` dict of offsets — the inter-process ABI. Tile processes
+receive (plan, tile_name), join the workspace with create=False, and
+reconstruct their rings/fseqs/cnc/metrics views from offsets alone
+(gaddr discipline, ref: src/util/wksp/fd_wksp.h:27-47).
+
+Reliability: a tile input declared reliable gets an fseq; the upstream
+link's producer credit-gates on every reliable consumer's fseq
+(ref: src/tango/fctl/fd_fctl.h:4-10). Unreliable consumers are never
+waited on and must tolerate overruns.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..runtime import Workspace, Ring, Fseq, Cnc, Tcache, lib
+
+METRICS_SLOTS = 64          # u64 counters per tile
+
+
+@dataclass
+class LinkSpec:
+    name: str
+    depth: int
+    mtu: int
+
+
+@dataclass
+class TileSpec:
+    name: str
+    kind: str
+    ins: list[dict] = field(default_factory=list)   # {link, reliable}
+    outs: list[str] = field(default_factory=list)
+    args: dict = field(default_factory=dict)
+
+
+class Topology:
+    """Builder. Declare links/tiles/objects, then build() into a wksp."""
+
+    def __init__(self, name: str, wksp_size: int = 1 << 26):
+        self.name = name
+        self.wksp_size = wksp_size
+        self.links: dict[str, LinkSpec] = {}
+        self.tiles: dict[str, TileSpec] = {}
+        self.tcaches: dict[str, int] = {}           # name -> depth
+
+    def link(self, name: str, depth: int = 128, mtu: int = 1280):
+        if name in self.links:
+            raise ValueError(f"duplicate link {name}")
+        self.links[name] = LinkSpec(name, depth, mtu)
+        return self
+
+    def tile(self, name: str, kind: str, ins=(), outs=(), **args):
+        """ins: link names (reliable) or (link, False) for unreliable."""
+        if name in self.tiles:
+            raise ValueError(f"duplicate tile {name}")
+        norm = []
+        for i in ins:
+            if isinstance(i, str):
+                norm.append({"link": i, "reliable": True})
+            else:
+                norm.append({"link": i[0], "reliable": bool(i[1])})
+        self.tiles[name] = TileSpec(name, kind, norm, list(outs), args)
+        return self
+
+    def tcache(self, name: str, depth: int = 4096):
+        self.tcaches[name] = depth
+        return self
+
+    def _validate(self):
+        producers: dict[str, str] = {}
+        consumed: set[str] = set()
+        for t in self.tiles.values():
+            for ln in t.outs:
+                if ln not in self.links:
+                    raise ValueError(f"tile {t.name}: unknown out link {ln}")
+                if ln in producers:
+                    raise ValueError(
+                        f"link {ln} has two producers "
+                        f"({producers[ln]}, {t.name}) — links are SPMC")
+                producers[ln] = t.name
+            for i in t.ins:
+                if i["link"] not in self.links:
+                    raise ValueError(
+                        f"tile {t.name}: unknown in link {i['link']}")
+                consumed.add(i["link"])
+        for ln in self.links:
+            if ln not in producers:
+                raise ValueError(f"link {ln} has no producer")
+            if ln not in consumed:
+                raise ValueError(f"link {ln} has no consumer")
+
+    def build(self, wksp_name: str | None = None) -> dict:
+        """Materialize into a fresh workspace; return the picklable plan.
+
+        The caller is the single creator (replace mode); every tile
+        process joins with create=False.
+        """
+        self._validate()
+        import os
+        wksp_name = wksp_name or f"/fdtpu_{self.name}"
+        w = Workspace(wksp_name, self.wksp_size)
+        plan: dict = {
+            "topology": self.name,
+            "wksp": {"name": wksp_name, "size": self.wksp_size},
+            # per-boot seed shared by verify (tag computation) and dedup
+            # (ref: verify/dedup share hashmap_seed via topology)
+            "seed": os.urandom(16).hex(),
+            "links": {}, "fseqs": {}, "tcaches": {}, "tiles": {},
+        }
+        try:
+            for ln, spec in self.links.items():
+                r = Ring.create(w, depth=spec.depth, mtu=spec.mtu)
+                plan["links"][ln] = {
+                    "ring_off": r.off, "arena_off": r.arena_off,
+                    "depth": spec.depth, "mtu": r.mtu,
+                }
+            for name, depth in self.tcaches.items():
+                tc = Tcache(w, depth=depth)
+                plan["tcaches"][name] = {"off": tc.off, "depth": depth}
+            for tn, t in self.tiles.items():
+                for i in t.ins:
+                    if i["reliable"]:
+                        fs = Fseq(w)
+                        plan["fseqs"][f"{i['link']}:{tn}"] = fs.off
+                cnc = Cnc(w)
+                metrics_off = w.alloc(METRICS_SLOTS * 8)
+                w.view(metrics_off, METRICS_SLOTS * 8)[:] = 0
+                plan["tiles"][tn] = {
+                    "kind": t.kind,
+                    "ins": list(t.ins),
+                    "outs": list(t.outs),
+                    "args": dict(t.args),
+                    "cnc_off": cnc.off,
+                    "metrics_off": metrics_off,
+                }
+        except Exception:
+            w.close()
+            w.unlink()
+            raise
+        w.close()
+        return plan
+
+
+# ---------------------------------------------------------------------------
+# plan-side join helpers (used inside tile processes and by the monitor)
+# ---------------------------------------------------------------------------
+
+class TileCtx:
+    """A tile process's materialized view of the plan: joined workspace,
+    in/out rings, fseqs (own consumer fseqs + downstream reliable fseqs
+    for each out link), cnc and metrics."""
+
+    def __init__(self, plan: dict, tile_name: str):
+        self.plan, self.tile_name = plan, tile_name
+        self.spec = plan["tiles"][tile_name]
+        self.wksp = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                              create=False)
+        self.cnc = Cnc(self.wksp, off=self.spec["cnc_off"])
+        self.metrics_off = self.spec["metrics_off"]
+
+        def ring(ln):
+            li = plan["links"][ln]
+            return Ring(self.wksp, li["ring_off"], li["depth"],
+                        li["arena_off"], li["mtu"])
+
+        self.in_rings = {}
+        self.in_fseqs = {}
+        for i in self.spec["ins"]:
+            ln = i["link"]
+            self.in_rings[ln] = ring(ln)
+            key = f"{ln}:{tile_name}"
+            if i["reliable"] and key in plan["fseqs"]:
+                self.in_fseqs[ln] = Fseq(self.wksp, off=plan["fseqs"][key])
+
+        self.out_rings = {}
+        self.out_fseqs = {}
+        for ln in self.spec["outs"]:
+            self.out_rings[ln] = ring(ln)
+            fseqs = []
+            for key, off in plan["fseqs"].items():
+                if key.split(":", 1)[0] == ln:
+                    fseqs.append(Fseq(self.wksp, off=off))
+            self.out_fseqs[ln] = fseqs
+
+        self.tcaches = {
+            name: Tcache(self.wksp, depth=tc["depth"], off=tc["off"])
+            for name, tc in plan["tcaches"].items()
+        }
+
+    def metrics_view(self):
+        import numpy as np
+        return self.wksp.view(self.metrics_off, METRICS_SLOTS * 8) \
+            .view(np.uint64)
+
+    def close(self):
+        self.wksp.close()
+
+
+def read_metrics(wksp: Workspace, plan: dict, tile_name: str):
+    import numpy as np
+    off = plan["tiles"][tile_name]["metrics_off"]
+    return wksp.view(off, METRICS_SLOTS * 8).view(np.uint64).copy()
+
+
+def read_heartbeat(wksp: Workspace, plan: dict, tile_name: str) -> int:
+    cnc = Cnc(wksp, off=plan["tiles"][tile_name]["cnc_off"])
+    return cnc.last_heartbeat
+
+
+def now_ticks() -> int:
+    return lib.fdtpu_ticks()

@@ -124,6 +124,10 @@ class Workspace:
     def unlink(self):
         lib.fdtpu_wksp_unlink(self.name.encode())
 
+    @staticmethod
+    def unlink_name(name: str):
+        lib.fdtpu_wksp_unlink(name.encode())
+
 
 class Ring:
     """Single-producer frag ring + payload arena inside a workspace."""
