@@ -9,6 +9,7 @@ the stem callbacks (poll_once / housekeeping / metrics_items / in_seqs).
 from __future__ import annotations
 
 import os
+import struct
 import time
 
 import numpy as np
@@ -170,6 +171,165 @@ class DedupAdapter:
 
     def in_seqs(self):
         return dict(self.seqs)
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("pack")
+class PackAdapter:
+    """Leader scheduler tile (ref: src/disco/pack/fd_pack_tile.c):
+    inserts txns from the dedup stage, emits non-conflicting
+    microblocks to parallel bank tiles, retires account locks on bank
+    completion frags.
+
+    Microblock wire format (one frag): u16 bank | u16 txn_cnt |
+    u64 microblock_id | (u16 len | payload)*.
+    Completion frag: u64 microblock_id (per-bank dedicated link).
+
+    args: txn_in (link), bank_links (ordered list), done_links (ordered
+    list, one per bank), max_txn_per_microblock, slot_ms (block timer —
+    the poh slot-boundary analog; fd_pack_end_block per slot)."""
+
+    METRICS = ["rx", "parse_fail", "inserted", "scheduled", "microblocks",
+               "completions", "blocks", "backpressure", "overruns"]
+
+    def __init__(self, ctx, args):
+        from ..pack import PackScheduler, PackLimits
+        from ..pack.scheduler import meta_from_payload
+        self._meta_from_payload = meta_from_payload
+        self.ctx = ctx
+        self.txn_in = args["txn_in"]
+        self.bank_links = list(args["bank_links"])
+        self.done_links = list(args["done_links"])
+        assert len(self.bank_links) == len(self.done_links)
+        n_banks = len(self.bank_links)
+        mtu = min(ctx.plan["links"][ln]["mtu"] for ln in self.bank_links)
+        self.sched = PackScheduler(
+            bank_cnt=n_banks,
+            limits=PackLimits(
+                max_txn_per_microblock=int(
+                    args.get("max_txn_per_microblock", 31)),
+                max_data_bytes_per_microblock=mtu - 12))
+        self.slot_ms = float(args.get("slot_ms", 400.0))
+        self._slot_t0 = time.monotonic()
+        self.batch = int(args.get("batch", 64))
+        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.in_mtu = ctx.plan["links"][self.txn_in]["mtu"]
+        self.busy = [None] * n_banks      # outstanding microblock id
+        self._next_mb = 0
+        self.m = {k: 0 for k in self.METRICS}
+
+    def _serialize(self, bank: int, mb_id: int, metas) -> bytes:
+        out = bytearray(struct.pack("<HHQ", bank, len(metas), mb_id))
+        for m in metas:
+            out += struct.pack("<H", len(m.payload)) + m.payload
+        return bytes(out)
+
+    def poll_once(self) -> int:
+        total = 0
+        # 1) retire completions (frees account locks first — matches the
+        # reference's poll order so banks never starve)
+        for bank, ln in enumerate(self.done_links):
+            ring = self.ctx.in_rings[ln]
+            n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
+                self.seqs[ln], self.batch, 64)
+            self.m["overruns"] += ovr
+            for i in range(n):
+                mb_id = int(sigs[i])
+                if self.busy[bank] == mb_id:
+                    self.sched.microblock_done(bank)
+                    self.busy[bank] = None
+                    self.m["completions"] += 1
+            total += n
+        # 2) ingest new txns
+        ring = self.ctx.in_rings[self.txn_in]
+        n, self.seqs[self.txn_in], buf, sizes, sigs, ovr = ring.gather(
+            self.seqs[self.txn_in], self.batch, self.in_mtu)
+        self.m["overruns"] += ovr
+        for i in range(n):
+            try:
+                self.sched.insert(
+                    self._meta_from_payload(bytes(buf[i, :sizes[i]])))
+                self.m["inserted"] += 1
+            except Exception:
+                self.m["parse_fail"] += 1
+        self.m["rx"] += n
+        total += n
+        # 3) fill idle banks
+        for bank, ln in enumerate(self.bank_links):
+            if self.busy[bank] is not None:
+                continue
+            out = self.ctx.out_rings[ln]
+            fseqs = self.ctx.out_fseqs[ln]
+            if fseqs and out.credits(fseqs) <= 0:
+                self.m["backpressure"] += 1
+                continue
+            metas = self.sched.schedule_microblock(bank)
+            if not metas:
+                continue
+            mb_id = self._next_mb
+            self._next_mb += 1
+            out.publish(self._serialize(bank, mb_id, metas), sig=mb_id)
+            self.busy[bank] = mb_id
+            self.m["scheduled"] += len(metas)
+            self.m["microblocks"] += 1
+            total += 1
+        return total
+
+    def housekeeping(self):
+        # slot boundary: reset per-block cost budgets
+        if (time.monotonic() - self._slot_t0) * 1e3 >= self.slot_ms:
+            self.sched.end_block()
+            self._slot_t0 = time.monotonic()
+            self.m["blocks"] += 1
+
+    def in_seqs(self):
+        return dict(self.seqs)
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("bank")
+class BankAdapter:
+    """Execution stage stub (ref: src/discoh/bank/fd_bank_tile.c shape:
+    consume microblock, execute, emit completion): parses the microblock
+    frame, counts transactions, acknowledges on its completion link.
+    The real SVM executor slots in here.
+    args: in link = pack_bank*, out link = done link back to pack."""
+
+    METRICS = ["microblocks", "txns", "overruns"]
+
+    def __init__(self, ctx, args):
+        self.ctx = ctx
+        if len(ctx.in_rings) != 1:
+            raise ValueError(f"bank tile {ctx.tile_name}: one in link")
+        self.in_link = next(iter(ctx.in_rings))
+        self.ring = ctx.in_rings[self.in_link]
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.out_fseqs = _single(ctx.out_fseqs, "out link", ctx.tile_name)
+        self.seq = 0
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+        self.m = {k: 0 for k in self.METRICS}
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, 8, self.mtu)
+        self.m["overruns"] += ovr
+        for i in range(n):
+            bank, txn_cnt, mb_id = struct.unpack_from("<HHQ", buf[i], 0)
+            # execution stub: account txns; real runtime goes here
+            self.m["txns"] += txn_cnt
+            self.m["microblocks"] += 1
+            while self.out_fseqs and \
+                    self.out.credits(self.out_fseqs) <= 0:
+                time.sleep(20e-6)
+            self.out.publish(struct.pack("<Q", mb_id), sig=mb_id)
+        return n
+
+    def in_seqs(self):
+        return {self.in_link: self.seq}
 
     def metrics_items(self):
         return dict(self.m)

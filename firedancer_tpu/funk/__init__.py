@@ -1,0 +1,7 @@
+"""funk: fork-aware key-value store (prepare / publish / cancel).
+
+Re-expression of the reference's funk database
+(ref: src/funk/fd_funk.h:4-90 — record table + in-preparation
+transaction tree; src/funk/fd_funk_txn.h — fork management APIs).
+"""
+from .funk import Funk, FunkTxnError  # noqa: F401

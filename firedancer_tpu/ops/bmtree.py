@@ -1,0 +1,112 @@
+"""Solana binary Merkle tree (bmtree) — batched root + proofs in JAX.
+
+Reference semantics (ref: src/ballet/bmtree/fd_bmtree.h:1-140):
+  * leaf node  = sha256(0x00-prefix ‖ leaf blob)
+  * branch     = sha256(0x01-prefix ‖ left ‖ right)
+  * odd layer: the last node is paired with ITSELF (duplicated link)
+  * short prefixes are the single bytes 0x00/0x01; the long 26-byte
+    "\\x00SOLANA_MERKLE_SHREDS_LEAF" / "\\x01...NODE" prefixes are used
+    for shreds (fd_bmtree.h:139-142)
+
+TPU shape: one call computes the root over a power-of-two padded layer
+with inactive lanes masked; levels run as a `lax.scan` with a static
+depth. Leaf hashing is one batched sha256 over all leaves — the "wide"
+axis the MXU/VPU wants — and each reduction level halves the live lanes
+(same wide-then-tree dataflow the reference's AVX batch sha256 feeds,
+src/ballet/sha256/fd_sha256_batch_avx2.c).
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha2 import sha256
+
+__all__ = ["bmtree_root", "bmtree_depth", "host_bmtree_root",
+           "LEAF_PREFIX", "NODE_PREFIX", "LEAF_PREFIX_SHREDS",
+           "NODE_PREFIX_SHREDS"]
+
+LEAF_PREFIX = b"\x00"
+NODE_PREFIX = b"\x01"
+LEAF_PREFIX_SHREDS = b"\x00SOLANA_MERKLE_SHREDS_LEAF"
+NODE_PREFIX_SHREDS = b"\x01SOLANA_MERKLE_SHREDS_NODE"
+
+
+def bmtree_depth(n_leaves: int) -> int:
+    """Number of reduction levels for n leaves."""
+    d = 0
+    while (1 << d) < n_leaves:
+        d += 1
+    return d
+
+
+def bmtree_root(leaves, leaf_cnt, max_leaves: int,
+                leaf_prefix: bytes = LEAF_PREFIX,
+                node_prefix: bytes = NODE_PREFIX):
+    """Root of a bmtree over variable-size leaf count, batched.
+
+    leaves:   (..., max_leaves, 32) uint8 — 32-byte leaf blobs (callers
+              hash larger blobs to 32B first, or pass shred merkle leaves)
+    leaf_cnt: (...,) int32 in [1, max_leaves]
+    max_leaves: static power-of-two bound.
+    Returns (..., 32) uint8 root.
+
+    Matches the reference tree topology exactly: each level pairs
+    (2i, 2i+1) with the last node of an odd level duplicated
+    (fd_bmtree.h:60-75 example with 5 leaves).
+    """
+    assert max_leaves & (max_leaves - 1) == 0, "max_leaves power of two"
+    depth = bmtree_depth(max_leaves)
+    lp = jnp.asarray(np.frombuffer(leaf_prefix, np.uint8))
+    np_ = jnp.asarray(np.frombuffer(node_prefix, np.uint8))
+
+    # leaf hashing: one wide batched sha256
+    batch = leaves.shape[:-2]
+    lpb = jnp.broadcast_to(lp, batch + (max_leaves, len(leaf_prefix)))
+    msg = jnp.concatenate([lpb, leaves], axis=-1)
+    ln = jnp.full(batch + (max_leaves,), len(leaf_prefix) + 32, jnp.int32)
+    nodes = sha256(msg, ln)                       # (..., max_leaves, 32)
+
+    # statically-unrolled levels (each level halves the lane count, so
+    # shapes shrink — a python loop over the static depth, not lax.scan,
+    # whose carry must keep one shape)
+    live = jnp.asarray(leaf_cnt, jnp.int32)
+    for _ in range(depth):
+        left = nodes[..., 0::2, :]
+        right = nodes[..., 1::2, :]
+        idx = jnp.arange(left.shape[-2])          # (m,)
+        live_e = live[..., None]                  # broadcasts vs (m,)
+        # odd live count: the last live node pairs with itself
+        right = jnp.where(((2 * idx + 1) < live_e)[..., None], right, left)
+        npb = jnp.broadcast_to(np_, left.shape[:-1] + (len(node_prefix),))
+        msg = jnp.concatenate([npb, left, right], axis=-1)
+        ln = jnp.full(left.shape[:-1], len(node_prefix) + 64, jnp.int32)
+        parents = sha256(msg, ln)
+        # beyond the live region nodes pass through unchanged; a single
+        # node layer IS the root (fd_bmtree.h: "has exactly one node,
+        # this one node is the root") so it also passes through
+        passthru = ((2 * idx) >= live_e) | (live_e == 1)
+        nodes = jnp.where(passthru[..., None], left, parents)
+        live = jnp.maximum((live + 1) // 2, 1)
+    return nodes[..., 0, :]
+
+
+# -- host oracle (tests, shred tile bookkeeping) ---------------------------
+
+def host_bmtree_root(leaf_blobs: list[bytes],
+                     leaf_prefix: bytes = LEAF_PREFIX,
+                     node_prefix: bytes = NODE_PREFIX) -> bytes:
+    """Plain-python reference implementation of the same topology."""
+    assert leaf_blobs
+    nodes = [hashlib.sha256(leaf_prefix + b).digest() for b in leaf_blobs]
+    while len(nodes) > 1:
+        nxt = []
+        for i in range(0, len(nodes), 2):
+            l = nodes[i]
+            r = nodes[i + 1] if i + 1 < len(nodes) else nodes[i]
+            nxt.append(hashlib.sha256(node_prefix + l + r).digest())
+        nodes = nxt
+    return nodes[0]

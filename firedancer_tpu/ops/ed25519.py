@@ -291,20 +291,99 @@ def _host_affine(p):
     return (x * zi % P, y * zi % P)
 
 
+SQRT_M1_INT = pow(2, (P - 1) // 4, P)
+
+
+def _host_sqrt_ratio(u: int, v: int):
+    """x with v x^2 = u (mod p), or None."""
+    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    if v * x * x % P == u % P:
+        return x
+    x = x * SQRT_M1_INT % P
+    if v * x * x % P == (u % P):
+        return x
+    return None
+
+
 def _basepoint():
     by = 4 * pow(5, P - 2, P) % P
     # recover even x from the curve equation
     d = -121665 * pow(121666, P - 2, P) % P
-    u = (by * by - 1) % P
-    v = (d * by * by + 1) % P
-    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
-    if v * x * x % P != u:
-        x = x * pow(2, (P - 1) // 4, P) % P
+    x = _host_sqrt_ratio((by * by - 1) % P, (d * by * by + 1) % P)
+    assert x is not None
     if x % 2 != 0:
         x = P - x
     return (x, by)
 
 BASEPOINT = _basepoint()
+
+
+@functools.lru_cache(maxsize=None)
+def _small_order_encodings() -> np.ndarray:
+    """(n, 32) uint8: every 32-byte string that decodes (RFC 8032 rules)
+    to a point of the 8-torsion subgroup.
+
+    The reference rejects signatures whose A or R is small order
+    (verify_strict; ref: src/ballet/ed25519/fd_ed25519_user.c:195-201
+    fd_ed25519_affine_is_small_order) — matching ed25519-dalek's
+    VerifyingKey::verify_strict, the rule Solana consensus applies.
+    Instead of paying a second batched decompression for R, membership
+    in this precomputed encoding set is an exact equivalent: an encoding
+    is small order iff its decoded (y mod p, sign) hits the torsion
+    subgroup, and the set of such encodings (canonical y, plus y+p when
+    y < 19 fits below 2^255, for each sign) is tiny and static.
+    """
+    d_int = -121665 * pow(121666, P - 2, P) % P
+    # find a point of order exactly 8: clear the prime factor from a
+    # random curve point Q -> T = [l]Q has order dividing 8
+    l = L
+
+    def host_mul(k: int, pt):
+        acc = (0, 1, 1, 0)
+        add = pt
+        while k:
+            if k & 1:
+                acc = _host_pt_add(acc, add)
+            add = _host_pt_add(add, add)
+            k >>= 1
+        return acc
+
+    torsion = None
+    for y in range(2, 200):
+        u = (y * y - 1) % P
+        v = (d_int * y * y + 1) % P
+        x = _host_sqrt_ratio(u, v)
+        if x is None:
+            continue
+        q = (x, y, 1, x * y % P)
+        t = host_mul(l, q)
+        # order of t divides 8; need exactly 8
+        t2 = _host_pt_add(t, t)
+        t4 = _host_pt_add(t2, t2)
+        ax4, ay4 = _host_affine(t4)
+        if (ax4, ay4) != (0, 1):            # order 8: [4]T != identity
+            torsion = t
+            break
+    assert torsion is not None
+    encs = set()
+    pt = (0, 1, 1, 0)
+    for _ in range(8):
+        ax, ay = _host_affine(pt)
+        for yy in ([ay, ay + P] if ay < 19 else [ay]):
+            for sign in ([0, 1] if ax != 0 else [0]):
+                encs.add((yy | (sign << 255)).to_bytes(32, "little"))
+        pt = _host_pt_add(pt, torsion)
+    out = np.zeros((len(encs), 32), np.uint8)
+    for i, e in enumerate(sorted(encs)):
+        out[i] = np.frombuffer(e, np.uint8)
+    return out
+
+
+def is_small_order_encoding(b):
+    """(..., 32) uint8 -> (...,) bool: encodes an 8-torsion point."""
+    tab = jnp.asarray(_small_order_encodings())      # (n, 32)
+    eq = jnp.all(b[..., None, :] == tab, axis=-1)    # (..., n)
+    return jnp.any(eq, axis=-1)
 
 
 @functools.lru_cache(maxsize=None)
@@ -383,6 +462,12 @@ def verify_batch(sig, pub, msg, msg_len):
 
     s_digits, s_ok = sc_from_bytes32(s_bytes)
     a_pt, a_ok = decompress(pub)
+    # verify_strict: small-order A or R rejected (ref:
+    # fd_ed25519_user.c:195-201; see is_small_order_encoding). R needs
+    # no decompression: non-decodable or non-canonical R already fails
+    # the byte compare below, so the encoding-set test is exact.
+    a_ok = a_ok & ~is_small_order_encoding(pub)
+    r_ok = ~is_small_order_encoding(r_bytes)
 
     # k = SHA-512(R ‖ A ‖ msg) mod l
     kmsg = jnp.concatenate([r_bytes, pub, msg], axis=-1)
@@ -391,4 +476,4 @@ def verify_batch(sig, pub, msg, msg_len):
     rprime = _double_scalar_mul(
         sc_windows4(s_digits), sc_windows4(k_digits), pt_neg(a_pt))
     match = jnp.all(pt_tobytes(rprime) == r_bytes, axis=-1)
-    return s_ok & a_ok & match
+    return s_ok & a_ok & r_ok & match

@@ -1,0 +1,128 @@
+"""Proof-of-History hash chain: batched verification + host generation.
+
+Reference semantics (ref: src/ballet/poh/fd_poh.c — fd_poh_append is n
+repeated SHA-256's of the 32-byte state; fd_poh_mixin is one SHA-256
+over state ‖ mixin):
+
+  append(state, n):  state <- sha256^n(state)
+  mixin(state, m):   state <- sha256(state ‖ m)
+
+Generation is inherently sequential (that's the point of PoH), so the
+poh tile generates on host. VERIFICATION is embarrassingly parallel at
+entry granularity — each entry declares (num_hashes, optional mixin) and
+the chain segments can be recomputed independently — which is exactly
+the axis a TPU wants (the reference replays PoH verification across
+cores the same way; here it's one jitted program over the entry batch).
+
+All lanes scan to the max hash count with inactive steps masked, so the
+compiled shape is static (XLA constraint; ref batching discipline
+src/ballet/sha512/fd_sha512_batch_avx512.c — lanes run in lockstep).
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha2 import sha256
+
+__all__ = ["poh_verify_entries", "host_poh_append", "host_poh_mixin",
+           "PohChain"]
+
+
+def _sha256_fixed(msg):
+    """sha256 over a fixed-width (batch, L) message, all lanes full."""
+    ln = jnp.full(msg.shape[:-1], msg.shape[-1], jnp.int32)
+    return sha256(msg, ln)
+
+
+def poh_verify_entries(prev_hash, num_hashes, mixin, has_mixin,
+                       expected, max_hashes: int):
+    """Batched PoH entry verification.
+
+    prev_hash:  (..., 32) uint8 — chain state before the entry
+    num_hashes: (...,) int32 — total hashes in the entry (>= 1)
+    mixin:      (..., 32) uint8 — entry mixin (ignored if not has_mixin)
+    has_mixin:  (...,) bool — tick entries have no mixin
+    expected:   (..., 32) uint8 — declared post-entry chain state
+    max_hashes: static scan bound (consensus: hashes per tick)
+
+    Entry semantics (ref: how the replay stage recomputes each entry):
+    state = sha256^(num_hashes-1)(prev); then if mixin:
+    state = sha256(state ‖ mixin) else state = sha256(state) — i.e.
+    num_hashes total applications, the last one absorbing the mixin if
+    present. Returns (...,) bool.
+    """
+    state = prev_hash.astype(jnp.uint8)
+    n_plain = jnp.where(has_mixin, num_hashes - 1, num_hashes)
+
+    def step(st, i):
+        nxt = _sha256_fixed(st)
+        keep = (i < n_plain)[..., None]
+        return jnp.where(keep, nxt, st), None
+
+    state, _ = jax.lax.scan(step, state, jnp.arange(max_hashes))
+    mixed = _sha256_fixed(jnp.concatenate([state, mixin], axis=-1))
+    final = jnp.where(has_mixin[..., None], mixed, state)
+    return jnp.all(final == expected, axis=-1) & (num_hashes >= 1)
+
+
+# -- host-side generation (the poh tile's inner loop) ----------------------
+
+def host_poh_append(state: bytes, n: int) -> bytes:
+    for _ in range(n):
+        state = hashlib.sha256(state).digest()
+    return state
+
+
+def host_poh_mixin(state: bytes, mixin: bytes) -> bytes:
+    return hashlib.sha256(state + mixin).digest()
+
+
+class PohChain:
+    """Host chain state + entry recorder (the poh tile's bookkeeping,
+    ref: src/discof/poh/fd_poh.h:4-31)."""
+
+    def __init__(self, seed: bytes):
+        assert len(seed) == 32
+        self.state = seed
+        self.entries: list[dict] = []
+
+    def tick(self, num_hashes: int):
+        prev = self.state
+        self.state = host_poh_append(self.state, num_hashes)
+        self.entries.append({
+            "prev": prev, "num_hashes": num_hashes,
+            "mixin": None, "hash": self.state,
+        })
+
+    def record(self, mixin: bytes, num_hashes: int):
+        """num_hashes total, the last absorbs the mixin."""
+        assert num_hashes >= 1
+        prev = self.state
+        st = host_poh_append(self.state, num_hashes - 1)
+        self.state = host_poh_mixin(st, mixin)
+        self.entries.append({
+            "prev": prev, "num_hashes": num_hashes,
+            "mixin": mixin, "hash": self.state,
+        })
+
+    def entry_arrays(self, max_hashes: int):
+        """Pack recorded entries into poh_verify_entries inputs."""
+        n = len(self.entries)
+        prev = np.zeros((n, 32), np.uint8)
+        num = np.zeros((n,), np.int32)
+        mix = np.zeros((n, 32), np.uint8)
+        has = np.zeros((n,), bool)
+        exp = np.zeros((n, 32), np.uint8)
+        for i, e in enumerate(self.entries):
+            assert e["num_hashes"] <= max_hashes
+            prev[i] = np.frombuffer(e["prev"], np.uint8)
+            num[i] = e["num_hashes"]
+            if e["mixin"] is not None:
+                mix[i] = np.frombuffer(e["mixin"], np.uint8)
+                has[i] = True
+            exp[i] = np.frombuffer(e["hash"], np.uint8)
+        return prev, num, mix, has, exp

@@ -1,0 +1,294 @@
+"""Account-conflict-aware microblock scheduler.
+
+Semantics follow the reference's fd_pack:
+
+* Pending transactions are priority-ordered by reward per cost unit
+  (ref: src/disco/pack/fd_pack.c — treap ordered by compare_worker;
+  here a lazy-deletion binary heap, which preserves the schedule order
+  contract without the treap's delete-by-key machinery).
+* A microblock for bank b contains only transactions that do not
+  conflict with any transaction currently outstanding on OTHER banks:
+  write-write and read-write overlaps are conflicts
+  (ref: fd_pack.c:1760 fd_pack_schedule_impl bitset checks).
+* Conflict tests use per-transaction account bitsets. The reference
+  compresses into a fixed 256-bit set with reserve-on-second-reference
+  (fd_pack_bitset.h:1-60) because it needs AVX-width compares; Python
+  arbitrary-precision ints give exact unlimited-width bitsets for free,
+  so every account gets a bit (freed when its refcount drops to zero) —
+  same contract, no false negatives.
+* Consensus cost limits enforced per block: total cost, per-writable-
+  account write cost, vote cost, microblock count/size
+  (ref: src/disco/pack/fd_pack.h:56-101 fd_pack_limits_t).
+
+Cost/reward model (ref: src/disco/pack/fd_pack_cost.h): cost units =
+per-signature + per-writable-lock + execution CUs (compute-budget
+requested, else the default); reward = base fee per signature +
+priority fee. Exact fee math can be swapped in without touching the
+scheduler.
+"""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+
+from ..protocol.txn import ParsedTxn, parse_txn
+
+# consensus-critical defaults (cluster-agreed values; ref:
+# src/disco/pack/fd_pack.h:30-36 — 48M lower bound, 12M per acct)
+MAX_COST_PER_BLOCK = 48_000_000
+MAX_VOTE_COST_PER_BLOCK = 36_000_000
+MAX_WRITE_COST_PER_ACCT = 12_000_000
+
+COST_PER_SIGNATURE = 720          # ref: fd_pack_cost.h
+COST_PER_WRITABLE_ACCT = 300
+DEFAULT_EXEC_CU = 200_000
+FEE_PER_SIGNATURE = 5000          # ref: fd_pack.h:20
+
+
+@dataclass
+class PackLimits:
+    max_cost_per_block: int = MAX_COST_PER_BLOCK
+    max_vote_cost_per_block: int = MAX_VOTE_COST_PER_BLOCK
+    max_write_cost_per_acct: int = MAX_WRITE_COST_PER_ACCT
+    max_txn_per_microblock: int = 31
+    max_microblocks_per_block: int = 16384
+    # cap on serialized microblock bytes (keeps one microblock within a
+    # ring frag MTU; the reference bounds block data bytes for the same
+    # reason at block scale, fd_pack.h max_data_bytes_per_block)
+    max_data_bytes_per_microblock: int = 1 << 20
+    probe_depth: int = 64         # candidates examined per microblock
+
+
+@dataclass
+class TxnMeta:
+    payload: bytes
+    txn: ParsedTxn
+    reward: int                   # lamports to the leader
+    cost: int                     # cost units
+    writes: tuple[bytes, ...]     # writable account keys
+    reads: tuple[bytes, ...]      # readonly account keys
+    is_vote: bool = False
+    seq: int = 0                  # insertion order (priority tiebreak)
+    w_mask: int = 0
+    r_mask: int = 0
+
+
+def txn_cost_and_reward(t: ParsedTxn, payload: bytes) -> tuple[int, int]:
+    """Simplified fd_pack_cost model: signature cost + write-lock cost +
+    execution CU (default; compute-budget parsing can refine)."""
+    n_writable = sum(t.is_writable(i) for i in range(t.acct_cnt))
+    cost = (COST_PER_SIGNATURE * t.sig_cnt
+            + COST_PER_WRITABLE_ACCT * n_writable
+            + DEFAULT_EXEC_CU)
+    reward = FEE_PER_SIGNATURE * t.sig_cnt
+    return cost, reward
+
+
+def meta_from_payload(payload: bytes, seq: int = 0,
+                      reward: int | None = None,
+                      cost: int | None = None) -> TxnMeta:
+    t = parse_txn(payload)
+    keys = t.account_keys(payload)
+    writes = tuple(k for i, k in enumerate(keys) if t.is_writable(i))
+    reads = tuple(k for i, k in enumerate(keys) if not t.is_writable(i))
+    c, r = txn_cost_and_reward(t, payload)
+    return TxnMeta(payload, t, reward if reward is not None else r,
+                   cost if cost is not None else c, writes, reads, seq=seq)
+
+
+class _AcctBits:
+    """account key -> bit index, refcounted; bits freed at refcount 0
+    (the reference frees at 0 too — fd_pack_bitset.h 'defer freeing the
+    bit until the reference count drops to 0')."""
+
+    def __init__(self):
+        self.bits: dict[bytes, int] = {}
+        self.refs: dict[bytes, int] = {}
+        self.free: list[int] = []
+        self.next_bit = 0
+
+    def acquire(self, key: bytes) -> int:
+        if key in self.bits:
+            self.refs[key] += 1
+            return self.bits[key]
+        b = self.free.pop() if self.free else self.next_bit
+        if b == self.next_bit:
+            self.next_bit += 1
+        self.bits[key] = b
+        self.refs[key] = 1
+        return b
+
+    def release(self, key: bytes):
+        self.refs[key] -= 1
+        if self.refs[key] == 0:
+            self.free.append(self.bits.pop(key))
+            del self.refs[key]
+
+
+class PackScheduler:
+    def __init__(self, bank_cnt: int = 4, limits: PackLimits | None = None):
+        self.limits = limits or PackLimits()
+        self.bank_cnt = bank_cnt
+        self._bits = _AcctBits()
+        self._heap: list[tuple[float, int, int]] = []   # (-prio, seq, id)
+        self._pending: dict[int, TxnMeta] = {}
+        self._next_id = 0
+        self._seq = 0
+        # outstanding (in-flight microblock) masks per bank
+        self._out_w = [0] * bank_cnt
+        self._out_r = [0] * bank_cnt
+        self._out_txns: list[list[TxnMeta]] = [[] for _ in range(bank_cnt)]
+        # block accounting
+        self.block_cost = 0
+        self.block_vote_cost = 0
+        self.block_microblocks = 0
+        self._acct_write_cost: dict[bytes, int] = {}
+        self.metrics = {"inserted": 0, "scheduled": 0, "microblocks": 0,
+                        "conflict_skip": 0, "limit_skip": 0}
+
+    # -- insert -----------------------------------------------------------
+
+    def insert(self, meta: TxnMeta) -> int:
+        """Queue a txn; returns its pack id."""
+        meta.seq = self._seq
+        self._seq += 1
+        meta.w_mask = 0
+        meta.r_mask = 0
+        for k in meta.writes:
+            meta.w_mask |= 1 << self._bits.acquire(k)
+        for k in meta.reads:
+            meta.r_mask |= 1 << self._bits.acquire(k)
+        tid = self._next_id
+        self._next_id += 1
+        self._pending[tid] = meta
+        # reward-per-cost priority, FIFO tiebreak (deterministic)
+        heapq.heappush(self._heap, (-meta.reward / max(1, meta.cost),
+                                    meta.seq, tid))
+        self.metrics["inserted"] += 1
+        return tid
+
+    def insert_payload(self, payload: bytes) -> int:
+        return self.insert(meta_from_payload(payload))
+
+    @property
+    def pending_cnt(self) -> int:
+        return len(self._pending)
+
+    # -- schedule ---------------------------------------------------------
+
+    def _conflicts(self, meta: TxnMeta, out_w: int, out_rw: int) -> bool:
+        return bool(meta.w_mask & out_rw) or bool(meta.r_mask & out_w)
+
+    def _block_allows(self, meta: TxnMeta, mb_cost: int,
+                      mb_vote_cost: int, mb_acct_cost: dict) -> bool:
+        if self.block_cost + mb_cost + meta.cost \
+                > self.limits.max_cost_per_block:
+            return False
+        if meta.is_vote and self.block_vote_cost + mb_vote_cost \
+                + meta.cost > self.limits.max_vote_cost_per_block:
+            return False
+        for k in meta.writes:
+            if self._acct_write_cost.get(k, 0) + mb_acct_cost.get(k, 0) \
+                    + meta.cost > self.limits.max_write_cost_per_acct:
+                return False
+        return True
+
+    def schedule_microblock(self, bank: int) -> list[TxnMeta]:
+        """Emit the next microblock for `bank` (empty when nothing
+        schedulable). The caller must signal microblock_done(bank)
+        before asking for another microblock on the same bank.
+        (ref contract: fd_pack.c:2477 schedule_next_microblock)."""
+        assert not self._out_txns[bank], \
+            "previous microblock on this bank not completed"
+        if self.block_microblocks >= self.limits.max_microblocks_per_block:
+            return []
+        out_w = 0
+        out_rw = 0
+        for b in range(self.bank_cnt):
+            if b == bank:
+                continue
+            out_w |= self._out_w[b]
+            out_rw |= self._out_w[b] | self._out_r[b]
+
+        chosen: list[tuple[float, int, int]] = []
+        skipped: list[tuple[float, int, int]] = []
+        mb: list[TxnMeta] = []
+        mb_cost = 0
+        mb_vote_cost = 0
+        mb_acct_cost: dict[bytes, int] = {}
+        mb_bytes = 0
+        mb_w = 0
+        mb_r = 0
+        probes = 0
+        while self._heap and len(mb) < self.limits.max_txn_per_microblock \
+                and probes < self.limits.probe_depth:
+            entry = heapq.heappop(self._heap)
+            tid = entry[2]
+            meta = self._pending.get(tid)
+            if meta is None:
+                continue            # lazily-deleted entry
+            probes += 1
+            # conflicts vs other banks' outstanding AND this microblock
+            if self._conflicts(meta, out_w | mb_w, out_rw | mb_w | mb_r):
+                self.metrics["conflict_skip"] += 1
+                skipped.append(entry)
+                continue
+            if not self._block_allows(meta, mb_cost, mb_vote_cost,
+                                      mb_acct_cost) \
+                    or mb_bytes + 2 + len(meta.payload) \
+                    > self.limits.max_data_bytes_per_microblock:
+                self.metrics["limit_skip"] += 1
+                skipped.append(entry)
+                continue
+            del self._pending[tid]
+            chosen.append(entry)
+            mb.append(meta)
+            mb_cost += meta.cost
+            if meta.is_vote:
+                mb_vote_cost += meta.cost
+            for k in meta.writes:
+                mb_acct_cost[k] = mb_acct_cost.get(k, 0) + meta.cost
+            mb_bytes += 2 + len(meta.payload)
+            mb_w |= meta.w_mask
+            mb_r |= meta.r_mask
+        for entry in skipped:       # retry later
+            heapq.heappush(self._heap, entry)
+
+        if not mb:
+            return []
+        self._out_w[bank] = mb_w
+        self._out_r[bank] = mb_r
+        self._out_txns[bank] = mb
+        self.block_cost += mb_cost
+        self.block_microblocks += 1
+        for m in mb:
+            if m.is_vote:
+                self.block_vote_cost += m.cost
+            for k in m.writes:
+                self._acct_write_cost[k] = \
+                    self._acct_write_cost.get(k, 0) + m.cost
+        self.metrics["scheduled"] += len(mb)
+        self.metrics["microblocks"] += 1
+        return mb
+
+    def microblock_done(self, bank: int):
+        """Bank finished executing its microblock: release account locks
+        (block-level cost accounting is permanent until end_block)."""
+        for m in self._out_txns[bank]:
+            for k in m.writes:
+                self._bits.release(k)
+            for k in m.reads:
+                self._bits.release(k)
+        self._out_txns[bank] = []
+        self._out_w[bank] = 0
+        self._out_r[bank] = 0
+
+    def end_block(self):
+        """Reset per-block accounting (ref: fd_pack_end_block)."""
+        self.block_cost = 0
+        self.block_vote_cost = 0
+        self.block_microblocks = 0
+        self._acct_write_cost.clear()
+
+    def outstanding(self, bank: int) -> list[TxnMeta]:
+        return list(self._out_txns[bank])

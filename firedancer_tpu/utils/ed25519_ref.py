@@ -96,16 +96,28 @@ def sign(seed: bytes, msg: bytes) -> bytes:
     return rb + s.to_bytes(32, "little")
 
 
+def is_small_order(p) -> bool:
+    """[8]P == identity (the 8-torsion subgroup)."""
+    q = pt_mul(8, p)
+    zi = pow(q[2], P - 2, P)
+    return (q[0] * zi % P, q[1] * zi % P) == (0, 1)
+
+
 def verify(sig: bytes, pub: bytes, msg: bytes) -> bool:
-    """Cofactorless verify with S >= l (malleability) rejection — same
-    semantics as the JAX kernel and the reference's fd_ed25519_verify."""
+    """Cofactorless verify with S >= l (malleability) rejection AND
+    small-order A/R rejection (verify_strict) — same semantics as the
+    JAX kernel and the reference's fd_ed25519_verify
+    (ref: src/ballet/ed25519/fd_ed25519_user.c:159-201)."""
     if len(sig) != 64 or len(pub) != 32:
         return False
     s = int.from_bytes(sig[32:], "little")
     if s >= L:
         return False
     a = pt_decompress(pub)
-    if a is None:
+    if a is None or is_small_order(a):
+        return False
+    r_pt = pt_decompress(sig[:32])
+    if r_pt is not None and is_small_order(r_pt):
         return False
     k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(),
                        "little") % L

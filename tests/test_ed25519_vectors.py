@@ -1,0 +1,150 @@
+"""External crypto vector gates for the ed25519 verify kernel.
+
+* Wycheproof EdDSA verify vectors (public test data from the Wycheproof
+  project, via the reference's generated table — ref:
+  src/ballet/ed25519/test_ed25519_wycheproof.c; extracted by
+  vectors/convert_wycheproof.py). Expected verdicts are those of a
+  strict cofactorless verifier (fd_ed25519_verify) — our parity target.
+* Signature malleability corpus (Zcash/ed25519-zebra test data — ref:
+  src/ballet/ed25519/test_ed25519_signature_malleability*.bin): 96-byte
+  (sig, pub) records over the fixed message "Zcash".
+* Randomized large-batch differential fuzz vs the pure-python RFC 8032
+  oracle (VERDICT r1: >=4K lanes).
+
+All device calls share ONE compiled shape (batch 128 x max_len 1024)
+so the suite costs a single jit compile.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops.ed25519 import verify_batch
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BATCH = 128
+MAX_LEN = 1024
+
+_fn = None
+
+
+def _verify_chunked(sig, pub, msg, ln):
+    """Run (n, ...) inputs through the fixed-shape jitted kernel."""
+    global _fn
+    if _fn is None:
+        _fn = jax.jit(verify_batch)
+    n = sig.shape[0]
+    out = np.zeros(n, bool)
+    for c0 in range(0, n, BATCH):
+        c1 = min(c0 + BATCH, n)
+        s = np.zeros((BATCH, 64), np.uint8)
+        p = np.zeros((BATCH, 32), np.uint8)
+        m = np.zeros((BATCH, MAX_LEN), np.uint8)
+        L = np.zeros((BATCH,), np.int32)
+        s[:c1 - c0] = sig[c0:c1]
+        p[:c1 - c0] = pub[c0:c1]
+        m[:c1 - c0] = msg[c0:c1]
+        L[:c1 - c0] = ln[c0:c1]
+        got = np.asarray(_fn(jnp.asarray(s), jnp.asarray(p),
+                             jnp.asarray(m), jnp.asarray(L)))
+        out[c0:c1] = got[:c1 - c0]
+    return out
+
+
+def test_wycheproof():
+    with open(os.path.join(HERE, "vectors",
+                           "ed25519_wycheproof.json")) as f:
+        vecs = json.load(f)
+    n = len(vecs)
+    sig = np.zeros((n, 64), np.uint8)
+    pub = np.zeros((n, 32), np.uint8)
+    msg = np.zeros((n, MAX_LEN), np.uint8)
+    ln = np.zeros((n,), np.int32)
+    want = np.zeros((n,), bool)
+    for i, v in enumerate(vecs):
+        sig[i] = np.frombuffer(bytes.fromhex(v["sig"]), np.uint8)
+        pub[i] = np.frombuffer(bytes.fromhex(v["pub"]), np.uint8)
+        mb = bytes.fromhex(v["msg"])
+        msg[i, :len(mb)] = np.frombuffer(mb, np.uint8)
+        ln[i] = len(mb)
+        want[i] = v["ok"]
+    got = _verify_chunked(sig, pub, msg, ln)
+    bad = [(vecs[i]["tc_id"], vecs[i]["comment"], bool(want[i]))
+           for i in range(n) if got[i] != want[i]]
+    assert not bad, f"{len(bad)} wycheproof mismatches: {bad[:10]}"
+
+
+def test_malleability_corpus():
+    recs = []
+    for name, expect in [("malleability_should_pass.bin", True),
+                         ("malleability_should_fail.bin", False)]:
+        raw = open(os.path.join(HERE, "vectors", name), "rb").read()
+        assert len(raw) % 96 == 0
+        for off in range(0, len(raw), 96):
+            recs.append((raw[off:off + 64], raw[off + 64:off + 96],
+                         expect))
+    n = len(recs)
+    sig = np.zeros((n, 64), np.uint8)
+    pub = np.zeros((n, 32), np.uint8)
+    msg = np.zeros((n, MAX_LEN), np.uint8)
+    ln = np.full((n,), 5, np.int32)
+    msg[:, :5] = np.frombuffer(b"Zcash", np.uint8)
+    want = np.zeros((n,), bool)
+    for i, (s, p, e) in enumerate(recs):
+        sig[i] = np.frombuffer(s, np.uint8)
+        pub[i] = np.frombuffer(p, np.uint8)
+        want[i] = e
+    got = _verify_chunked(sig, pub, msg, ln)
+    mism = np.nonzero(got != want)[0]
+    assert mism.size == 0, (
+        f"{mism.size}/{n} malleability mismatches, first at rec "
+        f"{mism[:5]} (expected {want[mism[:5]]})")
+
+
+def test_large_batch_differential_fuzz():
+    """4096 lanes: mostly valid signatures with a scattering of
+    corruptions; verdicts must match the RFC 8032 oracle exactly."""
+    import hashlib
+    from firedancer_tpu.utils.ed25519_ref import keypair, sign, verify
+
+    rng = np.random.default_rng(123)
+    n = 4096
+    sig = np.zeros((n, 64), np.uint8)
+    pub = np.zeros((n, 32), np.uint8)
+    msg = np.zeros((n, MAX_LEN), np.uint8)
+    ln = np.zeros((n,), np.int32)
+    n_unique = 48
+    base = []
+    for i in range(n_unique):
+        seed = hashlib.sha256(b"fuzz-%d" % i).digest()
+        m = rng.integers(0, 256, int(rng.integers(0, 200)),
+                         dtype=np.uint8).tobytes()
+        _, _, pk = keypair(seed)
+        s = sign(seed, m)
+        base.append((s, pk, m))
+    for i in range(n):
+        s, pk, m = base[i % n_unique]
+        s, pk, m = bytearray(s), bytearray(pk), bytearray(m)
+        r = rng.random()
+        if r < 0.15 and len(m):
+            m[rng.integers(len(m))] ^= 1 << rng.integers(8)
+        elif r < 0.3:
+            s[rng.integers(64)] ^= 1 << rng.integers(8)
+        elif r < 0.4:
+            pk[rng.integers(32)] ^= 1 << rng.integers(8)
+        sig[i] = np.frombuffer(bytes(s), np.uint8)
+        pub[i] = np.frombuffer(bytes(pk), np.uint8)
+        msg[i, :len(m)] = np.frombuffer(bytes(m), np.uint8)
+        ln[i] = len(m)
+    got = _verify_chunked(sig, pub, msg, ln)
+    # oracle over the distinct (sig, pub, msg) triples
+    cache = {}
+    for i in range(n):
+        key = (sig[i].tobytes(), pub[i].tobytes(),
+               msg[i, :ln[i]].tobytes())
+        if key not in cache:
+            cache[key] = verify(key[0], key[1], key[2])
+        assert got[i] == cache[key], f"lane {i}"

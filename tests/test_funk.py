@@ -1,0 +1,216 @@
+"""funk fork-tree property tests (ref model: src/funk/test_funk_txn.c —
+random fork trees checked against a naive snapshot model)."""
+import random
+
+import pytest
+
+from firedancer_tpu.funk import Funk, FunkTxnError
+
+
+def test_basic_fork_shadowing():
+    f = Funk()
+    f.rec_write(None, b"a", 1)        # published state
+    f.txn_prepare(None, "t1")
+    assert f.rec_query("t1", b"a") == 1      # inherited
+    f.rec_write("t1", b"a", 2)
+    assert f.rec_query("t1", b"a") == 2      # own update shadows
+    assert f.rec_query(None, b"a") == 1      # root unaffected
+
+    f.txn_prepare("t1", "t2")
+    assert f.rec_query("t2", b"a") == 2      # ancestor update visible
+    f.rec_remove("t2", b"a")
+    assert f.rec_query("t2", b"a") is None   # tombstone shadows
+    assert f.rec_query("t1", b"a") == 2
+
+
+def test_competing_forks_isolated():
+    f = Funk()
+    f.rec_write(None, b"k", 0)
+    f.txn_prepare(None, "a")
+    f.txn_prepare(None, "b")
+    f.rec_write("a", b"k", 1)
+    f.rec_write("b", b"k", 2)
+    assert f.rec_query("a", b"k") == 1
+    assert f.rec_query("b", b"k") == 2
+    assert f.rec_query(None, b"k") == 0
+
+
+def test_publish_folds_ancestors_and_cancels_rivals():
+    f = Funk()
+    f.rec_write(None, b"x", 0)
+    f.txn_prepare(None, "p")          # ancestor
+    f.rec_write("p", b"x", 1)
+    f.rec_write("p", b"y", 10)
+    f.txn_prepare("p", "c")           # to publish
+    f.rec_write("c", b"x", 2)
+    f.txn_prepare("p", "rival")       # competing sibling
+    f.rec_write("rival", b"x", 99)
+    f.txn_prepare("c", "child")       # descendant of published
+    f.rec_write("child", b"z", 5)
+
+    f.txn_publish("c")
+    assert f.rec_query(None, b"x") == 2       # c's update (shadowed p's)
+    assert f.rec_query(None, b"y") == 10      # ancestor's fold
+    assert not f.txn_is_prepared("p")         # published away
+    assert not f.txn_is_prepared("c")
+    assert not f.txn_is_prepared("rival")     # cancelled
+    assert f.txn_is_prepared("child")         # survives, reparented
+    assert f.rec_query("child", b"z") == 5
+    assert f.rec_query("child", b"x") == 2    # sees new root
+    assert f.last_publish == "c"
+
+
+def test_cancel_subtree():
+    f = Funk()
+    f.txn_prepare(None, "a")
+    f.txn_prepare("a", "b")
+    f.txn_prepare("b", "c")
+    f.txn_prepare("a", "d")
+    f.txn_cancel("b")                 # kills b and c, not a/d
+    assert f.txn_is_prepared("a")
+    assert not f.txn_is_prepared("b")
+    assert not f.txn_is_prepared("c")
+    assert f.txn_is_prepared("d")
+
+
+def test_errors():
+    f = Funk()
+    f.txn_prepare(None, "a")
+    with pytest.raises(FunkTxnError):
+        f.txn_prepare(None, "a")      # dup xid
+    with pytest.raises(FunkTxnError):
+        f.txn_prepare("zz", "b")      # unknown parent
+    with pytest.raises(FunkTxnError):
+        f.rec_write("zz", b"k", 1)
+    with pytest.raises(FunkTxnError):
+        f.rec_query("zz", b"k")
+    with pytest.raises(FunkTxnError):
+        f.txn_cancel("zz")
+    with pytest.raises(FunkTxnError):
+        f.txn_publish("zz")
+
+
+class NaiveForkModel:
+    """Deliberately-simple oracle: per-txn write dicts + parent links,
+    query = walk up. REMOVED sentinel models tombstones."""
+
+    REMOVED = ("REMOVED",)
+
+    def __init__(self):
+        self.root = {}
+        self.writes = {}              # xid -> {key: val|REMOVED}
+        self.parent = {}
+        self.kids = {None: []}
+
+    def prepare(self, parent, xid):
+        self.writes[xid] = {}
+        self.parent[xid] = parent
+        self.kids[xid] = []
+        self.kids[parent].append(xid)
+
+    def write(self, xid, k, v):
+        if xid is None:
+            self.root[k] = v
+        else:
+            self.writes[xid][k] = v
+
+    def remove(self, xid, k):
+        if xid is None:
+            self.root.pop(k, None)
+        else:
+            self.writes[xid][k] = self.REMOVED
+
+    def query(self, xid, k):
+        x = xid
+        while x is not None:
+            if k in self.writes[x]:
+                v = self.writes[x][k]
+                return None if v is self.REMOVED else v
+            x = self.parent[x]
+        return self.root.get(k)
+
+    def _subtree(self, xid):
+        out = [xid]
+        for c in self.kids[xid]:
+            out.extend(self._subtree(c))
+        return out
+
+    def cancel(self, xid):
+        self.kids[self.parent[xid]].remove(xid)
+        for x in self._subtree(xid):
+            del self.writes[x], self.parent[x], self.kids[x]
+
+    def publish(self, xid):
+        chain = []
+        x = xid
+        while x is not None:
+            chain.append(x)
+            x = self.parent[x]
+        for x in reversed(chain):
+            for k, v in self.writes[x].items():
+                if v is self.REMOVED:
+                    self.root.pop(k, None)
+                else:
+                    self.root[k] = v
+        survivors = set()
+        for c in self.kids[xid]:
+            survivors.update(self._subtree(c))
+        new_kids = {None: list(self.kids[xid])}
+        self.writes = {x: self.writes[x] for x in survivors}
+        for x in survivors:
+            new_kids[x] = self.kids[x]
+        self.parent = {x: (self.parent[x] if self.parent[x] in survivors
+                           else None) for x in survivors}
+        self.kids = new_kids
+
+    def live(self):
+        return list(self.writes)
+
+
+def test_randomized_vs_naive_model():
+    rng = random.Random(7)
+    f = Funk()
+    m = NaiveForkModel()
+    next_xid = 0
+    keys = [bytes([k]) for k in range(8)]
+
+    for step in range(4000):
+        op = rng.random()
+        live = m.live()
+        if op < 0.28 or not live:     # prepare
+            parent = rng.choice([None] + live)
+            xid = f"t{next_xid}"
+            next_xid += 1
+            f.txn_prepare(parent, xid)
+            m.prepare(parent, xid)
+        elif op < 0.55:               # write (root writes included)
+            tx = rng.choice([None] + live)
+            k, v = rng.choice(keys), rng.randrange(1000)
+            f.rec_write(tx, k, v)
+            m.write(tx, k, v)
+        elif op < 0.65:               # remove
+            tx = rng.choice([None] + live)
+            k = rng.choice(keys)
+            f.rec_remove(tx, k)
+            m.remove(tx, k)
+        elif op < 0.8:                # query spot check
+            tx = rng.choice([None] + live)
+            k = rng.choice(keys)
+            assert f.rec_query(tx, k) == m.query(tx, k), \
+                f"step {step} txn {tx} key {k!r}"
+        elif op < 0.9:                # cancel
+            tx = rng.choice(live)
+            f.txn_cancel(tx)
+            m.cancel(tx)
+        else:                         # publish
+            tx = rng.choice(live)
+            f.txn_publish(tx)
+            m.publish(tx)
+        assert set(x for x in m.live()) == \
+            set(x for x in m.live() if f.txn_is_prepared(x))
+
+    # final coherence sweep over every live txn and key
+    for tx in [None] + m.live():
+        for k in keys:
+            assert f.rec_query(tx, k) == m.query(tx, k)
+    assert f.root_items() == m.root

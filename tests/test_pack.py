@@ -1,0 +1,192 @@
+"""Pack scheduler property tests.
+
+Reference model: src/disco/pack/test_pack.c + test_pack_tile.c — the
+no-conflict invariant, cost-limit enforcement, and priority order are
+checked against brute-force recomputation from the raw account sets
+(never trusting the scheduler's own bitsets).
+"""
+import random
+
+import pytest
+
+from firedancer_tpu.pack import PackScheduler, PackLimits, TxnMeta
+
+
+def mk_meta(writes, reads=(), reward=5000, cost=10_000, vote=False):
+    return TxnMeta(payload=b"", txn=None, reward=reward, cost=cost,
+                   writes=tuple(bytes([w]) * 32 for w in writes),
+                   reads=tuple(bytes([r]) * 32 for r in reads),
+                   is_vote=vote)
+
+
+def brute_conflict(a: TxnMeta, b: TxnMeta) -> bool:
+    aw, ar = set(a.writes), set(a.reads)
+    bw, br = set(b.writes), set(b.reads)
+    return bool(aw & bw) or bool(aw & br) or bool(ar & bw)
+
+
+def test_non_conflicting_parallel_banks():
+    s = PackScheduler(bank_cnt=2)
+    s.insert(mk_meta([1], reward=9000))
+    s.insert(mk_meta([2], reward=8000))
+    s.insert(mk_meta([1], reward=7000))   # conflicts with first
+    mb0 = s.schedule_microblock(0)
+    mb1 = s.schedule_microblock(1)
+    # bank 0 takes accts {1,2} (both fit, no mutual conflict); bank 1
+    # cannot take the acct-1 txn while bank 0 holds it
+    assert len(mb0) == 2
+    assert len(mb1) == 0
+    s.microblock_done(0)
+    mb1 = s.schedule_microblock(1)
+    assert len(mb1) == 1 and mb1[0].writes[0] == bytes([1]) * 32
+
+
+def test_read_write_conflicts():
+    s = PackScheduler(bank_cnt=2)
+    s.insert(mk_meta([1], [], reward=9000))       # writes 1
+    s.insert(mk_meta([], [1], reward=8000))       # reads 1
+    s.insert(mk_meta([], [2], reward=7000))       # reads 2
+    s.insert(mk_meta([2], [], reward=6000))       # writes 2
+    mb0 = s.schedule_microblock(0)
+    # within one microblock w1 + r1 conflict; w1 + r2 don't
+    accts = [(m.writes, m.reads) for m in mb0]
+    for i in range(len(mb0)):
+        for j in range(i + 1, len(mb0)):
+            assert not brute_conflict(mb0[i], mb0[j])
+    mb1 = s.schedule_microblock(1)
+    for a in mb0:
+        for b in mb1:
+            assert not brute_conflict(a, b)
+
+
+def test_priority_order_no_conflicts():
+    s = PackScheduler(bank_cnt=1,
+                      limits=PackLimits(max_txn_per_microblock=100))
+    rewards = [3000, 9000, 1000, 7000, 5000]
+    for i, r in enumerate(rewards):
+        s.insert(mk_meta([i + 1], reward=r, cost=10_000))
+    mb = s.schedule_microblock(0)
+    got = [m.reward for m in mb]
+    assert got == sorted(rewards, reverse=True)
+
+
+def test_block_cost_limit():
+    lim = PackLimits(max_cost_per_block=25_000,
+                     max_txn_per_microblock=10)
+    s = PackScheduler(bank_cnt=1, limits=lim)
+    for i in range(5):
+        s.insert(mk_meta([i + 1], cost=10_000))
+    mb = s.schedule_microblock(0)
+    assert len(mb) == 2                     # 3rd would exceed 25k
+    s.microblock_done(0)
+    assert s.schedule_microblock(0) == []   # block full
+    s.end_block()
+    mb = s.schedule_microblock(0)
+    assert len(mb) == 2                     # fresh block budget
+
+
+def test_per_account_write_cost_limit():
+    lim = PackLimits(max_write_cost_per_acct=15_000,
+                     max_txn_per_microblock=10)
+    s = PackScheduler(bank_cnt=1, limits=lim)
+    for _ in range(4):
+        s.insert(mk_meta([7], cost=10_000))     # same hot account
+    total = 0
+    for _ in range(4):
+        mb = s.schedule_microblock(0)
+        total += len(mb)
+        s.microblock_done(0)
+    assert total == 1     # only one fits under the 15k per-acct cap
+    s.end_block()
+    mb = s.schedule_microblock(0)
+    assert len(mb) == 1   # next block admits the next one
+
+
+def test_vote_cost_limit():
+    lim = PackLimits(max_vote_cost_per_block=10_000,
+                     max_txn_per_microblock=10)
+    s = PackScheduler(bank_cnt=1, limits=lim)
+    for i in range(3):
+        s.insert(mk_meta([i + 1], cost=6_000, vote=True))
+    mb = s.schedule_microblock(0)
+    assert len(mb) == 1   # second vote would exceed the vote budget
+
+
+def test_randomized_invariants():
+    """Fuzz: random txns over a small hot account universe, random
+    completions across 4 banks; every scheduled set must be conflict
+    free vs all outstanding (brute force), nothing lost or duplicated,
+    block limits never violated."""
+    rng = random.Random(42)
+    lim = PackLimits(max_cost_per_block=500_000,
+                     max_write_cost_per_acct=120_000,
+                     max_txn_per_microblock=4, probe_depth=32)
+    s = PackScheduler(bank_cnt=4, limits=lim)
+    metas = []
+    for i in range(200):
+        nw = rng.randint(1, 3)
+        nr = rng.randint(0, 2)
+        univ = list(range(1, 12))
+        rng.shuffle(univ)
+        m = mk_meta(univ[:nw], univ[nw:nw + nr],
+                    reward=rng.randint(1000, 50_000),
+                    cost=rng.randint(5_000, 30_000))
+        metas.append(m)
+        s.insert(m)
+
+    scheduled_ids = []
+    busy = [False] * 4
+    blocks = 0
+    for step in range(5000):
+        bank = rng.randrange(4)
+        if busy[bank] and rng.random() < 0.6:
+            s.microblock_done(bank)
+            busy[bank] = False
+            continue
+        if busy[bank]:
+            continue
+        mb = s.schedule_microblock(bank)
+        if not mb:
+            # nothing schedulable: drain banks, then try a new block
+            if all(not b for b in busy):
+                s.end_block()
+                blocks += 1
+                if blocks > 300:
+                    break
+            continue
+        busy[bank] = True
+        # INVARIANT 1: no conflicts inside the microblock or vs any
+        # other bank's outstanding txns (brute force on account sets)
+        outstanding = [m for b in range(4) if b != bank
+                       for m in s.outstanding(b)]
+        for i, a in enumerate(mb):
+            for b2 in mb[i + 1:]:
+                assert not brute_conflict(a, b2)
+            for o in outstanding:
+                assert not brute_conflict(a, o)
+        # INVARIANT 2: per-microblock txn count
+        assert len(mb) <= lim.max_txn_per_microblock
+        scheduled_ids.extend(id(m) for m in mb)
+        if s.pending_cnt == 0 and all(not b for b in busy):
+            break
+
+    # INVARIANT 3: nothing scheduled twice
+    assert len(scheduled_ids) == len(set(scheduled_ids))
+    # INVARIANT 4: everything eventually scheduled (no starvation under
+    # enough blocks)
+    assert len(scheduled_ids) == len(metas), \
+        f"only {len(scheduled_ids)}/{len(metas)} scheduled"
+    assert s.metrics["scheduled"] == len(metas)
+
+
+def test_bitset_bit_reuse():
+    """Bits are refcounted and reused; masks of live txns stay valid."""
+    s = PackScheduler(bank_cnt=1)
+    s.insert(mk_meta([1]))
+    mb = s.schedule_microblock(0)
+    assert len(mb) == 1
+    s.microblock_done(0)          # acct 1's bit freed
+    s.insert(mk_meta([2]))        # may reuse the freed bit
+    s.insert(mk_meta([2]))        # same account -> same bit
+    mb = s.schedule_microblock(0)
+    assert len(mb) == 1           # second write-2 txn must conflict

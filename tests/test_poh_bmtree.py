@@ -1,0 +1,86 @@
+"""PoH chain + bmtree merkle kernel tests vs host oracles
+(ref test model: src/ballet/poh/, src/ballet/bmtree/test_bmtree.c —
+known-topology trees checked node by node)."""
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops.bmtree import (bmtree_root, host_bmtree_root,
+                                       LEAF_PREFIX_SHREDS,
+                                       NODE_PREFIX_SHREDS)
+from firedancer_tpu.ops.poh import (PohChain, poh_verify_entries,
+                                    host_poh_append)
+
+
+def test_host_poh_append_is_repeated_sha256():
+    s = hashlib.sha256(b"seed").digest()
+    out = host_poh_append(s, 3)
+    want = s
+    for _ in range(3):
+        want = hashlib.sha256(want).digest()
+    assert out == want
+
+
+def test_poh_verify_entries_batch():
+    chain = PohChain(hashlib.sha256(b"genesis").digest())
+    chain.tick(7)
+    chain.record(hashlib.sha256(b"txn merkle 1").digest(), 5)
+    chain.tick(12)
+    chain.record(hashlib.sha256(b"txn merkle 2").digest(), 1)
+    chain.tick(3)
+
+    prev, num, mix, has, exp = chain.entry_arrays(max_hashes=16)
+    ok = np.asarray(poh_verify_entries(
+        jnp.asarray(prev), jnp.asarray(num), jnp.asarray(mix),
+        jnp.asarray(has), jnp.asarray(exp), max_hashes=16))
+    assert ok.all()
+
+    # corrupt one expected hash -> only that entry fails
+    exp2 = exp.copy()
+    exp2[2, 0] ^= 1
+    ok = np.asarray(poh_verify_entries(
+        jnp.asarray(prev), jnp.asarray(num), jnp.asarray(mix),
+        jnp.asarray(has), jnp.asarray(exp2), max_hashes=16))
+    assert list(ok) == [True, True, False, True, True]
+
+    # wrong num_hashes -> fails
+    num2 = num.copy()
+    num2[1] += 1
+    ok = np.asarray(poh_verify_entries(
+        jnp.asarray(prev), jnp.asarray(num2), jnp.asarray(mix),
+        jnp.asarray(has), jnp.asarray(exp), max_hashes=16))
+    assert not ok[1] and ok[0]
+
+
+@pytest.mark.parametrize("n_leaves", [1, 2, 3, 4, 5, 7, 8, 11, 16])
+def test_bmtree_root_matches_host(n_leaves):
+    rng = np.random.default_rng(n_leaves)
+    blobs = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+             for _ in range(n_leaves)]
+    want = host_bmtree_root(blobs)
+
+    max_leaves = 16
+    leaves = np.zeros((max_leaves, 32), np.uint8)
+    for i, b in enumerate(blobs):
+        leaves[i] = np.frombuffer(b, np.uint8)
+    got = np.asarray(bmtree_root(jnp.asarray(leaves),
+                                 jnp.asarray(n_leaves, jnp.int32),
+                                 max_leaves))
+    assert bytes(got) == want
+
+
+def test_bmtree_batched_and_shred_prefixes():
+    rng = np.random.default_rng(99)
+    batch, max_leaves = 8, 8
+    leaves = rng.integers(0, 256, (batch, max_leaves, 32), dtype=np.uint8)
+    cnts = np.array([1, 2, 3, 4, 5, 6, 7, 8], np.int32)
+    got = np.asarray(bmtree_root(
+        jnp.asarray(leaves), jnp.asarray(cnts), max_leaves,
+        leaf_prefix=LEAF_PREFIX_SHREDS, node_prefix=NODE_PREFIX_SHREDS))
+    for b in range(batch):
+        blobs = [leaves[b, i].tobytes() for i in range(cnts[b])]
+        want = host_bmtree_root(blobs, LEAF_PREFIX_SHREDS,
+                                NODE_PREFIX_SHREDS)
+        assert bytes(got[b]) == want, f"batch lane {b} (cnt {cnts[b]})"

@@ -1,0 +1,62 @@
+"""One-off converter: extract the Wycheproof ed25519 verify vectors from
+the reference's generated table (test_ed25519_wycheproof.c, itself
+generated from the Wycheproof project's eddsa_test.json) into JSON.
+
+Vectors are public test DATA (Wycheproof, Apache-2.0); only the data is
+extracted, no code. The `ok` field is the expected verdict of a strict
+cofactorless verifier (what fd_ed25519_verify implements — our parity
+target).
+
+Usage: python convert_wycheproof.py <path-to-test_ed25519_wycheproof.c>
+Writes ed25519_wycheproof.json next to this script.
+"""
+import json
+import os
+import re
+import sys
+
+
+def c_string_to_bytes(s: str) -> bytes:
+    # the generated file uses only \xNN escapes and ASCII
+    return s.encode("latin1").decode("unicode_escape").encode("latin1")
+
+
+def main(path: str):
+    src = open(path).read()
+    rec_re = re.compile(
+        r"\{\s*\.tc_id\s*=\s*(\d+),\s*"
+        r"\.comment\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.msg\s*=\s*\(uchar const \*\)\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.msg_sz\s*=\s*(\d+)UL,\s*"
+        r"\.sig\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.pub\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.ok\s*=\s*(\d+)\s*\}", re.S)
+    out = []
+    for m in rec_re.finditer(src):
+        tc_id, comment, msg, msg_sz, sig, pub, ok = m.groups()
+        msg_b = c_string_to_bytes(msg)
+        sig_b = c_string_to_bytes(sig)
+        pub_b = c_string_to_bytes(pub)
+        msg_sz = int(msg_sz)
+        # C string literals NUL-terminate: a trailing \x00 in the data
+        # is dropped by the literal only if explicitly... they are
+        # written fully escaped, so lengths should match exactly.
+        assert len(msg_b) >= msg_sz, (tc_id, len(msg_b), msg_sz)
+        assert len(sig_b) == 64 and len(pub_b) == 32, tc_id
+        out.append({
+            "tc_id": int(tc_id),
+            "comment": comment,
+            "msg": msg_b[:msg_sz].hex(),
+            "sig": sig_b.hex(),
+            "pub": pub_b.hex(),
+            "ok": bool(int(ok)),
+        })
+    dst = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "ed25519_wycheproof.json")
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=0)
+    print(f"wrote {len(out)} vectors to {dst}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
