@@ -144,23 +144,22 @@ def neg(a):
     return carry(jnp.asarray(SUB_C) - a)
 
 
-# anti-diagonal gather map: coefficient j collects prod[i, j-i]; invalid
-# (i, j-i) pairs point at a trailing zero slot
-_CONV_IDX = np.full((NLIMB, 2 * NLIMB - 1), NLIMB * NLIMB, np.int32)
-for _i in range(NLIMB):
-    for _j in range(2 * NLIMB - 1):
-        if 0 <= _j - _i < NLIMB:
-            _CONV_IDX[_i, _j] = _i * NLIMB + (_j - _i)
-
-
 def _mul_core(a, b):
-    """Schoolbook product via one outer product + static gather + sum —
-    no per-limb python loops, so the XLA graph stays small and wide."""
+    """Schoolbook product via one outer product + skewed reshape + sum.
+
+    The anti-diagonal collection c[k] = Σ_i prod[i, k-i] is done with the
+    classic pad-to-(n, 2n) / flatten / truncate / reshape-(n, 2n-1) skew:
+    element (i, j) of the padded matrix lands at flat offset 2n·i + j =
+    (2n-1)·i + (i+j), i.e. row i, column i+j of the reshaped view. Pure
+    data movement XLA folds into the layout — no gather (TPU gathers run
+    near-scalar and were ~the whole cost of the previous formulation)."""
     prod = a[..., :, None] * b[..., None, :]          # (...,20,20) < 2^26.6
-    flat = prod.reshape(*prod.shape[:-2], NLIMB * NLIMB)
-    flat = jnp.concatenate(
-        [flat, jnp.zeros_like(flat[..., :1])], axis=-1)
-    c = flat[..., jnp.asarray(_CONV_IDX)].sum(axis=-2)  # (...,39) < 2^30.6
+    pad = jnp.concatenate(
+        [prod, jnp.zeros_like(prod)], axis=-1)        # (...,20,40)
+    flat = pad.reshape(*prod.shape[:-2], 2 * NLIMB * NLIMB)
+    skew = flat[..., : NLIMB * (2 * NLIMB - 1)].reshape(
+        *prod.shape[:-2], NLIMB, 2 * NLIMB - 1)
+    c = skew.sum(axis=-2)                             # (...,39) < 2^30.6
     # one relaxed pass so the 608-fold below cannot overflow int32
     lo = c & MASK
     hi = c >> BITS

@@ -1,0 +1,598 @@
+"""Fused Pallas TPU kernels for batched ed25519 verification.
+
+This is the high-throughput backend of the sigverify hot loop — the role
+the AVX-512-IFMA backend plays for the reference
+(ref: src/ballet/ed25519/avx512/fd_r43x6.h:10-32, fd_r43x6_ge.c) and the
+wiredancer FPGA plays at the tile level (ref: src/wiredancer/README.md:99-119).
+The pure-jnp kernels in ops/ed25519.py remain the portable reference
+implementation (and the CPU-backend path); these kernels compute the same
+function but keep the entire field/point computation resident in VMEM, so
+the ~3k field multiplies per signature never round-trip HBM. On the XLA
+path each fe.mul materializes a (20,20,B) outer product to HBM, which
+measures ~55 ns/lane; in-kernel the same multiply is ~1.3 ns/lane.
+
+Layout: field elements are (NLIMB, TB) int32 limb-major blocks (batch in
+the lane dimension, limbs in sublanes), radix 2^13, same representation
+and bound discipline as ops/fe25519.py (see its module docstring for the
+carry analysis). The grid splits the batch into TB-lane programs.
+
+Two kernels:
+  * `_decompress_kernel` — RFC 8032 §5.1.3 point decompression with
+    failure masks; one (p-5)/8 power chain (addition-chain form:
+    254 squarings + 11 multiplies instead of scan square-and-multiply).
+  * `_dsm_encode_kernel` — the double scalar mul [S]B + [k](−A) with
+    4-bit windows (fixed-base: doubling-free precomputed affine tables,
+    7-mul mixed adds; variable-base: per-lane 16-entry table, 256
+    doublings in T-free 7-mul form where possible), followed by the
+    projective→affine encode (one inversion chain) to canonical y digits
+    + x parity.
+
+Glue `verify_batch` reproduces ops/ed25519.verify_batch semantics
+bit-for-bit (strict small-order rejection, S canonicality, cofactorless
+equation) with SHA-512 and scalar reduction still on the jnp path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import fe25519 as fe
+from . import ed25519 as ed
+
+NL = fe.NLIMB
+BITS = fe.BITS
+MASK = fe.MASK
+FOLD = fe.FOLD
+P = fe.P
+
+DEFAULT_TB = 256
+
+
+# ---------------------------------------------------------------------------
+# in-kernel field arithmetic on (NL, TB) int32 values
+# ---------------------------------------------------------------------------
+
+def _carry(x, passes=3):
+    """Relaxed parallel carry; bound analysis in ops/fe25519.py."""
+    for _ in range(passes):
+        lo = x & MASK
+        hi = x >> BITS
+        x = lo + jnp.concatenate([hi[-1:] * FOLD, hi[:-1]], axis=0)
+    return x
+
+
+def _const_col(arr) -> jnp.ndarray:
+    """(NL,) numpy constant -> (NL, 1) broadcastable column.
+
+    Built from broadcasted_iota + scalar selects rather than a literal
+    array: Pallas TPU kernels may not capture non-scalar array constants
+    (they would have to be passed as inputs), but scalar splats are fine
+    and Mosaic folds this chain at compile time."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, (NL, 1), 0)
+    out = jnp.zeros((NL, 1), jnp.int32)
+    for i, v in enumerate(np.asarray(arr, np.int64)):
+        if int(v):
+            out = jnp.where(idx == i, jnp.int32(int(v)), out)
+    return out
+
+
+_SUB_C = None     # initialized lazily to avoid import-order issues
+_ONE = None
+
+
+def fadd(a, b):
+    # loose(≤9408) + loose < 2^14.3: one pass leaves limbs ≤ 8192+2 and
+    # limb0 ≤ 8192+2·608+2 = 9410 — still multiply-safe (9410²·20 < 2^31)
+    return _carry(a + b, passes=1)
+
+
+def fsub(a, b):
+    # a + C − b with C ≡ 0 (mod p), per-limb 22752..65535: sum < 2^17;
+    # two passes restore the ≤9410 loose bound
+    return _carry(a + _const_col(fe.SUB_C) - b, passes=2)
+
+
+def fneg(a):
+    return _carry(_const_col(fe.SUB_C) - a, passes=2)
+
+
+def fmul_small2(a):
+    """a·2 for loose a — one pass suffices."""
+    return _carry(a * 2, passes=1)
+
+
+def _reduce39(c):
+    """(2*NL-1, TB) schoolbook coefficients (< 2^31) -> loose (NL, TB)."""
+    lo = c & MASK
+    hi = c >> BITS
+    z1 = jnp.zeros_like(lo[:1])
+    c = (jnp.concatenate([lo, z1], axis=0)
+         + jnp.concatenate([z1, hi], axis=0))          # (2*NL, TB)
+    return _carry(c[:NL] + c[NL:] * FOLD, passes=3)
+
+
+def fmul(a, b):
+    """Schoolbook product, row-broadcast pad+roll form: 20 shifted
+    (2*NL,TB)-wide accumulations, entirely in VMEM — no HBM
+    intermediates, no gathers."""
+    tb = a.shape[-1]
+    acc = jnp.zeros((2 * NL, tb), jnp.int32)
+    znl = jnp.zeros((NL, tb), jnp.int32)
+    for i in range(NL):
+        prod = a[i][None, :] * b                       # (NL, TB)
+        padded = jnp.concatenate([prod, znl], axis=0)  # (2*NL, TB)
+        acc = acc + pltpu.roll(padded, shift=i, axis=0)
+    return _reduce39(acc[: 2 * NL - 1])
+
+
+def fsq(a):
+    return fmul(a, a)
+
+
+def fmul_const(a, const_limbs):
+    """Multiply by a (NL,) constant limb vector (e.g. d, 2d, sqrt(-1)):
+    schoolbook with python-int scalar rows (splat constants only)."""
+    tb = a.shape[-1]
+    acc = jnp.zeros((2 * NL, tb), jnp.int32)
+    znl = jnp.zeros((NL, tb), jnp.int32)
+    for i, v in enumerate(np.asarray(const_limbs, np.int64)):
+        if not int(v):
+            continue
+        padded = jnp.concatenate([jnp.int32(int(v)) * a, znl], axis=0)
+        acc = acc + pltpu.roll(padded, shift=i, axis=0)
+    return _reduce39(acc[: 2 * NL - 1])
+
+
+def _digit_pass(x, fold=False):
+    """Sequential exact base-2^13 digit pass on (NL, TB); row ops."""
+    c = jnp.zeros_like(x[0:1])
+    rows = []
+    for i in range(NL):
+        v = x[i:i + 1] + c
+        rows.append(v & MASK)
+        c = v >> BITS
+    out = jnp.concatenate(rows, axis=0)
+    if fold:
+        out = jnp.concatenate([out[0:1] + c * FOLD, out[1:]], axis=0)
+    return out
+
+
+def _flt_const(x, const_digits):
+    """Lexicographic x < const on exact digit vectors. (1, TB) bool."""
+    c = np.asarray(const_digits)
+    lt = jnp.zeros_like(x[0:1], jnp.bool_)
+    eq = jnp.ones_like(x[0:1], jnp.bool_)
+    for i in range(NL - 1, -1, -1):
+        ci = jnp.int32(int(c[i]))
+        lt = lt | (eq & (x[i:i + 1] < ci))
+        eq = eq & (x[i:i + 1] == ci)
+    return lt
+
+
+def fcanon(x):
+    """Exact canonical digits in [0, p). Mirrors fe25519.canonical."""
+    x = _carry(x, passes=3)
+    x = _digit_pass(x, fold=True)
+    x = _digit_pass(x, fold=True)
+    hb = 255 - BITS * (NL - 1)                          # 8
+    h = x[NL - 1:NL] >> hb
+    x = jnp.concatenate(
+        [x[0:1] + h * 19, x[1:NL - 1], x[NL - 1:NL] & ((1 << hb) - 1)],
+        axis=0)
+    x = _digit_pass(x)
+    p_col = _const_col(fe.P_LIMBS)
+    for _ in range(2):
+        ge = ~_flt_const(x, fe.P_LIMBS)
+        x = _digit_pass(x - jnp.where(ge, p_col, 0))
+    return x
+
+
+def fis_zero(x):
+    return jnp.all(fcanon(x) == 0, axis=0, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# power chains (addition-chain form: 254 sq + 11 mul per chain)
+# ---------------------------------------------------------------------------
+
+def _nsq(x, n):
+    return jax.lax.fori_loop(0, n, lambda i, v: fsq(v), x)
+
+
+def _chain_z250(x):
+    """x^(2^250 - 1) plus intermediates (z50, x11) — shared prefix of the
+    standard curve25519 inversion/sqrt addition chain."""
+    x2 = fsq(x)
+    x4 = fsq(x2)
+    x8 = fsq(x4)
+    x9 = fmul(x, x8)
+    x11 = fmul(x2, x9)
+    x22 = fsq(x11)
+    z5 = fmul(x9, x22)                   # x^(2^5-1)
+    z10 = fmul(_nsq(z5, 5), z5)          # x^(2^10-1)
+    z20 = fmul(_nsq(z10, 10), z10)
+    z40 = fmul(_nsq(z20, 20), z20)
+    z50 = fmul(_nsq(z40, 10), z10)
+    z100 = fmul(_nsq(z50, 50), z50)
+    z200 = fmul(_nsq(z100, 100), z100)
+    z250 = fmul(_nsq(z200, 50), z50)
+    return z250, x11
+
+
+def fpow_p58(x):
+    """x^((p-5)/8) = x^(2^252 - 3)."""
+    z250, _ = _chain_z250(x)
+    return fmul(_nsq(z250, 2), x)
+
+
+def finv(x):
+    """x^(p-2) = x^(2^255 - 21)."""
+    z250, x11 = _chain_z250(x)
+    return fmul(_nsq(z250, 5), x11)
+
+
+# ---------------------------------------------------------------------------
+# point ops — extended coordinates, precomputed-operand adds
+# ---------------------------------------------------------------------------
+
+def pt_dbl_not(p):
+    """Doubling without computing T (7 muls) — legal when the result
+    feeds another doubling (dbl never reads T)."""
+    x1, y1, z1, _ = p
+    a = fsq(x1)
+    b = fsq(y1)
+    c = fmul_small2(fsq(z1))
+    h = fadd(a, b)
+    e = fsub(h, fsq(fadd(x1, y1)))
+    g = fsub(a, b)
+    f = fadd(c, g)
+    return (fmul(e, f), fmul(g, h), fmul(f, g), p[3])
+
+
+def pt_dbl_t(p):
+    """Full doubling (8 muls)."""
+    x1, y1, z1, _ = p
+    a = fsq(x1)
+    b = fsq(y1)
+    c = fmul_small2(fsq(z1))
+    h = fadd(a, b)
+    e = fsub(h, fsq(fadd(x1, y1)))
+    g = fsub(a, b)
+    f = fadd(c, g)
+    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+
+
+def pt_madd_aff(p, q_pre):
+    """p + q for q affine precomputed (ymx, ypx, t2d): 7 muls.
+    q_pre rows: Y2−X2, Y2+X2, 2d·T2 with Z2=1."""
+    x1, y1, z1, t1 = p
+    ymx, ypx, t2d = q_pre
+    a = fmul(fsub(y1, x1), ymx)
+    b = fmul(fadd(y1, x1), ypx)
+    c = fmul(t1, t2d)
+    d = fmul_small2(z1)
+    e = fsub(b, a)
+    f = fsub(d, c)
+    g = fadd(d, c)
+    h = fadd(b, a)
+    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+
+
+def pt_add_pre(p, q_pre):
+    """p + q for q projective precomputed (ymx, ypx, z2x2, t2d): 8 muls."""
+    x1, y1, z1, t1 = p
+    ymx, ypx, z2x2, t2d = q_pre
+    a = fmul(fsub(y1, x1), ymx)
+    b = fmul(fadd(y1, x1), ypx)
+    c = fmul(t1, t2d)
+    d = fmul(z1, z2x2)
+    e = fsub(b, a)
+    f = fsub(d, c)
+    g = fadd(d, c)
+    h = fadd(b, a)
+    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+
+
+def pt_add_full(p, q):
+    """General extended add (9 muls) — used once to join the two
+    accumulators."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = fmul(fsub(y1, x1), fsub(y2, x2))
+    b = fmul(fadd(y1, x1), fadd(y2, x2))
+    c = fmul(fmul_const(t1, fe.D2_LIMBS), t2)
+    d = fmul_small2(fmul(z1, z2))
+    e = fsub(b, a)
+    f = fsub(d, c)
+    g = fadd(d, c)
+    h = fadd(b, a)
+    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+
+
+def pt_identity(tb):
+    z = jnp.zeros((NL, tb), jnp.int32)
+    one = jnp.concatenate([jnp.ones((1, tb), jnp.int32), z[1:]], axis=0)
+    return (z, one, one, z)
+
+
+def _to_pre(p):
+    """Projective entry -> (ymx, ypx, 2·Z, 2d·T) precomputed form."""
+    x, y, z, t = p
+    return (fsub(y, x), fadd(y, x), fmul_small2(z),
+            fmul_const(t, fe.D2_LIMBS))
+
+
+def _sel16(entries, w):
+    """Binary-tree select of 16 table entries (tuples of (NL,TB)) by
+    per-lane window value w (1,TB) in [0,16)."""
+    ncoord = len(entries[0])
+    cur = entries
+    for bit in range(4):
+        m = ((w >> bit) & 1).astype(jnp.bool_)
+        cur = [tuple(jnp.where(m, hi[c], lo[c]) for c in range(ncoord))
+               for lo, hi in zip(cur[0::2], cur[1::2])]
+    return cur[0]
+
+
+# ---------------------------------------------------------------------------
+# fixed-base table (host-generated, affine precomputed form)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _fb_tables() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(64,16,NL) int32 ×3: (Y−X, Y+X, 2d·T) of (w·16^j)·B affine.
+    w=0 encodes the identity (1, 1, 0)."""
+    tab = ed._fixed_base_table()                     # (64,16,4,NL) affine ext
+    d2 = 2 * fe.d % P
+    ymx = np.zeros((64, 16, NL), np.int32)
+    ypx = np.zeros((64, 16, NL), np.int32)
+    t2d = np.zeros((64, 16, NL), np.int32)
+    for j in range(64):
+        for w in range(16):
+            x = fe.limbs_to_int(tab[j, w, 0])
+            y = fe.limbs_to_int(tab[j, w, 1])
+            t = fe.limbs_to_int(tab[j, w, 3])
+            ymx[j, w] = fe._int_to_limbs((y - x) % P)
+            ypx[j, w] = fe._int_to_limbs((y + x) % P)
+            t2d[j, w] = fe._int_to_limbs(t * d2 % P)
+    return ymx, ypx, t2d
+
+
+def _fb_entry(ymx_j, ypx_j, t2d_j, w):
+    """Select fb table entry: refs sliced to (16, NL), per-lane w (1,TB).
+    Constants broadcast against the batch inside the tree."""
+    entries = [
+        (ymx_j[k][:, None], ypx_j[k][:, None], t2d_j[k][:, None])
+        for k in range(16)
+    ]
+    return _sel16(entries, w)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _decompress_kernel(y_ref, sign_ref, x_ref, t_ref, ok_ref):
+    """RFC 8032 §5.1.3 decompression. y_ref: exact 255-bit digits.
+    Outputs x (loose), t = x·y (loose), ok mask. y-canonicality (y<p) is
+    checked on the jnp side (digit compare, cheap)."""
+    y = y_ref[:]
+    sign = sign_ref[:]
+    tb = y.shape[-1]
+    one = pt_identity(tb)[1]
+    y2 = fsq(y)
+    u = fsub(y2, one)
+    v = fadd(fmul_const(y2, fe.D_LIMBS), one)
+    v3 = fmul(fsq(v), v)
+    v7 = fmul(fsq(v3), v)
+    x = fmul(fmul(u, v3), fpow_p58(fmul(u, v7)))
+    vx2 = fmul(v, fsq(x))
+    root_ok = fis_zero(fsub(vx2, u))
+    root_neg = fis_zero(fadd(vx2, u))
+    x = jnp.where(root_neg, fmul_const(x, fe.SQRT_M1_LIMBS), x)
+    ok = root_ok | root_neg
+    xc = fcanon(x)
+    x_is_zero = jnp.all(xc == 0, axis=0, keepdims=True)
+    ok = ok & ~(x_is_zero & (sign == 1))
+    flip = (xc[0:1] & 1) != sign
+    x = jnp.where(flip, fneg(x), x)
+    x_ref[:] = _carry(x, passes=1)
+    t_ref[:] = fmul(x, y)
+    ok_ref[:] = ok.astype(jnp.int32)
+
+
+def _dsm_encode_kernel(sw_ref, kw_ref, ax_ref, ay_ref, at_ref,
+                       fb_ymx_ref, fb_ypx_ref, fb_t2d_ref,
+                       outy_ref, outsign_ref):
+    """R' = [S]B + [k](−A); outputs canonical y digits + x parity of R'.
+
+    Variable-base: per-lane 16-entry precomputed table of w·(−A), 64
+    msb-first windows of 4 T-free doublings + 1 full doubling + 1 8-mul
+    add. Fixed-base: doubling-free 7-mul mixed adds against the constant
+    affine tables. Encode: one inversion chain + canonicalization.
+    """
+    ax = ax_ref[:]
+    ay = ay_ref[:]
+    at = at_ref[:]
+    tb = ax.shape[-1]
+
+    # −A (affine, z = 1)
+    nx = fneg(ax)
+    nt = fneg(at)
+    one = pt_identity(tb)[1]
+    a_neg_pre = (fsub(ay, nx), fadd(ay, nx), fmul_const(nt, fe.D2_LIMBS))
+
+    # build 16-entry variable-base table in precomputed projective form
+    full = [pt_identity(tb), (nx, ay, one, nt)]
+    for _ in range(14):
+        full.append(pt_madd_aff(full[-1], a_neg_pre))
+    id_pre = (one, one, fmul_small2(one), jnp.zeros_like(one))
+    vbtab = [id_pre] + [_to_pre(p) for p in full[1:]]
+
+    def window_step(i, carry_pts):
+        vacc, facc = carry_pts
+        j = 63 - i
+        # variable-base: 16·vacc + w_j·(−A)
+        vacc = pt_dbl_not(vacc)
+        vacc = pt_dbl_not(vacc)
+        vacc = pt_dbl_not(vacc)
+        vacc = pt_dbl_t(vacc)
+        wk = kw_ref[pl.ds(j, 1), :]                  # (1, TB)
+        vacc = pt_add_pre(vacc, _sel16(vbtab, wk))
+        # fixed-base: += (w_j·16^j)·B
+        ws = sw_ref[pl.ds(j, 1), :]
+        ymx_j = fb_ymx_ref[j]                        # (16, NL)
+        ypx_j = fb_ypx_ref[j]
+        t2d_j = fb_t2d_ref[j]
+        facc = pt_madd_aff(facc, _fb_entry(ymx_j, ypx_j, t2d_j, ws))
+        return (vacc, facc)
+
+    vacc, facc = jax.lax.fori_loop(
+        0, 64, window_step, (pt_identity(tb), pt_identity(tb)))
+    rx, ry, rz, _ = pt_add_full(vacc, facc)
+
+    zinv = finv(rz)
+    xc = fcanon(fmul(rx, zinv))
+    yc = fcanon(fmul(ry, zinv))
+    outy_ref[:] = yc
+    outsign_ref[:] = xc[0:1] & 1
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+def _fe_spec(tb):
+    return pl.BlockSpec((NL, tb), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+
+def _row_spec(tb):
+    return pl.BlockSpec((1, tb), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+
+def _win_spec(tb):
+    return pl.BlockSpec((64, tb), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+
+def _tab_spec():
+    return pl.BlockSpec((64, 16, NL), lambda i: (0, 0, 0),
+                        memory_space=pltpu.VMEM)
+
+
+@functools.partial(jax.jit, static_argnames=("tb", "interpret"))
+def decompress_tpu(y_digits, sign, tb=DEFAULT_TB, interpret=False):
+    """y_digits (NL, B) exact digits; sign (1, B) int32. Returns
+    x (NL, B) loose, t (NL, B) loose, ok (1, B) int32."""
+    b = y_digits.shape[-1]
+    assert b % tb == 0, (b, tb)
+    grid = (b // tb,)
+    return pl.pallas_call(
+        _decompress_kernel,
+        grid=grid,
+        in_specs=[_fe_spec(tb), _row_spec(tb)],
+        out_specs=[_fe_spec(tb), _fe_spec(tb), _row_spec(tb)],
+        out_shape=[
+            jax.ShapeDtypeStruct((NL, b), jnp.int32),
+            jax.ShapeDtypeStruct((NL, b), jnp.int32),
+            jax.ShapeDtypeStruct((1, b), jnp.int32),
+        ],
+        interpret=interpret,
+    )(y_digits, sign)
+
+
+@functools.partial(jax.jit, static_argnames=("tb", "interpret"))
+def dsm_encode_tpu(s_w, k_w, ax, ay, at, tb=DEFAULT_TB, interpret=False):
+    """s_w/k_w (64, B) int32 windows; A affine (x, y, t) as (NL, B) each.
+    Returns (y_canonical_digits (NL, B), sign_row (1, B))."""
+    b = s_w.shape[-1]
+    assert b % tb == 0
+    ymx, ypx, t2d = _fb_tables()
+    grid = (b // tb,)
+    return pl.pallas_call(
+        _dsm_encode_kernel,
+        grid=grid,
+        in_specs=[_win_spec(tb), _win_spec(tb),
+                  _fe_spec(tb), _fe_spec(tb), _fe_spec(tb),
+                  _tab_spec(), _tab_spec(), _tab_spec()],
+        out_specs=[_fe_spec(tb), _row_spec(tb)],
+        out_shape=[
+            jax.ShapeDtypeStruct((NL, b), jnp.int32),
+            jax.ShapeDtypeStruct((1, b), jnp.int32),
+        ],
+        interpret=interpret,
+    )(s_w, k_w, ax, ay, at, jnp.asarray(ymx), jnp.asarray(ypx),
+      jnp.asarray(t2d))
+
+
+# ---------------------------------------------------------------------------
+# glue: full verify with pallas core
+# ---------------------------------------------------------------------------
+
+# 255-bit digit packing matrix (bytes handled on the jnp side)
+_PACK_BITS = None
+
+
+def _y_to_bytes(y_digits_t, sign_row):
+    """(NL, B) canonical digits + (1, B) sign -> (B, 32) uint8."""
+    y = jnp.moveaxis(y_digits_t, 0, -1)              # (B, NL)
+    bits = (y[..., jnp.asarray(fe._L2BIT_IDX)]
+            >> jnp.asarray(fe._L2BIT_SHIFT)) & 1
+    b = fe.bits_to_bytes(bits)                       # (B, 32)
+    sign = sign_row[0].astype(jnp.uint8)
+    return b.at[..., 31].set(b[..., 31] | (sign << 7))
+
+
+def _pad_to(x, b_pad, axis=0):
+    pad = b_pad - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def verify_batch(sig, pub, msg, msg_len, tb=DEFAULT_TB, interpret=False):
+    """Drop-in equivalent of ops.ed25519.verify_batch on the Pallas path.
+
+    sig (B, 64) u8, pub (B, 32) u8, msg (B, L) u8, msg_len (B,) i32
+    -> (B,) bool. Batch is padded up to a multiple of `tb` internally.
+    """
+    bsz = sig.shape[0]
+    b_pad = -(-bsz // tb) * tb
+
+    r_bytes = sig[:, :32]
+    s_bytes = sig[:, 32:]
+
+    s_digits, s_ok = ed.sc_from_bytes32(s_bytes)
+    a_ok = fe.digits_lt(fe.frombytes(pub), fe.P_LIMBS)  # y < p
+    a_ok = a_ok & ~ed.is_small_order_encoding(pub)
+    r_ok = ~ed.is_small_order_encoding(r_bytes)
+
+    kmsg = jnp.concatenate([r_bytes, pub, msg], axis=-1)
+    from .pallas_sha import sha512 as sha512_pl
+    k_digits = ed.sc_reduce64(
+        sha512_pl(kmsg, msg_len + 64, interpret=interpret))
+
+    s_w = jnp.moveaxis(ed.sc_windows4(s_digits), 0, -1)   # (64, B)
+    k_w = jnp.moveaxis(ed.sc_windows4(k_digits), 0, -1)
+
+    y_a = jnp.moveaxis(fe.frombytes(pub), 0, -1)          # (NL, B)
+    sign_a = (pub[:, 31] >> 7).astype(jnp.int32)[None, :]
+
+    # pad batch to grid multiple
+    y_a = _pad_to(y_a, b_pad, axis=1)
+    sign_a = _pad_to(sign_a, b_pad, axis=1)
+    s_w = _pad_to(s_w, b_pad, axis=1)
+    k_w = _pad_to(k_w, b_pad, axis=1)
+
+    ax, at, dec_ok = decompress_tpu(y_a, sign_a, tb=tb, interpret=interpret)
+    yc, sgn = dsm_encode_tpu(s_w, k_w, ax, y_a, at, tb=tb,
+                             interpret=interpret)
+    rp_bytes = _y_to_bytes(yc[:, :bsz], sgn[:, :bsz])
+    match = jnp.all(rp_bytes == r_bytes, axis=-1)
+    return s_ok & a_ok & r_ok & match & (dec_ok[0, :bsz] == 1)

@@ -1,0 +1,147 @@
+"""Experiment: limb-major (NLIMB, batch) layout for field arithmetic.
+
+Hypothesis: (batch, 20) arrays pad the minor dim 20 -> 128 lanes (84%
+waste); transposing to (20, batch) makes batch the minor dim and should
+speed up fe.mul / pt_dbl several-fold.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from firedancer_tpu.ops import fe25519 as fe
+
+NLIMB, BITS, MASK, FOLD = fe.NLIMB, fe.BITS, fe.MASK, fe.FOLD
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+R = 4
+
+
+def carry_t(x):
+    """(…limb axis 0…) transposed carry: limbs axis 0, batch axis 1."""
+    for _ in range(3):
+        lo = x & MASK
+        hi = x >> BITS
+        x = lo + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+        x = x.at[0].add(hi[-1] * FOLD)
+    return x
+
+
+def mul_t(a, b):
+    prod = a[:, None, :] * b[None, :, :]              # (20,20,B)
+    pad = jnp.concatenate([prod, jnp.zeros_like(prod)], axis=1)  # (20,40,B)
+    flat = pad.reshape(2 * NLIMB * NLIMB, *prod.shape[2:])
+    skew = flat[: NLIMB * (2 * NLIMB - 1)].reshape(
+        NLIMB, 2 * NLIMB - 1, *prod.shape[2:])
+    c = skew.sum(axis=0)                              # (39,B)
+    lo = c & MASK
+    hi = c >> BITS
+    c = jnp.concatenate([lo, jnp.zeros_like(lo[:1])], axis=0)
+    c = c.at[1:].add(hi)                              # (40,B)
+    return carry_t(c[:NLIMB] + c[NLIMB:] * FOLD)
+
+
+def mul_t_unrolled(a, b):
+    """Fully unrolled accumulation: no outer-product materialization."""
+    rows = []
+    zero = jnp.zeros_like(a[0])
+    for k in range(2 * NLIMB - 1):
+        acc = zero
+        for i in range(max(0, k - NLIMB + 1), min(NLIMB, k + 1)):
+            acc = acc + a[i] * b[k - i]
+        rows.append(acc)
+    c = jnp.stack(rows, axis=0)                       # (39,B)
+    lo = c & MASK
+    hi = c >> BITS
+    c = jnp.concatenate([lo, jnp.zeros_like(lo[:1])], axis=0)
+    c = c.at[1:].add(hi)
+    return carry_t(c[:NLIMB] + c[NLIMB:] * FOLD)
+
+
+def add_t(a, b):
+    return carry_t(a + b)
+
+
+def sub_t(a, b):
+    return carry_t(a + jnp.asarray(fe.SUB_C)[:, None] - b)
+
+
+def mul_small_t(a, k):
+    return carry_t(a * jnp.int32(k))
+
+
+def sq_t(a, mul=mul_t):
+    return mul(a, a)
+
+
+def pt_dbl_t(p, mul=mul_t):
+    x1, y1, z1, _ = p
+    a = mul(x1, x1)
+    b = mul(y1, y1)
+    c = mul_small_t(mul(z1, z1), 2)
+    h = add_t(a, b)
+    xy = add_t(x1, y1)
+    e = sub_t(h, mul(xy, xy))
+    g = sub_t(a, b)
+    f = add_t(c, g)
+    return (mul(e, f), mul(g, h), mul(f, g), mul(e, h))
+
+
+def timed(name, fn, x, iters=3):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(x))
+    comp = time.perf_counter() - t0
+    best = 1e9
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:32s} {best/R*1e3:9.3f} ms/run  compile {comp:5.1f}s")
+    return best / R
+
+
+def main():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 8192, (NLIMB, BATCH), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 8192, (NLIMB, BATCH), dtype=np.int32))
+    print(f"batch={BATCH}")
+
+    def mul64(v):
+        for _ in range(64):
+            v = mul_t(v, b)
+        return v
+    f = jax.jit(lambda v: jax.lax.fori_loop(0, R, lambda i, w: mul64(w), v))
+    per = timed("mul_t x64 (skew)", f, a)
+    print(f"  -> one fe.mul: {per/64*1e6:.0f} us")
+
+    def mul64u(v):
+        for _ in range(64):
+            v = mul_t_unrolled(v, b)
+        return v
+    f = jax.jit(lambda v: jax.lax.fori_loop(0, R, lambda i, w: mul64u(w), v))
+    per = timed("mul_t x64 (unrolled)", f, a)
+    print(f"  -> one fe.mul: {per/64*1e6:.0f} us")
+
+    pt = (a, b, mul_t(a, b), mul_t(b, b))
+    def dbl64(p):
+        q, _ = jax.lax.scan(lambda c, _: (pt_dbl_t(c), None), p, None, length=64)
+        return q
+    f = jax.jit(lambda p: jax.lax.fori_loop(
+        0, R, lambda i, w: dbl64(w), p))
+    per = timed("pt_dbl_t x64 (scan, skew)", f, pt)
+    print(f"  -> one pt_dbl: {per/64*1e6:.0f} us")
+
+    def dbl64u(p):
+        q, _ = jax.lax.scan(
+            lambda c, _: (pt_dbl_t(c, mul=mul_t_unrolled), None), p, None,
+            length=64)
+        return q
+    f = jax.jit(lambda p: jax.lax.fori_loop(0, R, lambda i, w: dbl64u(w), p))
+    per = timed("pt_dbl_t x64 (scan, unrolled)", f, pt)
+    print(f"  -> one pt_dbl: {per/64*1e6:.0f} us")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Raw VPU throughput: int32 mul vs f32 FMA vs bitwise, via Pallas chains.
+
+Decides the field-element representation for the ed25519 Pallas kernel.
+"""
+import sys
+import time
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS, COLS = 256, 1024          # 1MB f32 block
+K = 8192
+
+
+def make_kernel(op):
+    def kernel(a_ref, b_ref, o_ref):
+        a = a_ref[:]
+        b = b_ref[:]
+        def body(i, x):
+            return op(x, a, b)
+        o_ref[:] = jax.lax.fori_loop(0, K, body, b)
+    return kernel
+
+
+def bench(name, op, dtype, iters=3, reps=8):
+    if dtype == jnp.float32:
+        a = jnp.asarray(np.random.rand(ROWS, COLS) * 0.001 + 1.0, dtype)
+        b = jnp.asarray(np.random.rand(ROWS, COLS), dtype)
+    else:
+        a = jnp.asarray(np.random.randint(1, 3, (ROWS, COLS)), dtype)
+        b = jnp.asarray(np.random.randint(0, 100, (ROWS, COLS)), dtype)
+    f = pl.pallas_call(
+        make_kernel(op),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+    def rep(x, y):
+        o = f(x, y)
+        for _ in range(reps - 1):
+            o = f(x, o)
+        return o
+    g = jax.jit(rep)
+    jax.block_until_ready(g(a, b))
+    best = 1e9
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(g(a, b))
+        best = min(best, time.perf_counter() - t0)
+    ops = ROWS * COLS * K * reps
+    print(f"{name:24s} {best*1e3:8.2f} ms  {ops/best/1e9:8.1f} Gelem-op/s")
+
+
+def main():
+    print(f"block {ROWS}x{COLS}, chain {K}")
+    bench("f32 mul", lambda x, a, b: x * a, jnp.float32)
+    bench("f32 fma (x*a+b)", lambda x, a, b: x * a + b, jnp.float32)
+    bench("f32 add", lambda x, a, b: x + a, jnp.float32)
+    bench("int32 mul", lambda x, a, b: x * a, jnp.int32)
+    bench("int32 add", lambda x, a, b: x + a, jnp.int32)
+    bench("int32 and", lambda x, a, b: x & a, jnp.int32)
+    bench("int32 shr13", lambda x, a, b: (x >> 13) + a, jnp.int32)
+    bench("int32 mul+add", lambda x, a, b: x * a + b, jnp.int32)
+    bench("uint32 mul", lambda x, a, b: x * a, jnp.uint32)
+    # f32 carry step: x - floor(x * inv) * r  (2 ops + floor)
+    inv = 1.0 / 8192.0
+    r = 8192.0
+    bench("f32 carry (floor)", lambda x, a, b: x - jnp.floor(x * inv) * r + a,
+          jnp.float32)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Sanity-checked VPU throughput: vary inputs per call, check K scaling."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS, COLS = 256, 1024
+
+
+def run(name, op, dtype, K):
+    def kernel(a_ref, b_ref, o_ref):
+        a = a_ref[:]
+
+        def body(i, x):
+            return op(x, a)
+        o_ref[:] = jax.lax.fori_loop(0, K, body, b_ref[:])
+
+    f = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+    g = jax.jit(lambda x, y: f(x, f(x, f(x, f(x, y)))))
+    if dtype == jnp.float32:
+        a = jnp.asarray(np.random.rand(ROWS, COLS) * 1e-8 + 1.0, dtype)
+        b = jnp.asarray(np.random.rand(ROWS, COLS), dtype)
+    else:
+        a = jnp.asarray(np.random.randint(1, 100, (ROWS, COLS)), dtype)
+        b = jnp.asarray(np.random.randint(0, 100, (ROWS, COLS)), dtype)
+    jax.block_until_ready(g(a, b))
+    best = 1e9
+    for _ in range(4):
+        b2 = b + np.random.randint(1, 10)      # new value each call
+        t0 = time.perf_counter()
+        jax.block_until_ready(g(a, b2))
+        best = min(best, time.perf_counter() - t0)
+    ops = ROWS * COLS * K * 4
+    print(f"{name:18s} K={K:6d}  {best*1e3:8.2f} ms  {ops/best/1e9:8.0f} Gop/s")
+
+
+for K in (1024, 8192):
+    run("f32 mul", lambda x, a: x * a, jnp.float32, K)
+    run("f32 fma", lambda x, a: x * a + a, jnp.float32, K)
+    run("int32 mul", lambda x, a: x * a, jnp.int32, K)
+    run("int32 add", lambda x, a: x + a, jnp.int32, K)

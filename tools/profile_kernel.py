@@ -1,0 +1,105 @@
+"""Phase-level timing attribution for the ed25519 verify kernel on TPU.
+
+Times each sub-phase of `verify_batch` separately (jitted, warmed) so we
+know where the 685 ms/batch goes: SHA-512, decompression, the double
+scalar-mul, and the final encode/invert. Run on the real chip:
+
+    python tools/profile_kernel.py [batch] [msg_len]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import fe25519 as fe
+from firedancer_tpu.ops.sha2 import sha512
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+MSG_LEN = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+
+
+def bench(name, fn, *args, iters=4):
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:28s} {dt*1e3:10.2f} ms  ({BATCH/dt:12.0f}/s)  compile {compile_s:6.1f}s")
+    return out
+
+
+def main():
+    print(f"devices={jax.devices()} batch={BATCH} msg_len={MSG_LEN}")
+    rng = np.random.default_rng(0)
+    sig = jnp.asarray(rng.integers(0, 256, (BATCH, 64), dtype=np.uint8))
+    pub = jnp.asarray(rng.integers(0, 256, (BATCH, 32), dtype=np.uint8))
+    msg = jnp.asarray(rng.integers(0, 256, (BATCH, MSG_LEN), dtype=np.uint8))
+    mlen = jnp.full((BATCH,), MSG_LEN, jnp.int32)
+
+    # full kernel
+    vb = jax.jit(lambda s, p, m, l: ed.verify_batch(s, p, m, l))
+    bench("verify_batch (full)", vb, sig, pub, msg, mlen)
+
+    # phase 1: sha512 of (R || A || msg)
+    kmsg = jnp.concatenate([sig[:, :32], pub, msg], axis=-1)
+    f_sha = jax.jit(lambda m, l: sha512(m, l))
+    bench("sha512", f_sha, kmsg, mlen + 64)
+
+    # phase 2: sc_reduce64
+    dig = jax.block_until_ready(f_sha(kmsg, mlen + 64))
+    f_red = jax.jit(ed.sc_reduce64)
+    bench("sc_reduce64", f_red, dig)
+
+    # phase 3: decompress (one pow chain)
+    f_dec = jax.jit(lambda b: ed.decompress(b))
+    bench("decompress(A)", f_dec, pub)
+
+    # phase 4: double scalar mul
+    k_digits = jax.block_until_ready(f_red(dig))
+    s_digits, _ = ed.sc_from_bytes32(sig[:, 32:])
+    a_pt, _ = jax.block_until_ready(f_dec(pub))
+    s_w = jax.block_until_ready(jax.jit(ed.sc_windows4)(s_digits))
+    k_w = jax.block_until_ready(jax.jit(ed.sc_windows4)(k_digits))
+
+    f_dsm = jax.jit(lambda sw, kw, a: ed._double_scalar_mul(sw, kw, ed.pt_neg(a)))
+    rp = bench("double_scalar_mul", f_dsm, s_w, k_w, a_pt)
+
+    # phase 5: encode (invert chain + canonical)
+    f_enc = jax.jit(ed.pt_tobytes)
+    bench("pt_tobytes (invert+enc)", f_enc, rp)
+
+    # micro: one field mul / one pt_add / one pt_dbl at batch
+    a = jnp.asarray(rng.integers(0, 8192, (BATCH, fe.NLIMB), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 8192, (BATCH, fe.NLIMB), dtype=np.int32))
+    f_mul = jax.jit(fe.mul)
+    bench("fe.mul x1", f_mul, a, b, iters=20)
+
+    def mul_chain(a, b):
+        for _ in range(100):
+            a = fe.mul(a, b)
+        return a
+    bench("fe.mul x100 (chain)", jax.jit(mul_chain), a, b)
+
+    pt = (a_pt[0], a_pt[1], a_pt[2], a_pt[3])
+    bench("pt_dbl x100", jax.jit(lambda p: _chain(ed.pt_dbl, p, 100)), pt)
+    bench("pt_add x100",
+          jax.jit(lambda p: _chain(lambda q: ed.pt_add(q, pt), p, 100)), pt)
+
+    # pow chain alone
+    bench("pow_const (p-5)/8", jax.jit(lambda x: fe.pow_const(x, (fe.P - 5) // 8)), a)
+
+
+def _chain(f, p, n):
+    for _ in range(n):
+        p = f(p)
+    return p
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""Dispatch-overhead-corrected phase attribution (in-graph repeats).
+
+Each phase runs R times inside one jit via lax.fori_loop with data
+dependence, so one dispatch amortizes the ~60 ms tunnel latency.
+
+    python tools/profile_kernel2.py [batch] [msg_len] [repeats]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import fe25519 as fe
+from firedancer_tpu.ops.sha2 import sha512
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+MSG_LEN = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+R = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+
+def timed(name, fn, *args, iters=3):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    compile_s = time.perf_counter() - t0
+    best = 1e9
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    per = best / R
+    print(f"{name:28s} {per*1e3:9.2f} ms/run ({BATCH/per:12.0f}/s) "
+          f"[dispatch {best*1e3:8.1f} ms, compile {compile_s:5.1f}s]")
+    return per
+
+
+def rep(body, x0):
+    """Run body R times with data dependence inside one jit."""
+    def f(x):
+        return jax.lax.fori_loop(0, R, lambda i, v: body(v), x)
+    return jax.jit(f), x0
+
+
+def main():
+    print(f"devices={jax.devices()} batch={BATCH} msg_len={MSG_LEN} repeats={R}")
+    rng = np.random.default_rng(0)
+    sig = jnp.asarray(rng.integers(0, 256, (BATCH, 64), dtype=np.uint8))
+    pub = jnp.asarray(rng.integers(0, 256, (BATCH, 32), dtype=np.uint8))
+    msg = jnp.asarray(rng.integers(0, 256, (BATCH, MSG_LEN), dtype=np.uint8))
+    mlen = jnp.full((BATCH,), MSG_LEN, jnp.int32)
+
+    # overhead: trivial op
+    f0, x0 = rep(lambda v: v + 1, jnp.zeros((8,), jnp.int32))
+    t0 = time.perf_counter(); jax.block_until_ready(f0(x0))
+    t0 = time.perf_counter(); jax.block_until_ready(f0(x0))
+    print(f"dispatch overhead (trivial):  {(time.perf_counter()-t0)*1e3:.1f} ms")
+
+    # full verify, repeated with perturbed message so nothing is elided
+    def vb_body(m):
+        ok = ed.verify_batch(sig, pub, m, mlen)
+        return m.at[:, 0].set(ok.astype(jnp.uint8))
+    f, x = rep(vb_body, msg)
+    timed("verify_batch (full)", f, x, iters=2)
+
+    # sha512 at msg len
+    kmsg = jnp.concatenate([sig[:, :32], pub, msg], axis=-1)
+    def sha_body(m):
+        d = sha512(m, mlen + 64)
+        return m.at[:, 0].set(d[:, 0])
+    f, x = rep(sha_body, kmsg)
+    timed("sha512", f, x)
+
+    # sc_reduce64
+    dig = jax.block_until_ready(jax.jit(sha512)(kmsg, mlen + 64))
+    def red_body(d):
+        z = ed.sc_reduce64(d)
+        return d.at[:, 0].set(z[:, 0].astype(jnp.uint8))
+    f, x = rep(red_body, dig)
+    timed("sc_reduce64", f, x)
+
+    # decompress
+    def dec_body(b):
+        (xx, yy, zz, tt), ok = ed.decompress(b)
+        return b.at[:, 0].set(xx[:, 0].astype(jnp.uint8))
+    f, x = rep(dec_body, pub)
+    timed("decompress(A)", f, x)
+
+    # double scalar mul
+    s_digits, _ = ed.sc_from_bytes32(sig[:, 32:])
+    k_digits = jax.block_until_ready(jax.jit(ed.sc_reduce64)(dig))
+    a_pt, _ = jax.block_until_ready(jax.jit(lambda b: ed.decompress(b))(pub))
+    s_w = jax.block_until_ready(jax.jit(ed.sc_windows4)(s_digits))
+    k_w = jax.block_until_ready(jax.jit(ed.sc_windows4)(k_digits))
+
+    def dsm_body(sw):
+        p = ed._double_scalar_mul(sw, k_w, ed.pt_neg(a_pt))
+        return sw.at[:, 0].set(p[0][:, 0])
+    f, x = rep(dsm_body, s_w)
+    timed("double_scalar_mul", f, x, iters=2)
+
+    # encode
+    rp = jax.block_until_ready(jax.jit(
+        lambda sw, kw: ed._double_scalar_mul(sw, kw, ed.pt_neg(a_pt)))(s_w, k_w))
+    def enc_body(p):
+        b = ed.pt_tobytes(p)
+        return tuple(c.at[..., 0].set(b[:, 0].astype(jnp.int32)) for c in p)
+    f, x = rep(enc_body, rp)
+    timed("pt_tobytes (invert+enc)", f, x)
+
+    # micro: fe.mul chain of 64 inside fori body
+    a = jnp.asarray(rng.integers(0, 8192, (BATCH, fe.NLIMB), dtype=np.int32))
+    b = jnp.asarray(rng.integers(0, 8192, (BATCH, fe.NLIMB), dtype=np.int32))
+    def mul64(v):
+        for _ in range(64):
+            v = fe.mul(v, b)
+        return v
+    f, x = rep(mul64, a)
+    per = timed("fe.mul x64", f, x)
+    print(f"  -> one batched fe.mul: {per/64*1e6:.0f} us "
+          f"({per/64/BATCH*1e9:.1f} ns/lane)")
+
+    # micro: pt_dbl / pt_add chains of 16 (scan to bound compile)
+    def dbln(p):
+        q, _ = jax.lax.scan(lambda c, _: (ed.pt_dbl(c), None), p, None, length=64)
+        return q
+    f, x = rep(dbln, a_pt)
+    per = timed("pt_dbl x64 (scan)", f, x)
+    print(f"  -> one batched pt_dbl: {per/64*1e6:.0f} us")
+
+    def addn(p):
+        q, _ = jax.lax.scan(lambda c, _: (ed.pt_add(c, a_pt), None), p, None,
+                            length=64)
+        return q
+    f, x = rep(addn, a_pt)
+    per = timed("pt_add x64 (scan)", f, x)
+    print(f"  -> one batched pt_add: {per/64*1e6:.0f} us")
+
+    # pow chain
+    def powb(v):
+        return fe.pow_const(v, (fe.P - 5) // 8)
+    f, x = rep(powb, a)
+    timed("pow_const (p-5)/8", f, x)
+
+
+if __name__ == "__main__":
+    main()
