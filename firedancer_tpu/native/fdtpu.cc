@@ -416,4 +416,242 @@ uint64_t fdtpu_ticks(void) {
   return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
 }
 
+/* ---- batched txn parse + verify lane assembly --------------------------
+ *
+ * The verify tile's host hot path: at target ingest rates a per-txn
+ * Python loop is the bottleneck (SURVEY language rule: no Python
+ * stand-ins on the native hot path), so parsing, dedup-tag hashing and
+ * device-lane assembly run here over the whole gathered batch.
+ * Semantics mirror protocol/txn.py::parse_txn exactly (which itself
+ * mirrors the reference zero-copy parser, ref:
+ * src/ballet/txn/fd_txn.h:181-227, fd_txn_parse.c) — the Python parser
+ * remains the spec; tests/test_txn.py fuzzes the two against each other.
+ */
+
+namespace {
+
+constexpr int kMtu = 1232;
+constexpr int kSigMax = 12;
+constexpr int kAcctMax = 128;
+constexpr int kInstrMax = 64;
+
+/* compact-u16: 1-3 byte varint, minimal encoding enforced */
+inline bool cu16(const uint8_t *p, int len, int *off, uint32_t *out) {
+  uint32_t v = 0;
+  for (int i = 0; i < 3; i++) {
+    if (*off >= len) return false;
+    uint8_t b = p[(*off)++];
+    v |= (uint32_t)(b & 0x7F) << (7 * i);
+    if (!(b & 0x80)) {
+      if (i == 2 && b > 0x03) return false;
+      if (i > 0 && b == 0) return false;   /* non-minimal */
+      *out = v;
+      return true;
+    }
+  }
+  return false;
+}
+
+/* SipHash-1-3 (public domain algorithm; short-input keyed hash).
+ * Plays the role of the reference's seeded fd_hash dedup tag
+ * (ref: src/disco/verify/fd_verify_tile.h:82). */
+inline uint64_t rotl64(uint64_t x, int b) { return (x << b) | (x >> (64 - b)); }
+
+uint64_t siphash13(uint64_t k0, uint64_t k1, const uint8_t *data, size_t len) {
+  uint64_t v0 = 0x736f6d6570736575ull ^ k0;
+  uint64_t v1 = 0x646f72616e646f6dull ^ k1;
+  uint64_t v2 = 0x6c7967656e657261ull ^ k0;
+  uint64_t v3 = 0x7465646279746573ull ^ k1;
+  auto round = [&]() {
+    v0 += v1; v1 = rotl64(v1, 13); v1 ^= v0; v0 = rotl64(v0, 32);
+    v2 += v3; v3 = rotl64(v3, 16); v3 ^= v2;
+    v0 += v3; v3 = rotl64(v3, 21); v3 ^= v0;
+    v2 += v1; v1 = rotl64(v1, 17); v1 ^= v2; v2 = rotl64(v2, 32);
+  };
+  size_t n = len & ~7ull;
+  for (size_t i = 0; i < n; i += 8) {
+    uint64_t m;
+    std::memcpy(&m, data + i, 8);
+    v3 ^= m;
+    round();
+    v0 ^= m;
+  }
+  uint64_t b = (uint64_t)len << 56;
+  for (size_t i = n; i < len; i++) b |= (uint64_t)data[i] << (8 * (i - n));
+  v3 ^= b; round(); v0 ^= b;
+  v2 ^= 0xff; round(); round(); round();
+  return v0 ^ v1 ^ v2 ^ v3;
+}
+
+struct TxnMeta {
+  int32_t ok;        /* 1 = parsed */
+  int32_t sig_cnt;
+  int32_t sig_off;
+  int32_t msg_off;
+  int32_t acct_off;
+  int32_t acct_cnt;
+  int32_t version;   /* -1 legacy, 0 = v0 */
+  int32_t hdr;       /* n_signed | n_ro_signed<<8 | n_ro_unsigned<<16 */
+};
+static_assert(sizeof(TxnMeta) == 32, "meta ABI");
+
+bool parse_one(const uint8_t *p, int len, TxnMeta *m) {
+  if (len > kMtu) return false;
+  int off = 0;
+  uint32_t sig_cnt;
+  if (!cu16(p, len, &off, &sig_cnt)) return false;
+  if (sig_cnt < 1 || sig_cnt > kSigMax) return false;
+  int sig_off = off;
+  off += 64 * (int)sig_cnt;
+  if (off > len) return false;
+  int msg_off = off;
+  if (off >= len) return false;
+  int version = -1;
+  if (p[off] & 0x80) {
+    version = p[off] & 0x7F;
+    if (version != 0) return false;
+    off++;
+  }
+  if (off + 3 > len) return false;
+  uint32_t n_signed = p[off], n_ro_signed = p[off + 1],
+           n_ro_unsigned = p[off + 2];
+  off += 3;
+  if (n_signed != sig_cnt) return false;
+  if (n_ro_signed >= n_signed) return false;
+  uint32_t acct_cnt;
+  if (!cu16(p, len, &off, &acct_cnt)) return false;
+  if (acct_cnt < n_signed || acct_cnt > kAcctMax) return false;
+  if (n_ro_unsigned > acct_cnt - n_signed) return false;
+  int acct_off = off;
+  off += 32 * (int)acct_cnt;
+  if (off > len) return false;
+  off += 32;                              /* blockhash */
+  if (off > len) return false;
+  uint32_t instr_cnt;
+  if (!cu16(p, len, &off, &instr_cnt)) return false;
+  if (instr_cnt > kInstrMax) return false;
+  for (uint32_t i = 0; i < instr_cnt; i++) {
+    if (off >= len) return false;
+    uint8_t prog_idx = p[off++];
+    if (prog_idx >= acct_cnt) return false;
+    uint32_t n_acct;
+    if (!cu16(p, len, &off, &n_acct)) return false;
+    if (off + (int)n_acct > len) return false;
+    for (uint32_t a = 0; a < n_acct; a++)
+      if (p[off + (int)a] >= acct_cnt) return false;
+    off += (int)n_acct;
+    uint32_t n_data;
+    if (!cu16(p, len, &off, &n_data)) return false;
+    off += (int)n_data;
+    if (off > len) return false;
+  }
+  if (version == 0) {
+    uint32_t alut_cnt;
+    if (!cu16(p, len, &off, &alut_cnt)) return false;
+    for (uint32_t i = 0; i < alut_cnt; i++) {
+      off += 32;
+      if (off > len) return false;
+      uint32_t n_w;
+      if (!cu16(p, len, &off, &n_w)) return false;
+      off += (int)n_w;
+      uint32_t n_ro;
+      if (!cu16(p, len, &off, &n_ro)) return false;
+      off += (int)n_ro;
+      if (off > len) return false;
+    }
+  }
+  if (off != len) return false;           /* trailing bytes */
+  m->ok = 1;
+  m->sig_cnt = (int32_t)sig_cnt;
+  m->sig_off = sig_off;
+  m->msg_off = msg_off;
+  m->acct_off = acct_off;
+  m->acct_cnt = (int32_t)acct_cnt;
+  m->version = version;
+  m->hdr = (int32_t)(n_signed | (n_ro_signed << 8) | (n_ro_unsigned << 16));
+  return true;
+}
+
+}  // namespace
+
+/* Parse a gathered batch; fill meta (n x 8 int32) and dedup tags (n u64,
+ * SipHash-1-3 of the full 64-byte first signature, per-boot seeded).
+ * Returns count of successfully parsed txns. */
+int64_t fdtpu_txn_parse_batch(const uint8_t *buf, const uint32_t *sizes,
+                              int64_t n, uint64_t stride,
+                              uint64_t seed0, uint64_t seed1,
+                              int32_t *meta_out, uint64_t *tags_out) {
+  int64_t ok_cnt = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t *p = buf + (uint64_t)i * stride;
+    TxnMeta *m = reinterpret_cast<TxnMeta *>(meta_out + 8 * i);
+    std::memset(m, 0, sizeof(*m));
+    if (parse_one(p, (int)sizes[i], m)) {
+      tags_out[i] = siphash13(seed0, seed1, p + m->sig_off, 64);
+      ok_cnt++;
+    } else {
+      tags_out[i] = 0;
+    }
+  }
+  return ok_cnt;
+}
+
+/* Fill device verify lanes from parsed batch. One lane per signature of
+ * every parsed, non-skipped txn, starting at txn *cursor_io. Stops when
+ * lanes are full or txns exhausted; advances *cursor_io past consumed
+ * txns (a txn's sigs never split across chunks). Unused lanes are zeroed
+ * (dead lanes, masked by the caller). Returns lanes filled.
+ * lane_txn[j] = source txn index. */
+int64_t fdtpu_verify_assemble(const uint8_t *buf, const uint32_t *sizes,
+                              const int32_t *meta, const uint8_t *skip,
+                              int64_t n, uint64_t stride,
+                              int64_t *cursor_io, int64_t cap,
+                              uint64_t max_len,
+                              uint8_t *lane_sig, uint8_t *lane_pub,
+                              uint8_t *lane_msg, int32_t *lane_len,
+                              int32_t *lane_txn) {
+  int64_t lanes = 0;
+  int64_t i = *cursor_io;
+  for (; i < n; i++) {
+    const TxnMeta *m = reinterpret_cast<const TxnMeta *>(meta + 8 * i);
+    if (!m->ok || (skip && skip[i])) continue;
+    if (lanes + m->sig_cnt > cap) break;
+    const uint8_t *p = buf + (uint64_t)i * stride;
+    uint32_t msg_len = sizes[i] - (uint32_t)m->msg_off;
+    if (msg_len > max_len) continue;      /* cannot fit: drop (over-MTU) */
+    for (int s = 0; s < m->sig_cnt; s++) {
+      std::memcpy(lane_sig + 64 * lanes, p + m->sig_off + 64 * s, 64);
+      std::memcpy(lane_pub + 32 * lanes, p + m->acct_off + 32 * s, 32);
+      std::memcpy(lane_msg + max_len * lanes, p + m->msg_off, msg_len);
+      std::memset(lane_msg + max_len * lanes + msg_len, 0, max_len - msg_len);
+      lane_len[lanes] = (int32_t)msg_len;
+      lane_txn[lanes] = (int32_t)i;
+      lanes++;
+    }
+  }
+  /* zero dead lanes' lengths + map */
+  for (int64_t j = lanes; j < cap; j++) {
+    lane_len[j] = 0;
+    lane_txn[j] = -1;
+  }
+  *cursor_io = i;
+  return lanes;
+}
+
+int fdtpu_tcache_query_batch(void *base, uint64_t off, const uint64_t *tags,
+                             const uint8_t *mask, int64_t n, uint8_t *hit) {
+  for (int64_t i = 0; i < n; i++)
+    hit[i] = (mask && !mask[i]) ? 0
+             : (uint8_t)fdtpu_tcache_query(base, off, tags[i]);
+  return 0;
+}
+
+int fdtpu_tcache_insert_batch(void *base, uint64_t off, const uint64_t *tags,
+                              const uint8_t *mask, int64_t n, uint8_t *dup) {
+  for (int64_t i = 0; i < n; i++)
+    dup[i] = (mask && !mask[i]) ? 0
+             : (uint8_t)fdtpu_tcache_insert(base, off, tags[i]);
+  return 0;
+}
+
 }  /* extern "C" */

@@ -160,6 +160,35 @@ int64_t fdtpu_ring_gather(void *base, uint64_t ring_off, uint64_t *seq_io,
 /* Tick counter (ns). */
 uint64_t fdtpu_ticks(void);
 
+/* Batched txn parse over a gathered buffer (full wire validation, same
+ * contract as protocol/txn.py::parse_txn). meta_out: n x 8 int32 records
+ * {ok, sig_cnt, sig_off, msg_off, acct_off, acct_cnt, version, hdr};
+ * tags_out: n u64 seeded SipHash-1-3 dedup tags over the first 64-byte
+ * signature. Returns number parsed ok. */
+int64_t fdtpu_txn_parse_batch(const uint8_t *buf, const uint32_t *sizes,
+                              int64_t n, uint64_t stride,
+                              uint64_t seed0, uint64_t seed1,
+                              int32_t *meta_out, uint64_t *tags_out);
+
+/* Fill fixed-shape device verify lanes (one lane per signature) from the
+ * parsed batch, skipping txns with skip[i] != 0. Chunk-able via
+ * *cursor_io; a txn's sigs never split across chunks. Returns lanes
+ * filled; dead lanes zeroed, lane_txn[j] = -1. */
+int64_t fdtpu_verify_assemble(const uint8_t *buf, const uint32_t *sizes,
+                              const int32_t *meta, const uint8_t *skip,
+                              int64_t n, uint64_t stride,
+                              int64_t *cursor_io, int64_t cap,
+                              uint64_t max_len,
+                              uint8_t *lane_sig, uint8_t *lane_pub,
+                              uint8_t *lane_msg, int32_t *lane_len,
+                              int32_t *lane_txn);
+
+/* Batch tcache presence/insert (mask: optional per-txn enable). */
+int fdtpu_tcache_query_batch(void *base, uint64_t off, const uint64_t *tags,
+                             const uint8_t *mask, int64_t n, uint8_t *hit);
+int fdtpu_tcache_insert_batch(void *base, uint64_t off, const uint64_t *tags,
+                              const uint8_t *mask, int64_t n, uint8_t *dup);
+
 #ifdef __cplusplus
 }
 #endif

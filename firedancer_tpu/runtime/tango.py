@@ -63,6 +63,26 @@ def _load():
                                     ct.POINTER(u32), ct.POINTER(u64),
                                     ct.POINTER(u64)]),
         "fdtpu_ticks": (u64, []),
+        "fdtpu_txn_parse_batch": (i64, [ct.POINTER(ct.c_uint8),
+                                        ct.POINTER(u32), i64, u64, u64, u64,
+                                        ct.POINTER(ct.c_int32),
+                                        ct.POINTER(u64)]),
+        "fdtpu_verify_assemble": (i64, [ct.POINTER(ct.c_uint8),
+                                        ct.POINTER(u32),
+                                        ct.POINTER(ct.c_int32),
+                                        ct.POINTER(ct.c_uint8), i64, u64,
+                                        ct.POINTER(i64), i64, u64,
+                                        ct.POINTER(ct.c_uint8),
+                                        ct.POINTER(ct.c_uint8),
+                                        ct.POINTER(ct.c_uint8),
+                                        ct.POINTER(ct.c_int32),
+                                        ct.POINTER(ct.c_int32)]),
+        "fdtpu_tcache_query_batch": (ct.c_int, [vp, u64, ct.POINTER(u64),
+                                                ct.POINTER(ct.c_uint8), i64,
+                                                ct.POINTER(ct.c_uint8)]),
+        "fdtpu_tcache_insert_batch": (ct.c_int, [vp, u64, ct.POINTER(u64),
+                                                 ct.POINTER(ct.c_uint8), i64,
+                                                 ct.POINTER(ct.c_uint8)]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -256,3 +276,30 @@ class Tcache:
     def insert(self, tag: int) -> bool:
         """True iff tag was already present (duplicate)."""
         return bool(lib.fdtpu_tcache_insert(self.wksp.base, self.off, tag))
+
+    def query_batch(self, tags, mask=None):
+        """tags (n,) uint64 -> (n,) uint8 hit flags (native loop)."""
+        import numpy as np
+        tags = np.ascontiguousarray(tags, np.uint64)
+        hit = np.zeros(len(tags), np.uint8)
+        mp = (mask.ctypes.data_as(ct.POINTER(ct.c_uint8))
+              if mask is not None else None)
+        lib.fdtpu_tcache_query_batch(
+            self.wksp.base, self.off,
+            tags.ctypes.data_as(ct.POINTER(ct.c_uint64)), mp, len(tags),
+            hit.ctypes.data_as(ct.POINTER(ct.c_uint8)))
+        return hit
+
+    def insert_batch(self, tags, mask=None):
+        """tags (n,) uint64 -> (n,) uint8 was-duplicate flags. mask: only
+        insert where mask[i] != 0."""
+        import numpy as np
+        tags = np.ascontiguousarray(tags, np.uint64)
+        dup = np.zeros(len(tags), np.uint8)
+        mp = (mask.ctypes.data_as(ct.POINTER(ct.c_uint8))
+              if mask is not None else None)
+        lib.fdtpu_tcache_insert_batch(
+            self.wksp.base, self.off,
+            tags.ctypes.data_as(ct.POINTER(ct.c_uint64)), mp, len(tags),
+            dup.ctypes.data_as(ct.POINTER(ct.c_uint8)))
+        return dup

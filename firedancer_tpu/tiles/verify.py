@@ -27,14 +27,39 @@ attached, the tile spins for credits instead of silently lapping them
 """
 from __future__ import annotations
 
-import hashlib
+import ctypes as ct
 import os
 import time
 
 import numpy as np
 
-from ..protocol.txn import parse_txn, TxnParseError, MTU
+from ..protocol.txn import MTU
 from ..runtime import Ring, Tcache
+from ..runtime.tango import lib as _lib
+
+_u8p = ct.POINTER(ct.c_uint8)
+_i32p = ct.POINTER(ct.c_int32)
+_u32p = ct.POINTER(ct.c_uint32)
+_u64p = ct.POINTER(ct.c_uint64)
+
+
+def parse_batch(buf: np.ndarray, sizes: np.ndarray, seed: bytes):
+    """Native batched txn parse + seeded dedup-tag hash.
+
+    buf (n, stride) uint8, sizes (n,) uint32 -> (meta (n,8) int32,
+    tags (n,) uint64). meta[:,0] is the parse-ok flag; layout per
+    native/fdtpu.h::fdtpu_txn_parse_batch."""
+    n, stride = buf.shape
+    buf = np.ascontiguousarray(buf)
+    sizes = np.ascontiguousarray(sizes, np.uint32)
+    meta = np.zeros((n, 8), np.int32)
+    tags = np.zeros((n,), np.uint64)
+    s0 = int.from_bytes(seed[:8], "little")
+    s1 = int.from_bytes(seed[8:16], "little")
+    _lib.fdtpu_txn_parse_batch(
+        buf.ctypes.data_as(_u8p), sizes.ctypes.data_as(_u32p), n, stride,
+        s0, s1, meta.ctypes.data_as(_i32p), tags.ctypes.data_as(_u64p))
+    return meta, tags
 
 
 class VerifyTile:
@@ -43,6 +68,11 @@ class VerifyTile:
                  backend: str = "jax", out_fseqs=None,
                  dedup_seed: bytes | None = None):
         self.in_ring, self.out_ring, self.tcache = in_ring, out_ring, tcache
+        # a txn's sig lanes never split across device chunks, so the
+        # chunk must hold the max per-txn signature count (SIG_MAX=12,
+        # protocol/txn.py) or a 13-lane txn could wedge lane assembly
+        if batch < 12:
+            raise ValueError(f"verify batch {batch} < max sig_cnt 12")
         self.batch, self.max_len = batch, max_len
         self.out_fseqs = list(out_fseqs or [])
         # per-boot random seed: tags are unpredictable to senders
@@ -56,10 +86,27 @@ class VerifyTile:
         }
         if backend == "jax":
             import jax
-            from ..ops.ed25519 import verify_batch
-            self._fn = jax.jit(verify_batch)
+            if jax.devices()[0].platform == "cpu":
+                from ..ops.ed25519 import verify_batch
+                self._fn = jax.jit(verify_batch)
+            else:
+                # fused Pallas kernels on accelerator backends
+                from ..ops.pallas_ed import verify_batch as vb
+                self._fn = jax.jit(lambda s, p, m, l: vb(s, p, m, l))
         else:
             raise ValueError(backend)
+        # preallocated device-lane buffers (fixed compiled shape)
+        self._lane_sig = np.zeros((batch, 64), np.uint8)
+        self._lane_pub = np.zeros((batch, 32), np.uint8)
+        self._lane_msg = np.zeros((batch, max_len), np.uint8)
+        self._lane_len = np.zeros((batch,), np.int32)
+        self._lane_txn = np.zeros((batch,), np.int32)
+        # warm the compile NOW, before the stem declares RUN — tile
+        # startup gates on it (the reference does privileged/slow init
+        # before signaling the cnc, src/disco/topo/fd_topo_run.c), so
+        # the first real batch never stalls a minute inside poll_once
+        self._device_verify(self._lane_sig, self._lane_pub,
+                            self._lane_msg, self._lane_len)
 
     def _device_verify(self, sig, pub, msg, ln):
         import jax.numpy as jnp
@@ -67,16 +114,16 @@ class VerifyTile:
                        jnp.asarray(msg), jnp.asarray(ln))
         return np.asarray(out)
 
-    def _tag(self, payload: bytes, t) -> int:
-        """Seeded hash of the full 64-byte first signature."""
-        h = hashlib.blake2b(payload[t.sig_off:t.sig_off + 64],
-                            digest_size=8, key=self.dedup_seed)
-        return int.from_bytes(h.digest(), "little")
-
     def poll_once(self) -> int:
         """Gather -> parse -> ha-dedup -> device verify -> publish.
-        Returns number of frags CONSUMED (0 only when the ring was idle,
-        so the stem loop can distinguish idle from drop-heavy traffic)."""
+
+        The whole host side is batched: one native call parses + tags the
+        gathered frame set (fdtpu_txn_parse_batch), one native call per
+        device chunk assembles lanes (fdtpu_verify_assemble), and tcache
+        query/insert run as native batch loops — no per-txn Python on the
+        hot path (the reference's host path is C for the same reason,
+        src/disco/verify/fd_verify_tile.h:60-111).
+        Returns number of frags CONSUMED (0 only when the ring was idle)."""
         n, self.seq, buf, sizes, sigs, ovr = self.in_ring.gather(
             self.seq, self.batch, self.max_len)
         self.metrics["overruns"] += ovr
@@ -84,62 +131,71 @@ class VerifyTile:
             return 0
         self.metrics["rx"] += n
 
-        # host parse + ha-dedup query on first sig BEFORE spending device
-        # lanes (ref order: src/disco/verify/fd_verify_tile.h:84-94)
-        lanes = []                   # (txn_idx, sig, pub, msg)
-        parsed = {}
-        for i in range(n):
-            payload = bytes(buf[i, : sizes[i]])
-            try:
-                t = parse_txn(payload)
-            except (TxnParseError, ValueError, IndexError):
-                # any malformed wire bytes are a drop, never a crash
-                self.metrics["parse_fail"] += 1
-                continue
-            tag = self._tag(payload, t)
-            if self.tcache.query(tag):
-                self.metrics["dedup_drop"] += 1
-                continue
-            msg = t.message(payload)
-            for s, p in zip(t.signatures(payload),
-                            t.signer_pubkeys(payload)):
-                lanes.append((i, s, p, msg))
-            parsed[i] = (payload, tag)
-        if not lanes:
+        buf = buf[:n]
+        sizes = np.asarray(sizes[:n], np.uint32)
+        meta, tags = parse_batch(buf, sizes, self.dedup_seed)
+        ok = meta[:, 0] != 0
+        self.metrics["parse_fail"] += int(n - ok.sum())
+
+        # ha-dedup query BEFORE spending device lanes; insert only AFTER
+        # verify (ref order: src/disco/verify/fd_verify_tile.h:84-101)
+        hit = self.tcache.query_batch(tags, mask=ok.astype(np.uint8))
+        dup_pre = ok & (hit != 0)
+        self.metrics["dedup_drop"] += int(dup_pre.sum())
+        skip = np.ascontiguousarray(~ok | dup_pre).astype(np.uint8)
+        cand = ok & ~dup_pre
+        if not cand.any():
             return n
 
-        # device verify in fixed-shape chunks; dead lanes padded and masked
-        txn_ok = {i: True for i in parsed}
-        for c0 in range(0, len(lanes), self.batch):
-            chunk = lanes[c0:c0 + self.batch]
-            lane_sig = np.zeros((self.batch, 64), np.uint8)
-            lane_pub = np.zeros((self.batch, 32), np.uint8)
-            lane_msg = np.zeros((self.batch, self.max_len), np.uint8)
-            lane_len = np.zeros((self.batch,), np.int32)
-            for j, (_, s, p, m) in enumerate(chunk):
-                lane_sig[j] = np.frombuffer(s, np.uint8)
-                lane_pub[j] = np.frombuffer(p, np.uint8)
-                lane_msg[j, : len(m)] = np.frombuffer(m, np.uint8)
-                lane_len[j] = len(m)
-            ok = self._device_verify(lane_sig, lane_pub, lane_msg, lane_len)
+        # device verify in fixed-shape chunks (native lane assembly).
+        # FAIL-CLOSED: a candidate txn counts as verified only if every
+        # one of its signature lanes ran on the device AND passed; any
+        # txn the assembler skips (over-MTU msg) or cannot place is
+        # dropped, never forwarded unverified.
+        txn_ok = cand.copy()
+        covered = np.zeros(n, bool)
+        cursor = ct.c_int64(0)
+        while cursor.value < n:
+            lanes = _lib.fdtpu_verify_assemble(
+                np.ascontiguousarray(buf).ctypes.data_as(_u8p),
+                sizes.ctypes.data_as(_u32p),
+                meta.ctypes.data_as(_i32p), skip.ctypes.data_as(_u8p),
+                n, buf.shape[1], ct.byref(cursor), self.batch,
+                self.max_len,
+                self._lane_sig.ctypes.data_as(_u8p),
+                self._lane_pub.ctypes.data_as(_u8p),
+                self._lane_msg.ctypes.data_as(_u8p),
+                self._lane_len.ctypes.data_as(_i32p),
+                self._lane_txn.ctypes.data_as(_i32p))
+            if not lanes:
+                break
+            lane_ok = self._device_verify(
+                self._lane_sig, self._lane_pub, self._lane_msg,
+                self._lane_len)
             self.metrics["batches"] += 1
-            for j, (ti, *_rest) in enumerate(chunk):
-                if not ok[j]:
-                    txn_ok[ti] = False
+            live = self._lane_txn[:lanes]
+            covered[live] = True
+            # a txn passes only if ALL its signature lanes verified
+            failed = live[~lane_ok[:lanes]]
+            txn_ok[failed] = False
+
+        txn_ok &= covered
+        self.metrics["verify_fail"] += int((cand & ~txn_ok).sum())
+
+        # insert AFTER verify passed; a racing duplicate between query and
+        # insert is dropped here (insert returns "already present")
+        dup_post = self.tcache.insert_batch(tags,
+                                            mask=txn_ok.astype(np.uint8))
+        late = txn_ok & (dup_post != 0)
+        self.metrics["dedup_drop"] += int(late.sum())
+        txn_ok &= dup_post == 0
 
         fwd = 0
-        for i, (payload, tag) in parsed.items():
-            if not txn_ok[i]:
-                self.metrics["verify_fail"] += 1
-                continue
-            # insert AFTER verify passed; a racing duplicate between query
-            # and insert is dropped here (insert returns "already present")
-            if self.tcache.insert(tag):
-                self.metrics["dedup_drop"] += 1
-                continue
+        for i in np.nonzero(txn_ok)[0]:
             if not self._wait_credits():
                 break               # halted while backpressured
-            self.out_ring.publish(payload, sig=tag)
+            self.out_ring.publish(bytes(buf[i, : sizes[i]]),
+                                  sig=int(tags[i]))
             fwd += 1
         self.metrics["tx"] += fwd
         return n

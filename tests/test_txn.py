@@ -101,3 +101,78 @@ def test_mtu_sized_txn():
     assert len(txn) <= MTU
     t = parse_txn(txn)
     assert t.instrs[0].data_sz >= room - 64
+
+
+def test_native_parser_differential():
+    """Fuzz the C++ batch parser (fdtpu_txn_parse_batch) against the
+    Python spec parser on valid, mutated, and random payloads."""
+    import numpy as np
+    from firedancer_tpu.protocol.txn import build_txn, build_message
+    from firedancer_tpu.tiles.verify import parse_batch
+
+    rng = np.random.default_rng(77)
+    payloads = []
+    for i in range(300):
+        kind = i % 3
+        if kind == 0:
+            n_sig = int(rng.integers(1, 4))
+            signers = [bytes(rng.integers(0, 256, 32, np.uint8).tobytes())
+                       for _ in range(n_sig)]
+            extra = [bytes(rng.integers(0, 256, 32, np.uint8).tobytes())
+                     for _ in range(int(rng.integers(0, 3)))]
+            instrs = [(0, bytes([0]),
+                       rng.integers(0, 256,
+                                    int(rng.integers(0, 40)),
+                                    np.uint8).tobytes())
+                      for _ in range(int(rng.integers(0, 3)))]
+            m = build_message(signers, extra,
+                              bytes(32), instrs,
+                              n_ro_signed=0, n_ro_unsigned=len(extra) and 1,
+                              version=int(rng.integers(0, 2)) - 1)
+            p = build_txn([bytes(64) for _ in range(n_sig)], m)
+            if kind == 0 and i % 6 == 3:   # mutate a byte
+                p = bytearray(p)
+                p[int(rng.integers(0, len(p)))] ^= int(rng.integers(1, 256))
+                p = bytes(p)
+        elif kind == 1:
+            p = rng.integers(0, 256, int(rng.integers(1, 200)),
+                             np.uint8).tobytes()
+        else:
+            p = rng.integers(0, 256, int(rng.integers(1, 1232)),
+                             np.uint8).tobytes()
+        payloads.append(p)
+
+    stride = 1232
+    buf = np.zeros((len(payloads), stride), np.uint8)
+    sizes = np.zeros((len(payloads),), np.uint32)
+    for i, p in enumerate(payloads):
+        buf[i, :len(p)] = np.frombuffer(p, np.uint8)
+        sizes[i] = len(p)
+    meta, tags = parse_batch(buf, sizes, b"\x00" * 16)
+
+    from firedancer_tpu.protocol.txn import parse_txn, TxnParseError
+    for i, p in enumerate(payloads):
+        try:
+            t = parse_txn(p)
+            want = (1, t.sig_cnt, t.sig_off, t.msg_off, t.acct_off,
+                    t.acct_cnt, t.version)
+        except (TxnParseError, ValueError, IndexError):
+            want = None
+        got = tuple(int(x) for x in meta[i, :7]) if meta[i, 0] else None
+        assert got == want, (i, got, want, p.hex())
+
+    # dedup tags: keyed on the first signature — equal payloads tag
+    # equal, distinct first sigs tag distinct, and the key matters
+    parsed = [i for i in range(len(payloads)) if meta[i, 0]]
+    if len(parsed) >= 2:
+        i, j = parsed[0], parsed[1]
+        dup = np.stack([buf[i], buf[i], buf[j]])
+        dsz = np.asarray([sizes[i], sizes[i], sizes[j]], np.uint32)
+        m2, t2 = parse_batch(dup, dsz, b"\x00" * 16)
+        assert t2[0] == t2[1]
+        sig_i = bytes(buf[i][int(meta[i, 2]):int(meta[i, 2]) + 64])
+        sig_j = bytes(buf[j][int(meta[j, 2]):int(meta[j, 2]) + 64])
+        if sig_i != sig_j:
+            assert t2[0] != t2[2]
+        _, t3 = parse_batch(dup, dsz, b"\x01" * 16)
+        assert t3[0] != t2[0]       # seed actually keys the hash
