@@ -1,0 +1,104 @@
+"""Reed-Solomon erasure coding on the MXU (GF(2^8) as bit-matrix matmul).
+
+The single most MXU-native component of the whole reference: its encoder
+is a constant GF(2^8) matrix multiply per byte position
+(ref: src/ballet/reedsol/fd_reedsol.h:10-19 "left-multiplies the vector
+by a constant matrix in GF(2^8)"; the reference accelerates it with
+GFNI/AVX — P6 SIMD — while we map it onto the systolic array).
+
+Formulation: GF(2^8) is an 8-dimensional vector space over GF(2), and
+multiplication by a constant is GF(2)-linear. Expanding every shred byte
+into its 8 bits turns the (p, d) GF parity matrix M into a constant
+(8p, 8d) 0/1 matrix  B[(r,k),(j,b)] = bit_k( M[r,j] * x^b mod poly ),
+and encoding becomes
+
+    parity_bits = (B @ data_bits) mod 2
+
+— one f32 matmul on the MXU (exact: sums <= 8d < 2^24) plus a parity
+mask, batched over shred sets and byte positions. Recovery uses the same
+apply with a host-computed inverse matrix per erasure pattern
+(utils/gf256.recovery_matrix).
+
+Matches utils/gf256 (the host oracle pinned to the reference's
+construction, src/ballet/reedsol/gen_tbls.py:7-11) byte-for-byte.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import gf256
+
+
+def _bit_matrix(m: np.ndarray) -> np.ndarray:
+    """(p, d) GF matrix -> (8p, 8d) 0/1 float32 bit matrix."""
+    p, d = m.shape
+    out = np.zeros((8 * p, 8 * d), np.float32)
+    for r in range(p):
+        for j in range(d):
+            c = int(m[r, j])
+            if not c:
+                continue
+            for b in range(8):
+                prod = gf256.gf_mul(c, 1 << b)
+                for k in range(8):
+                    if prod & (1 << k):
+                        out[8 * r + k, 8 * j + b] = 1.0
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _parity_bit_matrix(d: int, p: int) -> np.ndarray:
+    return _bit_matrix(gf256.parity_matrix(d, p))
+
+
+def _bytes_to_bits(x):
+    """(..., n, sz) uint8 -> (..., 8n, sz) f32 bits (bit b of byte j at
+    row 8j+b)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[..., :, None, :] >> shifts[:, None]) & 1   # (..., n, 8, sz)
+    sh = bits.shape
+    return bits.reshape(*sh[:-3], sh[-3] * 8, sh[-1]).astype(jnp.float32)
+
+
+def _bits_to_bytes(bits):
+    """(..., 8n, sz) int32 0/1 -> (..., n, sz) uint8."""
+    sh = bits.shape
+    b = bits.reshape(*sh[:-2], sh[-2] // 8, 8, sh[-1])
+    w = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[:, None]
+    return jnp.sum(b.astype(jnp.uint8) * w, axis=-2, dtype=jnp.uint8)
+
+
+def _apply_bit_matrix(mat_bits, shreds):
+    """codes = mat @ shreds over GF(2^8), via the MXU.
+
+    mat_bits (8out, 8in) f32; shreds (..., in, sz) uint8 ->
+    (..., out, sz) uint8."""
+    bits = _bytes_to_bits(shreds)                        # (..., 8in, sz)
+    acc = jnp.einsum("ok,...kz->...oz", jnp.asarray(mat_bits), bits,
+                     preferred_element_type=jnp.float32)
+    par = acc.astype(jnp.int32) & 1
+    return _bits_to_bytes(par)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def encode(data, p: int):
+    """data (..., d, sz) uint8 shred set(s) -> (..., p, sz) parity.
+
+    Byte-identical to the reference construction for any (d, p) up to
+    the 67/67 maxima (ref: fd_reedsol.h FD_REEDSOL_*_SHREDS_MAX)."""
+    d = data.shape[-2]
+    return _apply_bit_matrix(_parity_bit_matrix(d, p), data)
+
+
+def recover(shreds, present: tuple[int, ...], d: int, p: int):
+    """Rebuild the d data shreds from d surviving shreds.
+
+    shreds (..., d, sz) uint8 — the surviving shreds in index order
+    (indices `present`, sorted, into the d+p codeword).
+    Returns (..., d, sz) uint8 data."""
+    r = gf256.recovery_matrix(d, p, list(present))
+    return _apply_bit_matrix(_bit_matrix(r), shreds)
