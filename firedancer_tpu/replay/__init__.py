@@ -1,0 +1,1 @@
+from .rdisp import ConflictDag, TxnState  # noqa: F401

@@ -1,0 +1,2 @@
+from .executor import (SystemTxn, execute_block, execute_block_serial,  # noqa: F401
+                       STATUS_OK, STATUS_INSUFFICIENT, STATUS_FEE_FAIL)

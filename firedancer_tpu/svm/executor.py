@@ -1,0 +1,196 @@
+"""Wave-scheduled block executor for system-program transfers (SVM core).
+
+North-star P3: the reference replays a block by feeding a conflict DAG
+to N exec tiles (ref: src/discof/replay/fd_rdisp.h:6-80,
+src/discof/exec/fd_exec_tile.c:14-21, runtime entry
+src/flamenco/runtime/fd_runtime.h:254-266, system program semantics
+src/flamenco/runtime/program/fd_system_program.c). On TPU the same DAG
+becomes *topological waves*: every wave is pairwise conflict-free, so
+one `lax.scan` step executes the whole wave vmapped over lanes, and the
+scan over waves replays the block — bit-identical to serial execution
+(the serial fiction), which `execute_block_serial` pins down as the
+oracle.
+
+Scope: system-program transfers (the first native program; sBPF stays on
+host exec tiles by design — SURVEY §7 hard-part 6). Lamports are u64 as
+(hi, lo) uint32 pairs — no 64-bit integer lanes on TPU, same move as the
+SHA-512 kernel. Consensus math is integer-only throughout.
+
+Failure semantics (mirrors the runtime's fee model, simplified):
+  * balance < fee                -> STATUS_FEE_FAIL, no state change
+    (the reference would never include such a txn; we report it)
+  * fee <= balance < fee+amount  -> STATUS_INSUFFICIENT, fee charged
+  * otherwise                    -> STATUS_OK, fee + amount moved
+Transfers to unknown accounts create them (system-owned model).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..replay.rdisp import ConflictDag
+
+STATUS_PAD = -1
+STATUS_OK = 0
+STATUS_INSUFFICIENT = 1
+STATUS_FEE_FAIL = 2
+
+_MASK32 = (1 << 32) - 1
+
+
+@dataclass(frozen=True)
+class SystemTxn:
+    """One system-program transfer: src pays fee + sends amount to dst."""
+    src: bytes           # 32B pubkey
+    dst: bytes           # 32B pubkey
+    amount: int          # u64 lamports
+    fee: int             # u64 lamports (burned in this model)
+
+
+def execute_block_serial(balances: dict, txns) -> list[int]:
+    """Serial oracle: execute in insertion order, mutating `balances`
+    (pubkey -> int lamports). Returns per-txn status codes."""
+    out = []
+    for t in txns:
+        bal = balances.get(t.src, 0)
+        if bal < t.fee:
+            out.append(STATUS_FEE_FAIL)
+            continue
+        if bal < t.fee + t.amount:
+            balances[t.src] = bal - t.fee
+            out.append(STATUS_INSUFFICIENT)
+            continue
+        balances[t.src] = bal - t.fee - t.amount
+        balances[t.dst] = balances.get(t.dst, 0) + t.amount
+        out.append(STATUS_OK)
+    return out
+
+
+def _build_waves(txns, key_idx):
+    """Conflict DAG -> padded wave tables (numpy). Dead (padding) lanes
+    point at a dummy account slot (index len(key_idx)) so their no-op
+    scatter writes can never collide with a live lane's write — XLA
+    scatter with duplicate indices is last-wins, so a dead lane aimed at
+    a real account could clobber it."""
+    dag = ConflictDag()
+    for t in txns:
+        dag.add_txn(writes=(t.src, t.dst), reads=())
+    waves = dag.waves() if len(dag) else []
+    n_waves = len(waves)
+    cap = max((len(w) for w in waves), default=1)
+    dummy = len(key_idx)
+    src = np.full((n_waves, cap), dummy, np.int32)
+    dst = np.full((n_waves, cap), dummy, np.int32)
+    amt = np.zeros((n_waves, cap, 2), np.uint32)    # (hi, lo)
+    fee = np.zeros((n_waves, cap, 2), np.uint32)
+    tix = np.full((n_waves, cap), -1, np.int32)
+    act = np.zeros((n_waves, cap), bool)
+    for wi, wave in enumerate(waves):
+        for li, t_idx in enumerate(wave):
+            t = txns[t_idx]
+            src[wi, li] = key_idx[t.src]
+            dst[wi, li] = key_idx[t.dst]
+            amt[wi, li] = (t.amount >> 32, t.amount & _MASK32)
+            fee[wi, li] = (t.fee >> 32, t.fee & _MASK32)
+            tix[wi, li] = t_idx
+            act[wi, li] = True
+    return waves, (src, dst, amt, fee, tix, act)
+
+
+def _jax_wave_scan(bal_hi, bal_lo, tables):
+    import jax
+    import jax.numpy as jnp
+
+    src, dst, amt, fee, tix, act = (jnp.asarray(x) for x in tables)
+
+    def u64_ge(ah, al, bh, bl):
+        return (ah > bh) | ((ah == bh) & (al >= bl))
+
+    def u64_add(ah, al, bh, bl):
+        lo = al + bl
+        return ah + bh + (lo < al).astype(jnp.uint32), lo
+
+    def u64_sub(ah, al, bh, bl):
+        lo = al - bl
+        return ah - bh - (al < bl).astype(jnp.uint32), lo
+
+    def wave_step(carry, wave):
+        bh, bl = carry
+        w_src, w_dst, w_amt, w_fee, w_act = wave
+        s_hi = bh[w_src]
+        s_lo = bl[w_src]
+        need_hi, need_lo = u64_add(w_amt[:, 0], w_amt[:, 1],
+                                   w_fee[:, 0], w_fee[:, 1])
+        fee_ok = u64_ge(s_hi, s_lo, w_fee[:, 0], w_fee[:, 1]) & w_act
+        ok = u64_ge(s_hi, s_lo, need_hi, need_lo) & w_act
+        # charge fee where payable, amount where fully funded
+        sub_hi = jnp.where(ok, need_hi, jnp.where(fee_ok, w_fee[:, 0], 0))
+        sub_lo = jnp.where(ok, need_lo, jnp.where(fee_ok, w_fee[:, 1], 0))
+        n_hi, n_lo = u64_sub(s_hi, s_lo, sub_hi, sub_lo)
+        # within a wave all written accounts are disjoint across txns
+        # (conflict rule), so scatter-set is race-free; self-transfer is
+        # handled by writing src first, then read-modify-write dst
+        bh = bh.at[w_src].set(jnp.where(w_act, n_hi, s_hi))
+        bl = bl.at[w_src].set(jnp.where(w_act, n_lo, s_lo))
+        d_hi = bh[w_dst]
+        d_lo = bl[w_dst]
+        add_hi = jnp.where(ok, w_amt[:, 0], 0)
+        add_lo = jnp.where(ok, w_amt[:, 1], 0)
+        r_hi, r_lo = u64_add(d_hi, d_lo, add_hi, add_lo)
+        bh = bh.at[w_dst].set(jnp.where(w_act, r_hi, d_hi))
+        bl = bl.at[w_dst].set(jnp.where(w_act, r_lo, d_lo))
+        status = jnp.where(~w_act, STATUS_PAD,
+                           jnp.where(ok, STATUS_OK,
+                                     jnp.where(fee_ok, STATUS_INSUFFICIENT,
+                                               STATUS_FEE_FAIL)))
+        return (bh, bl), status
+
+    (bh, bl), statuses = jax.lax.scan(
+        wave_step, (jnp.asarray(bal_hi), jnp.asarray(bal_lo)),
+        (src, dst, amt, fee, act))
+    return np.asarray(bh), np.asarray(bl), np.asarray(statuses)
+
+
+def execute_block(funk, parent_xid, xid, txns) -> list[int]:
+    """Replay a block of system transfers on the device and commit the
+    result as funk fork `xid` (prepared from `parent_xid`). Returns
+    per-txn statuses in insertion order.
+
+    funk record format: key = pubkey bytes, val = int lamports.
+    """
+    txns = list(txns)
+    funk.txn_prepare(parent_xid, xid)
+    if not txns:
+        return []
+
+    # dense account table for this block
+    key_idx: dict = {}
+    for t in txns:
+        for k in (t.src, t.dst):
+            if k not in key_idx:
+                key_idx[k] = len(key_idx)
+    keys = list(key_idx)
+    n = len(keys)
+    # slot n is the dummy account targeted by padding lanes
+    bal_hi = np.zeros((n + 1,), np.uint32)
+    bal_lo = np.zeros((n + 1,), np.uint32)
+    for k, i in key_idx.items():
+        v = funk.rec_query(parent_xid, k)
+        v = 0 if v is None else int(v)
+        bal_hi[i] = v >> 32
+        bal_lo[i] = v & _MASK32
+
+    waves, tables = _build_waves(txns, key_idx)
+    bh, bl, st = _jax_wave_scan(bal_hi, bal_lo, tables)
+
+    statuses = [STATUS_PAD] * len(txns)
+    tix, act = tables[4], tables[5]
+    for wi in range(tix.shape[0]):
+        for li in range(tix.shape[1]):
+            if act[wi, li]:
+                statuses[int(tix[wi, li])] = int(st[wi, li])
+
+    for k, i in key_idx.items():
+        funk.rec_write(xid, k, (int(bh[i]) << 32) | int(bl[i]))
+    return statuses
