@@ -1,0 +1,237 @@
+"""Shred format, merkle commitment, and shredder tests.
+
+Strategy mirrors the reference's (SURVEY §4): closed-form count
+functions cross-checked against a brute-force sizing model, wire
+round-trips, proof verification for every produced shred, and
+RS-recovery of erased data shreds from the produced parity."""
+import hashlib
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.shred import format as fmt
+from firedancer_tpu.shred.merkle import (MerkleTree20, bmtree_depth,
+                                         shred_merkle_leaf, verify_proof)
+from firedancer_tpu.shred.shredder import (Shredder, count_data_shreds,
+                                           count_fec_sets,
+                                           count_parity_shreds,
+                                           DATA_TO_PARITY)
+from firedancer_tpu.utils import ed25519_ref, gf256
+
+SEED = b"\x07" * 32
+
+
+def _signer():
+    calls = []
+
+    def sign(root: bytes) -> bytes:
+        calls.append(root)
+        return ed25519_ref.sign(SEED, root)
+    return sign, calls
+
+
+# -- sizing policy -----------------------------------------------------------
+
+def test_count_functions_normal_regime():
+    # exact multiple of 31840 -> sz/31840 sets of exactly 32+32
+    for k in (1, 2, 5):
+        sz = 31840 * k
+        assert count_fec_sets(sz, chained=False) == k
+        assert count_data_shreds(sz, chained=False) == 32 * k
+        assert count_parity_shreds(sz, chained=False) == 32 * k
+
+
+@pytest.mark.parametrize("chained,resigned", [(False, False), (True, False),
+                                              (True, True)])
+def test_count_matches_brute_force(chained, resigned):
+    # brute-force the spec formula: payload = 1115 - 20*ceil(log2(n))
+    # - 32*chained - 64*resigned with n = d + p(d), picking the largest
+    # consistent payload (fd_shredder.h:100-137)
+    def brute(rem):
+        best = None
+        for d in range(1, 68):
+            p = DATA_TO_PARITY[d] if d < len(DATA_TO_PARITY) else d
+            depth = bmtree_depth(d + p) - 1
+            payload = 1115 - 20 * depth - 32 * chained - 64 * resigned
+            if (d - 1) * payload < rem <= d * payload:
+                if best is None or payload > best[2]:
+                    best = (d, p, payload)
+        assert best, rem
+        return best
+
+    rng = np.random.default_rng(3)
+    fec_pl = {(False, False): 31840, (True, False): 30816,
+              (True, True): 28768}[(chained, resigned)]
+    for rem in [1, 17, 954, 955, 1015, 1016, 9135, 9136, 20000,
+                fec_pl, fec_pl + 1, 2 * fec_pl - 1,
+                *rng.integers(1, 2 * fec_pl, 40).tolist()]:
+        d, p, _ = brute(rem)
+        assert count_data_shreds(rem, chained, resigned) == d, rem
+        assert count_parity_shreds(rem, chained, resigned) == p, rem
+
+
+# -- merkle tree -------------------------------------------------------------
+
+def test_merkle_proofs_all_leaves():
+    rng = np.random.default_rng(5)
+    for n in (1, 2, 3, 5, 9, 32, 64, 67):
+        leaves = [rng.integers(0, 256, 40, np.uint8).tobytes()
+                  for _ in range(n)]
+        tree = MerkleTree20.from_leaves(leaves)
+        assert tree.proof_len == bmtree_depth(n) - 1
+        for i in range(n):
+            pf = tree.proof(i)
+            assert verify_proof(shred_merkle_leaf(leaves[i]), i, pf,
+                                tree.root)
+            # a corrupted leaf must fail
+            assert not verify_proof(
+                shred_merkle_leaf(leaves[i] + b"x"), i, pf, tree.root)
+
+
+def test_merkle_truncation_semantics():
+    # children truncated to 20B at concat time; root is full sha256
+    a = hashlib.sha256(b"\x00SOLANA_MERKLE_SHREDS_LEAF" + b"a").digest()
+    b = hashlib.sha256(b"\x00SOLANA_MERKLE_SHREDS_LEAF" + b"b").digest()
+    tree = MerkleTree20([a, b])
+    expect = hashlib.sha256(
+        b"\x01SOLANA_MERKLE_SHREDS_NODE" + a[:20] + b[:20]).digest()
+    assert tree.root == expect and len(tree.root) == 32
+
+
+# -- wire format -------------------------------------------------------------
+
+def test_shred_wire_sizes():
+    sign, _ = _signer()
+    sets = Shredder(sign).shred_batch(b"z" * 5000, slot=7, parent_off=1,
+                                      ref_tick=3, block_complete=False)
+    assert len(sets) == 1
+    fs = sets[0]
+    assert all(len(w) == fmt.SHRED_MIN_SZ for w in fs.data_shreds)
+    assert all(len(w) == fmt.SHRED_MAX_SZ for w in fs.parity_shreds)
+
+
+def test_pack_parse_roundtrip():
+    sign, _ = _signer()
+    sets = Shredder(sign, shred_version=42).shred_batch(
+        b"\xab" * 4000, slot=9, parent_off=2, ref_tick=11,
+        block_complete=True)
+    for fs in sets:
+        total = b"".join(
+            fmt.parse_shred(w).payload for w in fs.data_shreds)
+        assert total == b"\xab" * 4000
+        d0 = fmt.parse_shred(fs.data_shreds[0])
+        assert (d0.slot, d0.version, d0.parent_off) == (9, 42, 2)
+        assert d0.ref_tick == 11
+        last = fmt.parse_shred(fs.data_shreds[-1])
+        assert last.slot_complete and last.data_complete
+        c0 = fmt.parse_shred(fs.parity_shreds[0])
+        assert c0.data_cnt == len(fs.data_shreds)
+        assert c0.code_cnt == len(fs.parity_shreds)
+
+
+def test_parse_rejects_malformed():
+    sign, _ = _signer()
+    w = Shredder(sign).shred_batch(b"q" * 100, 1, 1, 0,
+                                   False)[0].data_shreds[0]
+    with pytest.raises(fmt.ShredParseError):
+        fmt.parse_shred(w[:-1])               # truncated
+    bad = bytearray(w)
+    bad[fmt.VARIANT_OFF] = 0xA5               # legacy
+    with pytest.raises(fmt.ShredParseError):
+        fmt.parse_shred(bytes(bad))
+    bad = bytearray(w)
+    bad[0x56:0x58] = (60000).to_bytes(2, "little")  # size field overrun
+    with pytest.raises(fmt.ShredParseError):
+        fmt.parse_shred(bytes(bad))
+
+
+# -- shredder end-to-end -----------------------------------------------------
+
+def test_every_shred_proof_verifies_and_sig_covers_root():
+    sign, roots = _signer()
+    pub = ed25519_ref.keypair(SEED)[-1]
+    sets = Shredder(sign).shred_batch(b"\x5c" * 9000, slot=3,
+                                      parent_off=1, ref_tick=0,
+                                      block_complete=False)
+    fs = sets[0]
+    d_var = fmt.parse_shred(fs.data_shreds[0]).variant
+    c_var = fmt.parse_shred(fs.parity_shreds[0]).variant
+    d_cnt = len(fs.data_shreds)
+    for i, w in enumerate(fs.data_shreds + fs.parity_shreds):
+        var = d_var if i < d_cnt else c_var
+        region = (fmt.data_merkle_region_sz(var) if i < d_cnt
+                  else fmt.code_merkle_region_sz(var))
+        leaf = shred_merkle_leaf(w[64:64 + region])
+        s = fmt.parse_shred(w)
+        assert verify_proof(leaf, i, list(s.proof), fs.merkle_root), i
+        assert ed25519_ref.verify(s.signature, pub, fs.merkle_root)
+    assert roots == [fs.merkle_root]
+
+
+def test_rs_recovery_from_parity():
+    sign, _ = _signer()
+    fs = Shredder(sign).shred_batch(b"\x11\x22\x33" * 2000, 5, 1, 2,
+                                    False)[0]
+    d = len(fs.data_shreds)
+    p = len(fs.parity_shreds)
+    var = fmt.parse_shred(fs.data_shreds[0]).variant
+    region = fmt.payload_capacity(var) + fmt.DATA_HEADER_SZ - 64
+    codeword = {}
+    for i, w in enumerate(fs.data_shreds):
+        codeword[i] = np.frombuffer(w[64:64 + region], np.uint8)
+    for j, w in enumerate(fs.parity_shreds):
+        codeword[d + j] = np.frombuffer(w[0x59:0x59 + region], np.uint8)
+    # erase as many data shreds as there is parity, recover, compare
+    rng = np.random.default_rng(7)
+    erased = set(rng.choice(d, size=min(p, d), replace=False).tolist())
+    surviving = {k: v for k, v in codeword.items() if k not in erased}
+    rec = gf256.recover(surviving, d, p)
+    for i in range(d):
+        assert np.array_equal(rec[i], codeword[i]), i
+
+
+def test_chained_roots_thread_across_sets():
+    sign, _ = _signer()
+    prev_root = b"\x99" * 32
+    # two FEC sets (exact multiple of the chained payload)
+    sets = Shredder(sign).shred_batch(b"r" * (30816 * 2), slot=2,
+                                      parent_off=1, ref_tick=0,
+                                      block_complete=False,
+                                      chained_root=prev_root)
+    assert len(sets) == 2
+    s0 = fmt.parse_shred(sets[0].data_shreds[0])
+    assert fmt.is_chained(s0.variant) and not fmt.is_resigned(s0.variant)
+    assert s0.chained_root == prev_root
+    s1 = fmt.parse_shred(sets[1].data_shreds[0])
+    assert s1.chained_root == sets[0].merkle_root
+    # chained+block_complete -> resigned variants with sig slot zeroed
+    sets = Shredder(sign).shred_batch(b"r" * 100, slot=3, parent_off=1,
+                                      ref_tick=0, block_complete=True,
+                                      chained_root=prev_root)
+    s = fmt.parse_shred(sets[0].data_shreds[0])
+    assert fmt.is_resigned(s.variant)
+    assert s.retransmit_sig == bytes(64)
+
+
+def test_idx_bookkeeping_across_batches():
+    sign, _ = _signer()
+    sh = Shredder(sign)
+    a = sh.shred_batch(b"a" * 2000, 7, 1, 0, False)[0]
+    b = sh.shred_batch(b"b" * 2000, 7, 1, 0, False)[0]
+    a_d = [fmt.parse_shred(w).idx for w in a.data_shreds]
+    b_d = [fmt.parse_shred(w).idx for w in b.data_shreds]
+    assert b_d[0] == a_d[-1] + 1                 # contiguous in slot
+    assert b.fec_set_idx == b_d[0]
+    c = sh.shred_batch(b"c" * 2000, 8, 1, 0, False)[0]  # new slot resets
+    assert fmt.parse_shred(c.data_shreds[0]).idx == 0
+
+
+def test_payload_sz_formula_pinned():
+    # depth-6 tree (32+32 shreds): payload 995 unchained / 963 chained
+    assert fmt.payload_capacity(fmt.TYPE_MERKLE_DATA | 6) == 995
+    assert fmt.payload_capacity(fmt.TYPE_MERKLE_DATA_CHAINED | 6) == 963
+    assert fmt.payload_capacity(
+        fmt.TYPE_MERKLE_DATA_CHAINED_RESIGNED | 6) == 899
+    # header+payload+proof must tile the wire exactly
+    assert 88 + 995 + 20 * 6 == fmt.SHRED_MIN_SZ
+    assert 89 + (995 + 24) + 20 * 6 == fmt.SHRED_MAX_SZ
