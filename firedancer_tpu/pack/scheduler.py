@@ -32,6 +32,7 @@ import heapq
 from dataclasses import dataclass, field
 
 from ..protocol.txn import ParsedTxn, parse_txn
+from .cost import CostError, compute_cost
 
 # consensus-critical defaults (cluster-agreed values; ref:
 # src/disco/pack/fd_pack.h:30-36 — 48M lower bound, 12M per acct)
@@ -39,10 +40,8 @@ MAX_COST_PER_BLOCK = 48_000_000
 MAX_VOTE_COST_PER_BLOCK = 36_000_000
 MAX_WRITE_COST_PER_ACCT = 12_000_000
 
-COST_PER_SIGNATURE = 720          # ref: fd_pack_cost.h
-COST_PER_WRITABLE_ACCT = 300
-DEFAULT_EXEC_CU = 200_000
 FEE_PER_SIGNATURE = 5000          # ref: fd_pack.h:20
+TXN_FEE_BURN_PCT = 50             # ref: fd_pack.h:52
 
 
 @dataclass
@@ -73,15 +72,18 @@ class TxnMeta:
     r_mask: int = 0
 
 
-def txn_cost_and_reward(t: ParsedTxn, payload: bytes) -> tuple[int, int]:
-    """Simplified fd_pack_cost model: signature cost + write-lock cost +
-    execution CU (default; compute-budget parsing can refine)."""
-    n_writable = sum(t.is_writable(i) for i in range(t.acct_cnt))
-    cost = (COST_PER_SIGNATURE * t.sig_cnt
-            + COST_PER_WRITABLE_ACCT * n_writable
-            + DEFAULT_EXEC_CU)
-    reward = FEE_PER_SIGNATURE * t.sig_cnt
-    return cost, reward
+def txn_cost_and_reward(t: ParsedTxn, payload: bytes) -> tuple[int, int, bool]:
+    """Full fd_pack cost/reward model -> (cost units, leader lamports,
+    is_simple_vote). Raises CostError for txns the cost model rejects
+    (malformed compute-budget instructions — the reference returns
+    cost 0 and pack drops them, fd_pack.c:898-922)."""
+    tc = compute_cost(t, payload)
+    sig_rewards = FEE_PER_SIGNATURE * (t.sig_cnt + tc.precompile_sig_cnt)
+    # the leader keeps the UNburned share of the signature fee
+    # (fd_pack.c:914 applies the burn; burn pct fd_pack.h:52)
+    reward = sig_rewards * (100 - TXN_FEE_BURN_PCT) // 100 \
+        + tc.priority_fee
+    return tc.total, reward, tc.is_simple_vote
 
 
 def meta_from_payload(payload: bytes, seq: int = 0,
@@ -91,9 +93,10 @@ def meta_from_payload(payload: bytes, seq: int = 0,
     keys = t.account_keys(payload)
     writes = tuple(k for i, k in enumerate(keys) if t.is_writable(i))
     reads = tuple(k for i, k in enumerate(keys) if not t.is_writable(i))
-    c, r = txn_cost_and_reward(t, payload)
+    c, r, vote = txn_cost_and_reward(t, payload)
     return TxnMeta(payload, t, reward if reward is not None else r,
-                   cost if cost is not None else c, writes, reads, seq=seq)
+                   cost if cost is not None else c, writes, reads,
+                   is_vote=vote, seq=seq)
 
 
 class _AcctBits:

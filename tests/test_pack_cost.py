@@ -1,0 +1,166 @@
+"""Cost model tests: compute-budget parsing, simple votes, fee math,
+and the vote-cost block limit actually firing in the scheduler.
+
+Pinned to the reference constants (src/disco/pack/fd_pack_cost.h,
+fd_compute_budget_program.h) including the worked MAX_TXN_COST example
+in the header comment."""
+import pytest
+
+from firedancer_tpu.pack import cost as pc
+from firedancer_tpu.pack.scheduler import (PackLimits, PackScheduler,
+                                           meta_from_payload)
+from firedancer_tpu.protocol.txn import build_message, build_txn, parse_txn
+
+
+def _payload(instrs, extra_accounts, n_signers=1, n_ro_unsigned=0,
+             version=-1):
+    signers = [bytes([0x40 + i]) * 32 for i in range(n_signers)]
+    msg = build_message(signers, extra_accounts, b"\xbb" * 32, instrs,
+                        n_ro_unsigned=n_ro_unsigned, version=version)
+    return build_txn([b"\x01" * 64] * n_signers, msg)
+
+
+def _cb_ix(kind: int, value: int, width: int = 4) -> bytes:
+    return bytes([kind]) + value.to_bytes(width, "little")
+
+
+def test_default_cost_no_compute_budget():
+    # 1 signer + 1 writable + 1 non-builtin instr, 3 data bytes
+    prog = b"\x77" * 32
+    p = _payload([(2, bytes([1]), b"abc")],
+                 [b"\x55" * 32, prog], n_ro_unsigned=1)
+    t = parse_txn(p)
+    tc = pc.compute_cost(t, p)
+    assert not tc.is_simple_vote
+    assert tc.execution == pc.DEFAULT_INSTR_CU_LIMIT
+    assert tc.loaded_data_cost == 16384       # 64MiB/32KiB pages * 8
+    assert tc.total == (720 + 2 * 300        # signer + 1 writable acct
+                        + pc.DEFAULT_INSTR_CU_LIMIT
+                        + 3 // 4 + 16384)
+    assert tc.priority_fee == 0
+
+
+def test_builtin_vs_non_builtin_default_cu():
+    # one system-program (builtin: 3k) + one unknown program (200k)
+    sysp = pc.SYSTEM_PROGRAM_ID
+    unk = b"\x66" * 32
+    p = _payload([(1, b"", b""), (2, b"", b"")], [sysp, unk])
+    tc = pc.compute_cost(parse_txn(p), p)
+    assert tc.execution == pc.MAX_BUILTIN_CU_LIMIT \
+        + pc.DEFAULT_INSTR_CU_LIMIT
+
+
+def test_set_compute_unit_limit_and_price():
+    cb = pc.COMPUTE_BUDGET_PROGRAM_ID
+    unk = b"\x66" * 32
+    instrs = [(1, b"", _cb_ix(2, 500_000)),            # SetComputeUnitLimit
+              (1, b"", _cb_ix(3, 2_000_000, 8)),       # SetComputeUnitPrice
+              (2, b"", b"\x00" * 8)]
+    p = _payload(instrs, [cb, unk])
+    tc = pc.compute_cost(parse_txn(p), p)
+    assert tc.execution == 500_000
+    # ceil(500k CU * 2 lamports/CU-in-micro = 2e6 micro/CU / 1e6)
+    assert tc.priority_fee == 1_000_000
+    # CU limit clamps at 1.4M
+    instrs[0] = (1, b"", _cb_ix(2, 100_000_000))
+    p = _payload(instrs, [cb, unk])
+    assert pc.compute_cost(parse_txn(p), p).execution == pc.MAX_CU_LIMIT
+
+
+def test_loaded_accounts_data_size():
+    cb = pc.COMPUTE_BUDGET_PROGRAM_ID
+    p = _payload([(1, b"", _cb_ix(4, 33 * 1024))], [cb])
+    tc = pc.compute_cost(parse_txn(p), p)
+    assert tc.loaded_data_cost == 2 * pc.HEAP_COST    # 2 pages
+    with pytest.raises(pc.CostError):                 # zero size invalid
+        p = _payload([(1, b"", _cb_ix(4, 0))], [cb])
+        pc.compute_cost(parse_txn(p), p)
+
+
+def test_duplicate_and_malformed_compute_budget_fail():
+    cb = pc.COMPUTE_BUDGET_PROGRAM_ID
+    dup = [(1, b"", _cb_ix(2, 1000)), (1, b"", _cb_ix(2, 1000))]
+    p = _payload(dup, [cb])
+    with pytest.raises(pc.CostError):
+        pc.compute_cost(parse_txn(p), p)
+    p = _payload([(1, b"", b"\x02\x01")], [cb])       # too short
+    with pytest.raises(pc.CostError):
+        pc.compute_cost(parse_txn(p), p)
+    p = _payload([(1, b"", _cb_ix(0, 5))], [cb])      # deprecated kind 0
+    with pytest.raises(pc.CostError):
+        pc.compute_cost(parse_txn(p), p)
+    # heap size must be 1024-aligned
+    p = _payload([(1, b"", _cb_ix(1, 1025))], [cb])
+    with pytest.raises(pc.CostError):
+        pc.compute_cost(parse_txn(p), p)
+
+
+def test_precompile_signature_costs():
+    ed = pc.ED25519_SV_PROGRAM_ID
+    k1 = pc.KECCAK_SECP_PROGRAM_ID
+    p = _payload([(1, b"", b"\x03" + b"\x00" * 10),   # 3 ed25519 sigs
+                  (2, b"", b"\x02" + b"\x00" * 10)],  # 2 secp256k1 sigs
+                 [ed, k1], n_ro_unsigned=2)
+    tc = pc.compute_cost(parse_txn(p), p)
+    assert tc.precompile_sig_cnt == 5
+    base = 720 + 300                                  # 1 signer writable
+    sig_extra = 3 * 2400 + 2 * 6690
+    # both instrs are builtins -> 2*3000 CU
+    assert tc.total == base + sig_extra + 6000 + 22 // 4 + 16384
+
+
+def test_simple_vote_detection_and_fixed_cost():
+    vote = pc.VOTE_PROGRAM_ID
+    p = _payload([(2, bytes([1]), b"\x00" * 20)],
+                 [b"\x11" * 32, vote])
+    tc = pc.compute_cost(parse_txn(p), p)
+    assert tc.is_simple_vote
+    assert tc.total == pc.SIMPLE_VOTE_COST == 3428
+    # v0 txns are never simple votes
+    p = _payload([(2, bytes([1]), b"\x00" * 20)],
+                 [b"\x11" * 32, vote], version=0)
+    assert not pc.compute_cost(parse_txn(p), p).is_simple_vote
+
+
+def test_scheduler_vote_limit_fires():
+    """Votes beyond max_vote_cost_per_block are deferred even when the
+    overall block limit has room (ref fd_pack vote limit)."""
+    vote = pc.VOTE_PROGRAM_ID
+    lim = PackLimits(max_vote_cost_per_block=2 * pc.SIMPLE_VOTE_COST + 1,
+                     max_txn_per_microblock=10)
+    sch = PackScheduler(bank_cnt=1, limits=lim)
+    for i in range(4):
+        signer = bytes([i + 1]) * 32
+        msg = build_message([signer], [bytes([0x80 + i]) * 32, vote],
+                            b"\xbb" * 32, [(2, bytes([1]), b"\x00" * 8)],
+                            n_ro_unsigned=1)   # vote program readonly
+        tid = sch.insert(meta_from_payload(build_txn([b"\x01" * 64], msg)))
+        assert sch._pending[tid].is_vote
+    mb = sch.schedule_microblock(0)
+    assert len(mb) == 2                       # third vote exceeds limit
+    assert all(m.is_vote for m in mb)
+    assert sch.pending_cnt == 2
+
+
+def test_reward_model_burn_and_priority():
+    cb = pc.COMPUTE_BUDGET_PROGRAM_ID
+    unk = b"\x66" * 32
+    instrs = [(1, b"", _cb_ix(2, 1_000_000)),
+              (1, b"", _cb_ix(3, 5_000_000, 8)),
+              (2, b"", b"")]
+    p = _payload(instrs, [cb, unk])
+    m = meta_from_payload(p)
+    # burned sig fee: 5000 * 1 sig * 50% = 2500; priority:
+    # ceil(1M CU * 5 lamports/CU) = 5,000,000
+    assert m.reward == 2500 + 5_000_000
+    assert m.cost == pc.compute_cost(parse_txn(p), p).total
+
+
+def test_max_txn_cost_bound():
+    # the reference's worked bound: any txn cost fits under MAX_TXN_COST
+    cb = pc.COMPUTE_BUDGET_PROGRAM_ID
+    unk = b"\x66" * 32
+    p = _payload([(1, b"", _cb_ix(2, pc.MAX_CU_LIMIT)), (2, b"", b"")],
+                 [cb, unk], n_signers=9)
+    tc = pc.compute_cost(parse_txn(p), p)
+    assert tc.total < pc.MAX_TXN_COST
