@@ -188,8 +188,10 @@ class PackAdapter:
     Completion frag: u64 microblock_id (per-bank dedicated link).
 
     args: txn_in (link), bank_links (ordered list), done_links (ordered
-    list, one per bank), max_txn_per_microblock, slot_ms (block timer —
-    the poh slot-boundary analog; fd_pack_end_block per slot)."""
+    list, one per bank), max_txn_per_microblock, and the slot boundary
+    source: slot_in (link carrying PoH slot frags — the production
+    path, ref fd_poh.h leader slot handoff) or slot_ms (wall-clock
+    fallback for poh-less topologies)."""
 
     METRICS = ["rx", "parse_fail", "inserted", "scheduled", "microblocks",
                "completions", "blocks", "backpressure", "overruns"]
@@ -211,6 +213,7 @@ class PackAdapter:
                 max_txn_per_microblock=int(
                     args.get("max_txn_per_microblock", 31)),
                 max_data_bytes_per_microblock=mtu - 12))
+        self.slot_in = args.get("slot_in")
         self.slot_ms = float(args.get("slot_ms", 400.0))
         self._slot_t0 = time.monotonic()
         self.batch = int(args.get("batch", 64))
@@ -256,6 +259,16 @@ class PackAdapter:
                 self.m["parse_fail"] += 1
         self.m["rx"] += n
         total += n
+        # 2b) PoH slot boundaries (tick-count-driven, not wall clock)
+        if self.slot_in:
+            ring = self.ctx.in_rings[self.slot_in]
+            k, self.seqs[self.slot_in], buf, sizes, sigs, ovr = \
+                ring.gather(self.seqs[self.slot_in], 4, 16)
+            self.m["overruns"] += ovr
+            for _ in range(k):
+                self.sched.end_block()
+                self.m["blocks"] += 1
+            total += k
         # 3) fill idle banks
         for bank, ln in enumerate(self.bank_links):
             if self.busy[bank] is not None:
@@ -278,8 +291,9 @@ class PackAdapter:
         return total
 
     def housekeeping(self):
-        # slot boundary: reset per-block cost budgets
-        if (time.monotonic() - self._slot_t0) * 1e3 >= self.slot_ms:
+        # wall-clock slot fallback, only when no PoH slot link is wired
+        if not self.slot_in and \
+                (time.monotonic() - self._slot_t0) * 1e3 >= self.slot_ms:
             self.sched.end_block()
             self._slot_t0 = time.monotonic()
             self.m["blocks"] += 1
@@ -293,13 +307,25 @@ class PackAdapter:
 
 @register("bank")
 class BankAdapter:
-    """Execution stage stub (ref: src/discoh/bank/fd_bank_tile.c shape:
-    consume microblock, execute, emit completion): parses the microblock
-    frame, counts transactions, acknowledges on its completion link.
-    The real SVM executor slots in here.
-    args: in link = pack_bank*, out link = done link back to pack."""
+    """Execution stage (ref: src/discoh/bank/fd_bank_tile.c shape:
+    consume microblock, execute, emit completion; execution entry
+    src/flamenco/runtime/fd_runtime.h:254-266).
 
-    METRICS = ["microblocks", "txns", "overruns"]
+    exec="svm": parse each txn, execute system-program transfers
+    through the wave executor (svm/executor.py — conflict-DAG waves as
+    one lax.scan) against a process-local funk fork per microblock,
+    and forward the executed microblock (with a PoH mixin hash) on the
+    optional poh link. Multi-bank topologies share no account state yet
+    (the shm-resident accdb is a future component), so use one bank
+    tile with exec="svm".
+
+    exec="stub": count txns and ack (ring-plumbing tests).
+
+    args: exec, poh_link (optional out link name), done link = the
+    remaining out link."""
+
+    METRICS = ["microblocks", "txns", "transfers", "exec_skip",
+               "exec_fail", "overruns"]
 
     def __init__(self, ctx, args):
         self.ctx = ctx
@@ -307,21 +333,116 @@ class BankAdapter:
             raise ValueError(f"bank tile {ctx.tile_name}: one in link")
         self.in_link = next(iter(ctx.in_rings))
         self.ring = ctx.in_rings[self.in_link]
-        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
-        self.out_fseqs = _single(ctx.out_fseqs, "out link", ctx.tile_name)
+        self.exec_mode = args.get("exec", "stub")
+        self.poh_link = args.get("poh_link")
+        if self.poh_link:
+            self.poh_out = ctx.out_rings[self.poh_link]
+            self.poh_fseqs = ctx.out_fseqs[self.poh_link]
+            done = [ln for ln in ctx.out_rings if ln != self.poh_link]
+            assert len(done) == 1, done
+            self.out = ctx.out_rings[done[0]]
+            self.out_fseqs = ctx.out_fseqs[done[0]]
+        else:
+            self.poh_out = None
+            self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+            self.out_fseqs = _single(ctx.out_fseqs, "out link",
+                                     ctx.tile_name)
+        if self.exec_mode == "svm":
+            _setup_jax()
+            from ..funk.funk import Funk
+            self.funk = Funk()
+            self.xid = None            # published root
+            self._next_xid = 1
+            # genesis balances: airdropped synth accounts (tests inject
+            # via args; production restores from snapshot)
+            for acct_hex, bal in args.get("genesis", {}).items():
+                self.funk.rec_write(None, bytes.fromhex(acct_hex),
+                                    int(bal))
         self.seq = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
         self.m = {k: 0 for k in self.METRICS}
+
+    def _parse_transfers(self, frame, txn_cnt):
+        """Microblock frame -> (SystemTxn list — one per system-program
+        Transfer instruction, in instruction order, fee charged on each
+        txn's first only —, sha256 mixin over concatenated first
+        signatures)."""
+        import hashlib
+
+        from ..pack.cost import SYSTEM_PROGRAM_ID
+        from ..pack.scheduler import FEE_PER_SIGNATURE
+        from ..protocol.txn import parse_txn
+        from ..svm.executor import SystemTxn
+        txns, sigs = [], []
+        off = 12
+        for _ in range(txn_cnt):
+            (ln,) = struct.unpack_from("<H", frame, off)
+            off += 2
+            payload = bytes(frame[off:off + ln])
+            off += ln
+            try:
+                t = parse_txn(payload)
+            except Exception:
+                self.m["exec_skip"] += 1
+                continue
+            sigs.append(t.signatures(payload)[0])
+            keys = t.account_keys(payload)
+            matched = 0
+            for ins in t.instrs:
+                data = payload[ins.data_off:ins.data_off + ins.data_sz]
+                # system-program Transfer: u32 discriminant 2 + u64
+                # lamports (fd_system_program.c transfer instruction);
+                # every transfer instruction executes, fee once per txn
+                if (keys[ins.prog_idx] == SYSTEM_PROGRAM_ID
+                        and len(data) == 12
+                        and data[:4] == b"\x02\x00\x00\x00"
+                        and len(ins.acct_idxs) >= 2):
+                    amt = int.from_bytes(data[4:12], "little")
+                    txns.append(SystemTxn(
+                        src=keys[ins.acct_idxs[0]],
+                        dst=keys[ins.acct_idxs[1]], amount=amt,
+                        fee=0 if matched
+                        else FEE_PER_SIGNATURE * t.sig_cnt))
+                    matched += 1
+            if not matched:
+                self.m["exec_skip"] += 1
+        mixin = hashlib.sha256(b"".join(sigs)).digest()
+        return txns, mixin
 
     def poll_once(self) -> int:
         n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
             self.seq, 8, self.mtu)
         self.m["overruns"] += ovr
         for i in range(n):
-            bank, txn_cnt, mb_id = struct.unpack_from("<HHQ", buf[i], 0)
-            # execution stub: account txns; real runtime goes here
+            frame = bytes(buf[i, :sizes[i]])
+            bank, txn_cnt, mb_id = struct.unpack_from("<HHQ", frame, 0)
             self.m["txns"] += txn_cnt
             self.m["microblocks"] += 1
+            if self.exec_mode == "svm" and txn_cnt:
+                from ..svm.executor import STATUS_OK, execute_block
+                txns, mixin = self._parse_transfers(frame, txn_cnt)
+                if txns:
+                    new_xid = self._next_xid
+                    self._next_xid += 1
+                    try:
+                        st = execute_block(self.funk, self.xid, new_xid,
+                                           txns)
+                        self.funk.txn_publish(new_xid)
+                        self.xid = None   # published into root
+                        self.m["transfers"] += sum(
+                            1 for s in st if s == STATUS_OK)
+                        self.m["exec_fail"] += sum(
+                            1 for s in st if s != STATUS_OK)
+                    except Exception:
+                        self.funk.txn_cancel(new_xid)
+                        raise
+                if self.poh_out is not None:
+                    while self.poh_fseqs and \
+                            self.poh_out.credits(self.poh_fseqs) <= 0:
+                        time.sleep(20e-6)
+                    self.poh_out.publish(
+                        struct.pack("<QH", mb_id, txn_cnt) + mixin,
+                        sig=mb_id)
             while self.out_fseqs and \
                     self.out.credits(self.out_fseqs) <= 0:
                 time.sleep(20e-6)
@@ -330,6 +451,153 @@ class BankAdapter:
 
     def in_seqs(self):
         return {self.in_link: self.seq}
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("sock")
+class SockAdapter:
+    """UDP socket ingest (ref: src/disco/net/sock/fd_sock_tile.c).
+    args: port (0 = ephemeral; bound port published in metrics),
+    bind_addr, batch, mtu."""
+
+    METRICS = ["rx", "bytes", "oversz", "backpressure", "port"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.sock import SockTile
+        self.ctx = ctx
+        out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        fseqs = _single(ctx.out_fseqs, "out link", ctx.tile_name)
+        self.tile = SockTile(
+            out, fseqs, port=int(args.get("port", 0)),
+            bind_addr=args.get("bind_addr", "127.0.0.1"),
+            batch=int(args.get("batch", 64)),
+            mtu=int(args.get("mtu", 1500)))
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
+@register("poh")
+class PohAdapter:
+    """Proof-of-History tile (ref: src/discof/poh/fd_poh.h:4-31): owns
+    the hash chain; mixes executed microblocks (from bank tiles) into
+    it as record entries, emits tick entries on schedule, and declares
+    slot boundaries by TICK COUNT — the pack tile ends its block on the
+    slot frag, not a wall clock.
+
+    Chain generation is host-side (inherently sequential); entry
+    verification is the batched device kernel (ops/poh.py) run by
+    consumers/tests.
+
+    Entry frag wire: u64 slot | u32 tick | u32 num_hashes |
+    u8 has_mixin | prev 32 | hash 32 | mixin 32.
+    Slot frag wire (slot_link): u64 completed_slot.
+
+    args: hashes_per_tick, ticks_per_slot, seed (hex, 32B),
+    slot_link (optional out link to pack), entry link = remaining out.
+    """
+
+    METRICS = ["mixins", "ticks", "slots", "entries", "overruns",
+               "backpressure"]
+
+    def __init__(self, ctx, args):
+        from ..ops.poh import host_poh_append, host_poh_mixin
+        self._append = host_poh_append
+        self._mixin = host_poh_mixin
+        self.ctx = ctx
+        self.hashes_per_tick = int(args.get("hashes_per_tick", 64))
+        self.ticks_per_slot = int(args.get("ticks_per_slot", 8))
+        self.state = bytes.fromhex(args["seed"]) if "seed" in args \
+            else bytes(32)
+        self.slot_link = args.get("slot_link")
+        if self.slot_link:
+            self.slot_out = ctx.out_rings[self.slot_link]
+            self.slot_fseqs = ctx.out_fseqs[self.slot_link]
+            ent = [ln for ln in ctx.out_rings if ln != self.slot_link]
+            assert len(ent) == 1, ent
+            self.entry_out = ctx.out_rings[ent[0]]
+            self.entry_fseqs = ctx.out_fseqs[ent[0]]
+        else:
+            self.slot_out = None
+            self.entry_out = _single(ctx.out_rings, "out link",
+                                     ctx.tile_name)
+            self.entry_fseqs = _single(ctx.out_fseqs, "out link",
+                                       ctx.tile_name)
+        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.mtu = max((ctx.plan["links"][ln]["mtu"]
+                        for ln in ctx.in_rings), default=64)
+        self.slot = 0
+        self.tick_in_slot = 0
+        self.hashes_in_tick = 0
+        self.entry_idx = 0
+        self.m = {k: 0 for k in self.METRICS}
+
+    def _publish_entry(self, num_hashes: int, prev: bytes,
+                       mixin: bytes | None):
+        frame = struct.pack("<QII B", self.slot, self.tick_in_slot,
+                            num_hashes, 1 if mixin else 0)
+        frame += prev + self.state + (mixin or bytes(32))
+        while self.entry_fseqs and \
+                self.entry_out.credits(self.entry_fseqs) <= 0:
+            self.m["backpressure"] += 1
+            time.sleep(20e-6)
+        self.entry_out.publish(frame, sig=self.entry_idx)
+        self.entry_idx += 1
+        self.m["entries"] += 1
+
+    def poll_once(self) -> int:
+        total = 0
+        # 1) mix in executed microblocks (one hash consumed per record;
+        # fd_poh mixin semantics, src/ballet/poh/fd_poh.c)
+        for ln, ring in self.ctx.in_rings.items():
+            n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
+                self.seqs[ln], 16, self.mtu)
+            self.m["overruns"] += ovr
+            for i in range(n):
+                # a record must fit before the tick boundary
+                if self.hashes_in_tick + 1 >= self.hashes_per_tick:
+                    self._tick()
+                mixin = bytes(buf[i, 10:42])
+                prev = self.state
+                self.state = self._mixin(prev, mixin)
+                self.hashes_in_tick += 1
+                self._publish_entry(1, prev, mixin)
+                self.m["mixins"] += 1
+            total += n
+        return total
+
+    def _tick(self):
+        remaining = self.hashes_per_tick - self.hashes_in_tick
+        prev = self.state
+        self.state = self._append(prev, remaining)
+        self._publish_entry(remaining, prev, None)
+        self.hashes_in_tick = 0
+        self.tick_in_slot += 1
+        self.m["ticks"] += 1
+        if self.tick_in_slot >= self.ticks_per_slot:
+            if self.slot_out is not None:
+                while self.slot_fseqs and \
+                        self.slot_out.credits(self.slot_fseqs) <= 0:
+                    time.sleep(20e-6)
+                self.slot_out.publish(struct.pack("<Q", self.slot),
+                                      sig=self.slot)
+            self.slot += 1
+            self.tick_in_slot = 0
+            self.m["slots"] += 1
+
+    def housekeeping(self):
+        # tick cadence: one tick per housekeeping interval (the jittered
+        # stem timer stands in for the tick clock; production would pace
+        # against tempo ticks-per-ns calibration)
+        self._tick()
+
+    def in_seqs(self):
+        return dict(self.seqs)
 
     def metrics_items(self):
         return dict(self.m)

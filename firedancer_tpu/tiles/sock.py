@@ -1,0 +1,59 @@
+"""UDP socket ingest tile — the net-tile fallback path.
+
+The reference's ingress ladder is XDP (kernel-bypass) with a plain
+socket fallback (ref: src/disco/net/sock/fd_sock_tile.c:1-35 — batched
+recvmmsg into ring frags, the same frag contract as the XDP tile). This
+tile is the socket rung re-expressed for the shm ring runtime: a
+non-blocking bound UDP socket drained in bursts straight into the out
+ring, with ring credits as backpressure (packets beyond them stay in the
+kernel socket buffer — the kernel is the overflow queue, as with the
+reference's ring-buffer-full drop accounting).
+
+QUIC TPU ingest (src/waltz/quic/) terminates streams above this layer;
+this tile is the dgram transport it and the bench harness share.
+"""
+from __future__ import annotations
+
+import errno
+import socket
+
+
+class SockTile:
+    def __init__(self, out_ring, out_fseqs, port: int = 0,
+                 bind_addr: str = "127.0.0.1", batch: int = 64,
+                 mtu: int = 1500):
+        self.out = out_ring
+        self.out_fseqs = out_fseqs
+        self.batch = batch
+        self.mtu = mtu
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 21)
+        self.sock.bind((bind_addr, port))
+        self.sock.setblocking(False)
+        self.port = self.sock.getsockname()[1]
+        self.metrics = {"rx": 0, "bytes": 0, "oversz": 0,
+                        "backpressure": 0, "port": self.port}
+
+    def poll_once(self) -> int:
+        n = 0
+        while n < self.batch:
+            if self.out_fseqs and self.out.credits(self.out_fseqs) <= 0:
+                self.metrics["backpressure"] += 1
+                break
+            try:
+                data = self.sock.recv(self.mtu + 1)
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    break
+                raise
+            if len(data) > self.mtu:
+                self.metrics["oversz"] += 1     # jumbo: drop, don't trunc
+                continue
+            self.out.publish(data, sig=self.metrics["rx"])
+            self.metrics["rx"] += 1
+            self.metrics["bytes"] += len(data)
+            n += 1
+        return n
+
+    def close(self):
+        self.sock.close()

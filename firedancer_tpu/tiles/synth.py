@@ -24,20 +24,30 @@ def make_signed_txns(n: int, seed: int = 0,
             _, _, pub = keypair(seed_bytes)
             return pub, sign(seed_bytes, msg)
 
+    from ..pack.cost import SYSTEM_PROGRAM_ID
+
     rng = np.random.default_rng(seed)
     out = []
     for i in range(n):
-        key_seed = hashlib.sha256(b"synth-%d" % (i % 16)).digest()
+        key_seed = synth_signer_seed(i)
         blockhash = hashlib.sha256(b"hash-%d" % seed).digest()
         dest = hashlib.sha256(b"dest-%d" % i).digest()
-        # system-transfer-shaped instruction: prog=2, 8B data
-        data = int(rng.integers(1, 1 << 31)).to_bytes(8, "little")
+        # real system-program Transfer: u32 discriminant 2 + u64
+        # lamports — executable by the bank tile's SVM wave executor
+        data = b"\x02\x00\x00\x00" \
+            + int(rng.integers(1, 1 << 31)).to_bytes(8, "little")
         pub, _ = signer(key_seed, b"")
-        msg = build_message([pub], [dest, bytes(32)], blockhash,
+        msg = build_message([pub], [dest, SYSTEM_PROGRAM_ID], blockhash,
                             [(2, bytes([0, 1]), data)], n_ro_unsigned=1)
         _, sig = signer(key_seed, msg)
         out.append(build_txn([sig], msg))
     return out
+
+
+def synth_signer_seed(i: int) -> bytes:
+    """Deterministic signer seeds (16 distinct keys) so tests can fund
+    the synth accounts at genesis."""
+    return hashlib.sha256(b"synth-%d" % (i % 16)).digest()
 
 
 class SynthTile:
