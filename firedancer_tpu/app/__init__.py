@@ -1,0 +1,5 @@
+"""App layer: layered TOML config + run command (the fdctl analog,
+ref: src/app/fdctl/main.c, src/app/shared/commands/run/run.c)."""
+from .config import build_topology, load_config
+
+__all__ = ["build_topology", "load_config"]

@@ -1,0 +1,104 @@
+"""Layered TOML configuration -> topology.
+
+The reference boots from a layered TOML stack: a 1,799-line default
+config overridden by the user's file, parsed into a typed struct and
+handed to the topology builder (ref: src/app/fdctl/config/default.toml,
+src/app/shared/fd_config.h, fd_config_load). This module is that seam
+re-expressed: TOML layers deep-merge (later layers win), and the merged
+document declares the whole topology — links, tcaches, tiles with their
+args — which `build_topology` materializes into the declarative
+`Topology` builder (disco/topo.py).
+
+Schema:
+
+    [topology]
+    name = "demo"            # shm namespace (default: file stem + pid)
+    wksp_size = 16777216
+
+    [[link]]
+    name = "synth_verify"
+    depth = 128              # frags (power of two)
+    mtu = 1280
+
+    [[tcache]]
+    name = "dedup_tc"
+    depth = 4096
+
+    [[tile]]
+    name = "verify"
+    kind = "verify"          # registry kind (disco/tiles.py)
+    ins = ["synth_verify"]
+    outs = ["verify_dedup"]
+    batch = 32               # every other key = tile arg, verbatim
+
+Unknown top-level sections are rejected (typo safety — the reference
+validates its config the same way, fd_config_validate).
+"""
+from __future__ import annotations
+
+import os
+import tomllib
+
+_TOP_SECTIONS = {"topology", "link", "tcache", "tile"}
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _merge_named_lists(base: list, over: list) -> list:
+    """[[link]]/[[tile]] arrays merge by `name`: same-name entries
+    deep-merge (override layer wins per key), new names append."""
+    out = {e["name"]: dict(e) for e in base}
+    for e in over:
+        name = e["name"]
+        out[name] = _deep_merge(out.get(name, {}), e)
+    return list(out.values())
+
+
+def load_config(*paths, overrides: dict | None = None) -> dict:
+    """Parse + deep-merge TOML layers left-to-right (later wins), then
+    apply the `overrides` dict (the -D command-line escape hatch)."""
+    cfg: dict = {}
+    for p in paths:
+        with open(p, "rb") as f:
+            layer = tomllib.load(f)
+        bad = set(layer) - _TOP_SECTIONS
+        if bad:
+            raise ValueError(f"{p}: unknown config sections {sorted(bad)}")
+        for key in ("link", "tcache", "tile"):
+            if key in layer:
+                cfg[key] = _merge_named_lists(cfg.get(key, []),
+                                              layer[key])
+        if "topology" in layer:
+            cfg["topology"] = _deep_merge(cfg.get("topology", {}),
+                                          layer["topology"])
+    if overrides:
+        cfg = _deep_merge(cfg, overrides)
+    return cfg
+
+
+def build_topology(cfg: dict, name: str | None = None):
+    """Merged config -> Topology (unbuilt; caller runs .build())."""
+    from ..disco import Topology
+
+    top = cfg.get("topology", {})
+    topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
+                    wksp_size=int(top.get("wksp_size", 1 << 26)))
+    for ln in cfg.get("link", []):
+        topo.link(ln["name"], depth=int(ln.get("depth", 128)),
+                  mtu=int(ln.get("mtu", 1280)))
+    for tc in cfg.get("tcache", []):
+        topo.tcache(tc["name"], depth=int(tc.get("depth", 4096)))
+    for t in cfg.get("tile", []):
+        args = {k: v for k, v in t.items()
+                if k not in ("name", "kind", "ins", "outs")}
+        topo.tile(t["name"], t["kind"], ins=t.get("ins", ()),
+                  outs=t.get("outs", ()), **args)
+    return topo

@@ -1,0 +1,57 @@
+"""`run` command: boot a topology from TOML config layers.
+
+    python -m firedancer_tpu.app.run cfg/default.toml [cfg/local.toml ...]
+        [--duration S] [--name N]
+
+The fdctl-run analog (ref: src/app/shared/commands/run/run.c): load the
+config stack, materialize the topology, spawn every tile, supervise
+fail-fast, print the monitor table periodically, tear down on SIGINT or
+after --duration seconds.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..disco.launch import TopologyRunner
+from ..disco.monitor import attach, format_table, snapshot
+from .config import build_topology, load_config
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="firedancer_tpu run")
+    ap.add_argument("config", nargs="+", help="TOML layers, later wins")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="seconds to run (0 = until SIGINT)")
+    ap.add_argument("--name", default=None, help="topology name override")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="monitor refresh seconds")
+    args = ap.parse_args(argv)
+
+    cfg = load_config(*args.config)
+    topo = build_topology(cfg, name=args.name)
+    plan = topo.build()
+    runner = TopologyRunner(plan).start()
+    try:
+        runner.wait_running()
+        t0 = time.monotonic()   # duration clock starts once tiles RUN
+        mplan, wksp = attach(plan["topology"])
+        try:
+            while not args.duration \
+                    or time.monotonic() - t0 < args.duration:
+                runner.check_failures()
+                print(format_table(snapshot(mplan, wksp)), flush=True)
+                time.sleep(args.interval)
+        finally:
+            wksp.close()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        runner.halt()
+        runner.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
