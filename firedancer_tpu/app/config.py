@@ -52,35 +52,45 @@ def _deep_merge(base: dict, over: dict) -> dict:
     return out
 
 
-def _merge_named_lists(base: list, over: list) -> list:
+def _merge_named_lists(base: list, over: list, where: str) -> list:
     """[[link]]/[[tile]] arrays merge by `name`: same-name entries
     deep-merge (override layer wins per key), new names append."""
-    out = {e["name"]: dict(e) for e in base}
-    for e in over:
-        name = e["name"]
-        out[name] = _deep_merge(out.get(name, {}), e)
+    out = {}
+    for src, entries in (("base", base), (where, over)):
+        for e in entries:
+            name = e.get("name")
+            if not isinstance(name, str):
+                raise ValueError(
+                    f"{src}: array-of-tables entry missing 'name': {e}")
+            out[name] = _deep_merge(out.get(name, {}), e)
     return list(out.values())
 
 
 def load_config(*paths, overrides: dict | None = None) -> dict:
     """Parse + deep-merge TOML layers left-to-right (later wins), then
-    apply the `overrides` dict (the -D command-line escape hatch)."""
+    apply the `overrides` dict (the -D command-line escape hatch, same
+    by-name merge semantics for link/tcache/tile arrays as TOML
+    layers)."""
     cfg: dict = {}
-    for p in paths:
-        with open(p, "rb") as f:
-            layer = tomllib.load(f)
+    layers = [(p, None) for p in paths]
+    if overrides:
+        layers.append(("<overrides>", overrides))
+    for p, preparsed in layers:
+        if preparsed is None:
+            with open(p, "rb") as f:
+                layer = tomllib.load(f)
+        else:
+            layer = preparsed
         bad = set(layer) - _TOP_SECTIONS
         if bad:
             raise ValueError(f"{p}: unknown config sections {sorted(bad)}")
         for key in ("link", "tcache", "tile"):
             if key in layer:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
-                                              layer[key])
+                                              layer[key], str(p))
         if "topology" in layer:
             cfg["topology"] = _deep_merge(cfg.get("topology", {}),
                                           layer["topology"])
-    if overrides:
-        cfg = _deep_merge(cfg, overrides)
     return cfg
 
 
@@ -97,6 +107,8 @@ def build_topology(cfg: dict, name: str | None = None):
     for tc in cfg.get("tcache", []):
         topo.tcache(tc["name"], depth=int(tc.get("depth", 4096)))
     for t in cfg.get("tile", []):
+        if "kind" not in t:
+            raise ValueError(f"[[tile]] {t.get('name')!r}: missing 'kind'")
         args = {k: v for k, v in t.items()
                 if k not in ("name", "kind", "ins", "outs")}
         topo.tile(t["name"], t["kind"], ins=t.get("ins", ()),

@@ -15,7 +15,7 @@ import sys
 import time
 
 from ..disco.launch import TopologyRunner
-from ..disco.monitor import attach, format_table, snapshot
+from ..disco.monitor import format_table, snapshot
 from .config import build_topology, load_config
 
 
@@ -36,15 +36,14 @@ def main(argv=None) -> int:
     try:
         runner.wait_running()
         t0 = time.monotonic()   # duration clock starts once tiles RUN
-        mplan, wksp = attach(plan["topology"])
-        try:
-            while not args.duration \
-                    or time.monotonic() - t0 < args.duration:
-                runner.check_failures()
-                print(format_table(snapshot(mplan, wksp)), flush=True)
-                time.sleep(args.interval)
-        finally:
-            wksp.close()
+        while not args.duration \
+                or time.monotonic() - t0 < args.duration:
+            runner.check_failures()
+            # the runner already holds the plan + workspace; no need to
+            # re-attach through the plan JSON like an external monitor
+            print(format_table(snapshot(runner.plan, runner.wksp)),
+                  flush=True)
+            time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
     finally:

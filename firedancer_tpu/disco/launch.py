@@ -96,10 +96,9 @@ class TopologyRunner:
                 for tn in self.procs}
 
     def metrics(self, tile_name: str):
-        from .tiles import REGISTRY
         vals = topo_mod.read_metrics(self.wksp, self.plan, tile_name)
-        kind = self.plan["tiles"][tile_name]["kind"]
-        names = getattr(REGISTRY[kind], "METRICS", [])
+        # the plan carries the slot-name ABI (reorder-proof; r2 W7)
+        names = self.plan["tiles"][tile_name].get("metrics_names", [])
         return {nm: int(vals[i]) for i, nm in enumerate(names)}
 
     def halt(self, join_timeout_s: float = 30.0):

@@ -1,0 +1,150 @@
+"""Metrics: named-slot ABI, log2 latency histograms, prometheus text.
+
+The reference lays per-tile counters/gauges/histograms out in shared
+memory at codegen-fixed offsets (ref: src/disco/metrics/fd_metrics.h:6-40,
+generated/fd_metrics_all.h) and serves them as prometheus text from the
+metric tile (ref: src/disco/metrics/fd_prometheus.c, fd_metric_tile.c).
+Latency attribution uses fixed-bucket log histograms
+(ref: src/util/hist/fd_histf.h) fed from the stem's per-iteration timing.
+
+Here the slot ABI is explicit in the topology plan: build() records each
+tile's metric slot names (`metrics_names`), so readers match by name from
+the plan, never by adapter class list order — a reorder of a tile's
+METRICS declaration cannot mislabel monitor output (the r2 W7 fix).
+
+Histogram region layout per tile (all u64, little-endian, single writer):
+
+    [0] count   [1] sum_ns   [2..2+NBUCKETS) bucket counts
+
+bucket i counts samples with ns in [2^i, 2^(i+1)) (bucket 0 takes 0/1ns,
+bucket NBUCKETS-1 is the overflow tail). Two histograms per tile: WAIT
+(poll_once returned 0 — idle spin) and WORK (frags were processed), the
+same wait/work split the reference attributes per link pair
+(ref: fd_stem.c metrics, src/disco/metrics/fd_metrics.h regime counters).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+NBUCKETS = 32
+HIST_U64 = 2 + NBUCKETS          # count, sum_ns, buckets
+HIST_KINDS = ("wait", "work")    # order fixes the shm layout
+HIST_REGION_U64 = HIST_U64 * len(HIST_KINDS)
+
+
+def bucket_of(ns: int) -> int:
+    """Log2 bucket index for a nanosecond sample."""
+    if ns <= 1:
+        return 0
+    return min(NBUCKETS - 1, int(ns).bit_length() - 1)
+
+
+class HistAccum:
+    """Tile-local accumulator, flushed wholesale to shm (single writer,
+    cumulative counts — readers never see decreasing values)."""
+
+    def __init__(self):
+        self.count = 0
+        self.sum_ns = 0
+        self.buckets = [0] * NBUCKETS
+
+    def add(self, ns: int):
+        self.count += 1
+        self.sum_ns += ns
+        self.buckets[bucket_of(ns)] += 1
+
+    def flush_into(self, view_u64: np.ndarray):
+        # count is written LAST: a racing reader may see stale buckets
+        # with the old count (slightly stale quantiles) but never a
+        # count exceeding the bucket sum (which would break the
+        # cumulative rendering and push quantiles to the sentinel)
+        view_u64[1] = self.sum_ns
+        view_u64[2:2 + NBUCKETS] = self.buckets
+        view_u64[0] = self.count
+
+
+def read_hists(wksp, plan: dict, tile_name: str) -> dict:
+    """{kind: {count, sum_ns, buckets[NBUCKETS]}} from shm."""
+    off = plan["tiles"][tile_name].get("hist_off")
+    if off is None:
+        return {}
+    raw = wksp.view(off, HIST_REGION_U64 * 8).view(np.uint64).copy()
+    out = {}
+    for k, kind in enumerate(HIST_KINDS):
+        h = raw[k * HIST_U64:(k + 1) * HIST_U64]
+        out[kind] = {"count": int(h[0]), "sum_ns": int(h[1]),
+                     "buckets": [int(x) for x in h[2:]]}
+    return out
+
+
+def quantile_ns(hist: dict, q: float) -> int:
+    """Upper-bound estimate of the q-quantile from log2 buckets."""
+    count = hist["count"]
+    if not count:
+        return 0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(hist["buckets"]):
+        cum += c
+        if cum >= target:
+            return 1 << (i + 1)
+    return 1 << NBUCKETS
+
+
+# ---------------------------------------------------------------------------
+# prometheus text rendering (ref: src/disco/metrics/fd_prometheus.c)
+# ---------------------------------------------------------------------------
+
+def _esc(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_prometheus(plan: dict, wksp) -> str:
+    """All tiles' named counters + wait/work histograms + liveness, in
+    prometheus text exposition format. Reader-side only (any process
+    attached to the workspace can render)."""
+    from ..runtime import Cnc, CNC_RUN
+    from . import topo as topo_mod
+
+    topo = _esc(plan.get("topology", "?"))
+    lines = [
+        "# TYPE fdtpu_tile_up gauge",
+        "# TYPE fdtpu_heartbeat_age_ticks gauge",
+        "# TYPE fdtpu_tile_metric counter",
+    ]
+    hist_lines: list[str] = []
+    now = topo_mod.now_ticks()
+    for tn, spec in plan["tiles"].items():
+        lab = f'topology="{topo}",tile="{_esc(tn)}",kind="{_esc(spec["kind"])}"'
+        cnc = Cnc(wksp, off=spec["cnc_off"])
+        up = 1 if cnc.state == CNC_RUN else 0
+        lines.append(f"fdtpu_tile_up{{{lab}}} {up}")
+        age = max(0, now - cnc.last_heartbeat)
+        lines.append(f"fdtpu_heartbeat_age_ticks{{{lab}}} {age}")
+        vals = topo_mod.read_metrics(wksp, plan, tn)
+        for i, nm in enumerate(spec.get("metrics_names", [])):
+            if i >= len(vals):
+                break
+            lines.append(
+                f'fdtpu_tile_metric{{{lab},name="{_esc(nm)}"}} {int(vals[i])}')
+        for kind, h in read_hists(wksp, plan, tn).items():
+            base = f"fdtpu_poll_{kind}_seconds"
+            cum = 0
+            # the last bucket is the clamp/overflow bucket (bucket_of's
+            # min()): fold it into +Inf instead of claiming a finite le
+            for i, c in enumerate(h["buckets"][:-1]):
+                cum += c
+                le = (1 << (i + 1)) / 1e9
+                hist_lines.append(
+                    f'{base}_bucket{{{lab},le="{le:g}"}} {cum}')
+            # clamp keeps the series monotone even if a reader raced a
+            # flush (count and buckets are written at distinct instants)
+            total = max(h["count"], cum + h["buckets"][-1])
+            hist_lines.append(f'{base}_bucket{{{lab},le="+Inf"}} {total}')
+            hist_lines.append(f'{base}_sum{{{lab}}} {h["sum_ns"] / 1e9:g}')
+            hist_lines.append(f'{base}_count{{{lab}}} {total}')
+    if hist_lines:
+        lines.append("# TYPE fdtpu_poll_wait_seconds histogram")
+        lines.append("# TYPE fdtpu_poll_work_seconds histogram")
+        lines.extend(hist_lines)
+    return "\n".join(lines) + "\n"

@@ -25,30 +25,41 @@ _STATE = {CNC_BOOT: "boot", CNC_RUN: "run", CNC_HALT: "halt",
 
 
 def snapshot(plan: dict, wksp: Workspace) -> dict:
-    """{tile: {state, hb_age_ticks, metrics{...}}}"""
-    from .tiles import REGISTRY
+    """{tile: {state, hb_age_ticks, metrics{...}, wait/work latency}}"""
+    from .metrics import quantile_ns, read_hists
     out = {}
     now = topo_mod.now_ticks()
     for tn, spec in plan["tiles"].items():
         cnc = Cnc(wksp, off=spec["cnc_off"])
         vals = topo_mod.read_metrics(wksp, plan, tn)
-        names = getattr(REGISTRY.get(spec["kind"], object), "METRICS", [])
+        # slot names come from the plan ABI, not adapter class order
+        names = spec.get("metrics_names", [])
+        hists = read_hists(wksp, plan, tn)
         out[tn] = {
             "kind": spec["kind"],
             "state": _STATE.get(cnc.state, f"?{cnc.state}"),
             # clamp: clock reads race across processes by a few ticks
             "hb_age_ticks": max(0, now - cnc.last_heartbeat),
             "metrics": {nm: int(vals[i]) for i, nm in enumerate(names)},
+            "latency": {
+                kind: {"count": h["count"],
+                       "p50_us": quantile_ns(h, 0.50) / 1e3,
+                       "p99_us": quantile_ns(h, 0.99) / 1e3}
+                for kind, h in hists.items()
+            },
         }
     return out
 
 
 def format_table(snap: dict) -> str:
-    lines = [f"{'tile':<14}{'kind':<10}{'state':<7}{'hb_age':>12}  metrics"]
+    lines = [f"{'tile':<14}{'kind':<10}{'state':<7}{'hb_age':>12}"
+             f"{'work_p99us':>12}  metrics"]
     for tn, row in snap.items():
         ms = " ".join(f"{k}={v}" for k, v in row["metrics"].items() if v)
+        work = row.get("latency", {}).get("work", {})
+        p99 = f"{work.get('p99_us', 0):.0f}" if work.get("count") else "-"
         lines.append(f"{tn:<14}{row['kind']:<10}{row['state']:<7}"
-                     f"{row['hb_age_ticks']:>12}  {ms}")
+                     f"{row['hb_age_ticks']:>12}{p99:>12}  {ms}")
     return "\n".join(lines)
 
 

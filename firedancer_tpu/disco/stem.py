@@ -24,6 +24,7 @@ import random
 import time
 
 from ..runtime import CNC_RUN, CNC_HALT, CNC_FAIL
+from .metrics import HIST_U64, HistAccum
 
 
 class Stem:
@@ -33,20 +34,29 @@ class Stem:
         self.ctx, self.tile = ctx, tile
         self.hk_interval_s = hk_interval_s
         self.idle_sleep_s = idle_sleep_s
-        self._metrics_names: list[str] | None = None
+        # slot-name ABI comes from the plan (explicit, reorder-proof);
+        # a tile kind with no registered names falls back to the dict
+        # insertion order of its first metrics_items() result
+        self._metrics_names: list[str] | None = \
+            list(ctx.spec.get("metrics_names", [])) or None
+        # wait/work poll latency histograms (flushed at housekeeping)
+        self._hists = {"wait": HistAccum(), "work": HistAccum()}
 
     def _flush_metrics(self):
         items = getattr(self.tile, "metrics_items", None)
-        if items is None:
-            return
-        d = items()
-        if self._metrics_names is None:
-            self._metrics_names = list(d.keys())
-        view = self.ctx.metrics_view()
-        for i, k in enumerate(self._metrics_names):
-            if i >= len(view):
-                break
-            view[i] = d.get(k, 0)
+        if items is not None:
+            d = items()
+            if self._metrics_names is None:
+                self._metrics_names = list(d.keys())
+            view = self.ctx.metrics_view()
+            for i, k in enumerate(self._metrics_names):
+                if i >= len(view):
+                    break
+                view[i] = d.get(k, 0)
+        hv = self.ctx.hist_view()
+        if hv is not None:
+            self._hists["wait"].flush_into(hv[0:HIST_U64])
+            self._hists["work"].flush_into(hv[HIST_U64:2 * HIST_U64])
 
     def _update_in_fseqs(self):
         """Publish consumer progress so upstream producers see credits."""
@@ -79,7 +89,13 @@ class Stem:
                     self._flush_metrics()
                     next_hk = now + self.hk_interval_s * (
                         0.7 + 0.6 * random.random())
+                t0 = time.perf_counter_ns()
                 n = self.tile.poll_once()
+                # wait/work latency attribution: an idle poll is time
+                # spent waiting on upstream, a productive one is work
+                # (the reference's per-link regime split)
+                self._hists["work" if n else "wait"].add(
+                    time.perf_counter_ns() - t0)
                 if not n:
                     time.sleep(self.idle_sleep_s)
                 iters += 1

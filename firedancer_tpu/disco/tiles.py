@@ -603,6 +603,65 @@ class PohAdapter:
         return dict(self.m)
 
 
+@register("metric")
+class MetricAdapter:
+    """Prometheus scrape endpoint (ref: src/disco/metrics/fd_metric_tile.c
+    + fd_prometheus.c): serves GET /metrics with every tile's named
+    counters and wait/work latency histograms, rendered straight from the
+    shared-memory metrics regions. The HTTP server runs on a daemon
+    thread; the tile loop itself is idle (all state lives in shm).
+
+    args: port (0 = ephemeral; bound port published in the "port"
+    metric), bind_addr."""
+
+    METRICS = ["port", "scrapes"]
+
+    def __init__(self, ctx, args):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from .metrics import render_prometheus
+        self.ctx = ctx
+        self.scrapes = 0
+        adapter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = render_prometheus(
+                    adapter.ctx.plan, adapter.ctx.wksp).encode()
+                adapter.scrapes += 1
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):       # keep tile stdout quiet
+                pass
+
+        self.server = ThreadingHTTPServer(
+            (args.get("bind_addr", "127.0.0.1"), int(args.get("port", 0))),
+            Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def poll_once(self) -> int:
+        return 0
+
+    def on_halt(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def metrics_items(self):
+        return {"port": self.port, "scrapes": self.scrapes}
+
+
 @register("sink")
 class SinkAdapter:
     """Terminal consumer: counts frags (the reference's bencho TPS

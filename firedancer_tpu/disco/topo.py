@@ -28,6 +28,14 @@ from ..runtime import Workspace, Ring, Fseq, Cnc, Tcache, lib
 METRICS_SLOTS = 64          # u64 counters per tile
 
 
+def _metric_names(kind: str) -> list[str]:
+    """Slot names for a tile kind, frozen into the plan at build time
+    (the moral equivalent of the reference's metrics codegen fixing
+    offsets at compile time, src/disco/metrics/gen_metrics.py)."""
+    from .tiles import REGISTRY
+    return list(getattr(REGISTRY.get(kind, object), "METRICS", []))
+
+
 @dataclass
 class LinkSpec:
     name: str
@@ -128,6 +136,7 @@ class Topology:
             for name, depth in self.tcaches.items():
                 tc = Tcache(w, depth=depth)
                 plan["tcaches"][name] = {"off": tc.off, "depth": depth}
+            from .metrics import HIST_REGION_U64
             for tn, t in self.tiles.items():
                 for i in t.ins:
                     if i["reliable"]:
@@ -136,6 +145,8 @@ class Topology:
                 cnc = Cnc(w)
                 metrics_off = w.alloc(METRICS_SLOTS * 8)
                 w.view(metrics_off, METRICS_SLOTS * 8)[:] = 0
+                hist_off = w.alloc(HIST_REGION_U64 * 8)
+                w.view(hist_off, HIST_REGION_U64 * 8)[:] = 0
                 plan["tiles"][tn] = {
                     "kind": t.kind,
                     "ins": list(t.ins),
@@ -143,6 +154,10 @@ class Topology:
                     "args": dict(t.args),
                     "cnc_off": cnc.off,
                     "metrics_off": metrics_off,
+                    "hist_off": hist_off,
+                    # explicit slot-name ABI: readers match by these names,
+                    # never by adapter class declaration order (r2 W7)
+                    "metrics_names": _metric_names(t.kind),
                 }
         except Exception:
             w.close()
@@ -202,6 +217,16 @@ class TileCtx:
         import numpy as np
         return self.wksp.view(self.metrics_off, METRICS_SLOTS * 8) \
             .view(np.uint64)
+
+    def hist_view(self):
+        """u64 view of this tile's wait/work histogram region (or None
+        for plans built before histograms existed)."""
+        import numpy as np
+        off = self.spec.get("hist_off")
+        if off is None:
+            return None
+        from .metrics import HIST_REGION_U64
+        return self.wksp.view(off, HIST_REGION_U64 * 8).view(np.uint64)
 
     def close(self):
         self.wksp.close()

@@ -1,0 +1,117 @@
+"""Metrics subsystem tests: named-slot ABI, wait/work histograms,
+prometheus endpoint (ref: src/disco/metrics/fd_metrics.h:6-40,
+fd_prometheus.c, fd_metric_tile.c; histograms src/util/hist/fd_histf.h).
+"""
+import os
+import time
+import urllib.request
+
+import pytest
+
+from firedancer_tpu.disco import Topology, TopologyRunner
+from firedancer_tpu.disco.metrics import (
+    NBUCKETS, HistAccum, bucket_of, quantile_ns, read_hists,
+)
+from firedancer_tpu.disco.monitor import attach, snapshot
+
+
+def test_bucket_of_log2():
+    assert bucket_of(0) == 0
+    assert bucket_of(1) == 0
+    assert bucket_of(2) == 1
+    assert bucket_of(3) == 1
+    assert bucket_of(1024) == 10
+    assert bucket_of(1 << 60) == NBUCKETS - 1
+
+
+def test_quantile_upper_bound():
+    h = HistAccum()
+    for ns in [10, 10, 10, 10_000]:
+        h.add(ns)
+    d = {"count": h.count, "sum_ns": h.sum_ns, "buckets": h.buckets}
+    assert quantile_ns(d, 0.5) == 16          # 2^(3+1): bucket of 10
+    assert quantile_ns(d, 0.99) == 16384      # 2^(13+1): bucket of 10_000
+    assert quantile_ns({"count": 0, "sum_ns": 0,
+                        "buckets": [0] * NBUCKETS}, 0.5) == 0
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    topo = (
+        Topology(f"tm{os.getpid()}", wksp_size=1 << 23)
+        .link("s_k", depth=64, mtu=1280)
+        .tile("synth", "synth", outs=["s_k"], count=32, unique=8, seed=9)
+        .tile("sink", "sink", ins=["s_k"])
+        .tile("metric", "metric", port=0)
+    )
+    plan = topo.build()
+    runner = TopologyRunner(plan).start()
+    try:
+        runner.wait_running(timeout_s=120)
+        runner.wait_idle("sink", "rx", 32, timeout_s=120)
+        yield runner
+    finally:
+        runner.halt()
+        runner.close()
+
+
+def test_plan_carries_slot_names(pipeline):
+    tiles = pipeline.plan["tiles"]
+    assert tiles["synth"]["metrics_names"] == ["tx", "backpressure"]
+    assert tiles["sink"]["metrics_names"] == ["rx", "bytes", "overruns"]
+    # readers resolve by plan names — values land under the right keys
+    assert pipeline.metrics("synth")["tx"] == 32
+    assert pipeline.metrics("sink")["rx"] == 32
+
+
+def test_histograms_populate(pipeline):
+    # one housekeeping flush after the traffic
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        h = read_hists(pipeline.wksp, pipeline.plan, "sink")
+        if h and h["work"]["count"] > 0 and h["wait"]["count"] > 0:
+            break
+        time.sleep(0.05)
+    assert h["work"]["count"] > 0, "sink did work but no work samples"
+    assert h["wait"]["count"] > 0, "sink idled but no wait samples"
+    assert h["work"]["sum_ns"] > 0
+    assert sum(h["work"]["buckets"]) == h["work"]["count"]
+    # monitor surfaces latency quantiles
+    plan, wksp = attach(pipeline.plan["topology"])
+    try:
+        snap = snapshot(plan, wksp)
+        lat = snap["sink"]["latency"]
+        assert lat["work"]["count"] > 0
+        assert lat["work"]["p99_us"] >= lat["work"]["p50_us"] > 0
+    finally:
+        wksp.close()
+
+
+def test_prometheus_endpoint(pipeline):
+    port = pipeline.metrics("metric")["port"]
+    assert port > 0
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        assert r.status == 200
+        body = r.read().decode()
+    assert '# TYPE fdtpu_tile_metric counter' in body
+    assert 'tile="sink"' in body and 'name="rx"} 32' in body
+    # histogram exposition: cumulative buckets, monotone, +Inf == count
+    lines = [ln for ln in body.splitlines()
+             if ln.startswith('fdtpu_poll_work_seconds_bucket{'
+                              'topology') and 'tile="sink"' in ln]
+    assert lines, body[:500]
+    cum = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert cum == sorted(cum)
+    count_ln = [ln for ln in body.splitlines()
+                if ln.startswith("fdtpu_poll_work_seconds_count")
+                and 'tile="sink"' in ln]
+    assert int(count_ln[0].rsplit(" ", 1)[1]) == cum[-1]
+    # scrape counter advanced
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if pipeline.metrics("metric")["scrapes"] >= 1:
+            break
+        time.sleep(0.05)
+    assert pipeline.metrics("metric")["scrapes"] >= 1

@@ -52,8 +52,8 @@ def test_pallas_verify_matches_jnp():
     kernel invocation instead of a second full run."""
     rng = np.random.default_rng(11)
     sig, pub, msg, ml = _mixed_batch(8, 32, rng)
-    sig = np.asarray(sig)
-    pub = np.asarray(pub)
+    sig = np.array(sig)   # np.asarray over a jax array is a read-only view
+    pub = np.array(pub)
     # lane 1 already corrupt-R, 2 corrupt-S, 3 corrupt-msg (mixed_batch);
     # overwrite lanes 5-7 with the structural edge cases:
     pub[5] = np.frombuffer((1).to_bytes(32, "little"), np.uint8)
