@@ -1,0 +1,12 @@
+"""choreo: consensus components (ref: src/choreo/fd_choreo.h:1-12).
+
+tower — TowerBFT vote tower + lockout/threshold/switch checks
+ghost — LMD-GHOST weighted fork choice tree
+eqvoc — equivocation (duplicate block/shred) detection
+"""
+from .eqvoc import EqvocDetector, EquivocationProof, FecMeta  # noqa: F401
+from .ghost import Ghost, GhostNode  # noqa: F401
+from .tower import (  # noqa: F401
+    MAX_LOCKOUT_HISTORY, SWITCH_RATIO, THRESHOLD_DEPTH, THRESHOLD_RATIO,
+    Tower, TowerVote,
+)

@@ -1,0 +1,270 @@
+"""choreo tests: the worked examples from the reference's tower/ghost
+tutorial comments, replayed against our implementation
+(ref: src/choreo/tower/fd_tower.h:1-340, src/choreo/ghost/fd_ghost.h,
+src/choreo/eqvoc/fd_eqvoc.h)."""
+import pytest
+
+from firedancer_tpu.choreo import (
+    EqvocDetector, FecMeta, Ghost, Tower,
+)
+
+
+def bid(n: int) -> bytes:
+    return n.to_bytes(32, "little")
+
+
+# ---------------------------------------------------------------------------
+# tower state transitions (fd_tower.h worked examples)
+# ---------------------------------------------------------------------------
+
+def tower_of(*pairs):
+    t = Tower()
+    for slot, conf in pairs:
+        t.votes.append(__import__(
+            "firedancer_tpu.choreo.tower", fromlist=["TowerVote"]
+        ).TowerVote(slot, conf))
+    return t
+
+
+def as_pairs(t: Tower):
+    return [(v.slot, v.conf) for v in t.votes]
+
+
+def test_vote_expiry_doc_example():
+    """Tower [1:4, 2:3, 3:2, 4:1]; vote 9 expires 4 (exp 6) and 3 (exp 7)
+    giving [1:4, 2:3, 9:1] (ref: fd_tower.h 'vote for slot 9')."""
+    t = tower_of((1, 4), (2, 3), (3, 2), (4, 1))
+    assert t.vote(9) is None
+    assert as_pairs(t) == [(1, 4), (2, 3), (9, 1)]
+
+
+def test_vote_doubling_doc_example():
+    """Then vote 10: only the consecutive run doubles -> 9's conf becomes
+    2, while 2 and 1 are unchanged (gap at conf 2)."""
+    t = tower_of((1, 4), (2, 3), (9, 1))
+    assert t.vote(10) is None
+    assert as_pairs(t) == [(1, 4), (2, 3), (9, 2), (10, 1)]
+
+
+def test_expiry_is_top_down_contiguous():
+    """fd_tower.h: voting 11 does NOT expire vote 2 (exp 10 < 11)
+    because 10 (exp 12) and 9 (exp 13) survive on top and expiry stops
+    at the first survivor; the fully-consecutive tower then doubles
+    every lockout."""
+    t = tower_of((1, 4), (2, 3), (9, 2), (10, 1))
+    t.vote(11)
+    assert as_pairs(t) == [(1, 5), (2, 4), (9, 3), (10, 2), (11, 1)]
+
+
+def test_rooting_pops_bottom_at_max():
+    t = Tower(max_lockout_history=4)
+    assert t.vote(1) is None
+    assert t.vote(2) is None
+    assert t.vote(3) is None
+    assert t.vote(4) is None
+    # 5th consecutive vote roots the bottom
+    assert t.vote(5) == 1
+    assert t.root == 1
+    assert as_pairs(t) == [(2, 4), (3, 3), (4, 2), (5, 1)]
+
+
+def test_full_depth_rooting():
+    t = Tower()
+    roots = [t.vote(s) for s in range(1, 40)]
+    # the 32nd consecutive vote roots slot 1
+    assert roots[:31] == [None] * 31
+    assert roots[31] == 1
+    assert roots[32] == 2
+    assert len(t.votes) == 31
+
+
+def test_vote_must_advance():
+    t = tower_of((5, 1))
+    with pytest.raises(ValueError):
+        t.vote(5)
+
+
+# ---------------------------------------------------------------------------
+# ghost (fd_ghost.h)
+# ---------------------------------------------------------------------------
+
+def make_fork_tree():
+    """fd_tower.h switch-check diagram:
+               /-- 7
+          /-- 3-- 4
+    1-- 2  -- 6
+          \\-- 5-- 9
+    """
+    g = Ghost(bid(1), 1, total_stake=100)
+    g.insert(bid(2), 2, bid(1))
+    g.insert(bid(3), 3, bid(2))
+    g.insert(bid(4), 4, bid(3))
+    g.insert(bid(7), 7, bid(3))
+    g.insert(bid(6), 6, bid(2))
+    g.insert(bid(5), 5, bid(2))
+    g.insert(bid(9), 9, bid(5))
+    return g
+
+
+def test_ghost_weight_rollup_and_best():
+    g = make_fork_tree()
+    g.replay_vote(b"v1", 30, bid(4))
+    g.replay_vote(b"v2", 38, bid(9))
+    # subtree weights roll up (fd_ghost.h "subtree" paragraph)
+    assert g.weight(bid(2)) == 68
+    assert g.weight(bid(3)) == 30
+    assert g.weight(bid(5)) == 38
+    # greedy heaviest traversal picks 9
+    assert g.best() == bid(9)
+
+
+def test_ghost_lmd_revote_moves_stake():
+    g = make_fork_tree()
+    g.replay_vote(b"v1", 30, bid(4))
+    assert g.best() == bid(4)
+    g.replay_vote(b"v1", 30, bid(9))   # latest message replaces the old
+    assert g.weight(bid(3)) == 0
+    assert g.weight(bid(9)) == 30
+    assert g.best() == bid(9)
+
+
+def test_ghost_tie_break_lower_slot():
+    """Equal weights tie-break to the LOWER slot
+    (ref: fd_ghost.c:149-153)."""
+    g = make_fork_tree()
+    g.replay_vote(b"v1", 10, bid(4))
+    g.replay_vote(b"v2", 10, bid(9))
+    # weights at 2's children: 3 -> 10, 5 -> 10, 6 -> 0; 3 < 5 wins
+    assert g.best() == bid(4)
+
+
+def test_ghost_equivocation_invalid_then_confirmed():
+    g = Ghost(bid(1), 1, total_stake=100)
+    g.insert(bid(2), 2, bid(1))
+    g.insert(bid(40), 4, bid(2))    # block 4
+    g.insert(bid(41), 4, bid(2))    # equivocating 4'
+    g.replay_vote(b"v1", 30, bid(41))
+    g.replay_vote(b"v2", 52, bid(40))
+    g.mark_invalid(bid(40))
+    g.mark_invalid(bid(41))
+    # both versions invalid: fork choice stops at 2 (fd_ghost.h)
+    assert g.best() == bid(2)
+    # 52% on the real 4: duplicate confirmed, valid again
+    assert g.check_duplicate_confirmed(bid(40))
+    assert not g.check_duplicate_confirmed(bid(41))
+    assert g.best() == bid(40)
+
+
+def test_ghost_gca_and_publish():
+    g = make_fork_tree()
+    assert g.gca(bid(4), bid(9)) == bid(2)
+    assert g.gca(bid(7), bid(4)) == bid(3)
+    assert g.is_ancestor(bid(2), bid(9))
+    assert not g.is_ancestor(bid(4), bid(9))
+    g.replay_vote(b"v1", 10, bid(4))
+    g.replay_vote(b"v2", 20, bid(9))
+    g.publish(bid(5))
+    assert set(g.nodes) == {bid(5), bid(9)}
+    assert g.root == bid(5)
+    assert g.weight(bid(5)) == 20            # pruned fork's stake is gone
+    # votes for pruned blocks are dropped; new votes still work
+    g.replay_vote(b"v1", 10, bid(9))
+    assert g.weight(bid(9)) == 30
+
+
+# ---------------------------------------------------------------------------
+# tower checks against ghost
+# ---------------------------------------------------------------------------
+
+def test_lockout_check_doc_example():
+    """fd_tower.h: tower [1:4,2:3,3:2,4:1] on fork ...-3-4; slot 5 on the
+    other fork is locked out (exp of 4 is 6); slot 9 descending 5 passes
+    (9 > every cross-fork expiration)."""
+    g = make_fork_tree()
+    t = tower_of((1, 4), (2, 3), (3, 2), (4, 1))
+    vote_blocks = {1: bid(1), 2: bid(2), 3: bid(3), 4: bid(4)}
+    assert not t.lockout_check(bid(5), 5, g, vote_blocks)
+    assert t.lockout_check(bid(9), 9, g, vote_blocks)
+    # same-fork voting is never locked out
+    assert t.lockout_check(bid(7), 7, g, vote_blocks)
+
+
+def test_threshold_check():
+    t = Tower()
+    for s in range(1, 10):
+        t.vote(s)
+    # tower depth 9; vote at depth 8 incl. simulated vote 10 -> slot 2.
+    # voter towers need lockouts surviving the simulated vote for 10
+    # (conf >= 3 at slot 5: exp 13), else they expire and don't count
+    voters_pass = [(70, tower_of((5, 3))), (30, tower_of((1, 5)))]
+    voters_fail = [(50, tower_of((5, 3))), (50, tower_of((1, 5)))]
+    assert t.threshold_check(10, voters_pass, 100)
+    assert not t.threshold_check(10, voters_fail, 100)
+    # shallow towers always pass
+    assert Tower().threshold_check(10, [], 100)
+
+
+def test_threshold_check_expires_stale_votes():
+    """A voter whose only vote expires under the simulated vote must not
+    count (ref: fd_tower.c threshold_check comment)."""
+    t = Tower()
+    for s in range(1, 10):
+        t.vote(s)
+    # voter's vote for slot 5 conf 1 expires at 7 < 10 -> not counted
+    voters = [(70, tower_of((5, 1))), (30, tower_of((2, 5)))]
+    assert not t.threshold_check(10, voters, 100)
+
+
+def test_switch_check_doc_example():
+    """The fd_tower.h switch diagram: last vote 4, target 9, GCA 2.
+    Stake on 7 does NOT count (same GCA-subtree as our vote); stake on
+    5/9 and 6 does."""
+    g = make_fork_tree()
+    t = tower_of((4, 1))
+    g.replay_vote(b"us", 10, bid(4))
+    g.replay_vote(b"v7", 30, bid(7))          # our own GCA-subtree
+    g.replay_vote(b"v9", 30, bid(9))
+    assert not t.switch_check(bid(9), bid(4), g)   # 30 < 38
+    g.replay_vote(b"v6", 8, bid(6))
+    assert t.switch_check(bid(9), bid(4), g)       # 38 >= 38
+    # switching within our own fork is always allowed
+    assert t.switch_check(bid(7), bid(4), g) is True \
+        or t.switch_check(bid(4), bid(4), g)
+
+
+# ---------------------------------------------------------------------------
+# eqvoc (fd_eqvoc.h)
+# ---------------------------------------------------------------------------
+
+def test_eqvoc_direct_proof():
+    d = EqvocDetector()
+    a = FecMeta(7, 0, b"r1" * 16, b"s1" * 32, data_cnt=32)
+    assert d.insert_fec(a) is None
+    assert d.insert_fec(a) is None            # identical re-insert: fine
+    b = FecMeta(7, 0, b"r2" * 16, b"s2" * 32, data_cnt=32)
+    proof = d.insert_fec(b)
+    assert proof is not None and proof.kind == "direct"
+    assert proof.slot == 7 and proof.a == a and proof.b == b
+
+
+def test_eqvoc_overlap_proof():
+    d = EqvocDetector()
+    assert d.insert_fec(FecMeta(7, 0, b"r1" * 16, b"s1" * 32,
+                                data_cnt=32)) is None
+    # a second set starting inside [0, 32) implies two block layouts
+    p = d.insert_fec(FecMeta(7, 16, b"r3" * 16, b"s3" * 32, data_cnt=32))
+    assert p is not None and p.kind == "overlap"
+    # non-overlapping set is fine
+    assert d.insert_fec(FecMeta(7, 32, b"r4" * 16, b"s4" * 32,
+                                data_cnt=32)) is None
+
+
+def test_eqvoc_block_ids_and_prune():
+    d = EqvocDetector()
+    assert not d.note_block_id(5, bid(50))
+    assert d.note_block_id(5, bid(51))        # duplicate block
+    assert not d.note_block_id(6, bid(60))
+    d.insert_fec(FecMeta(5, 0, b"r" * 16, b"s" * 32, 32))
+    d.prune(6)
+    assert 5 not in d.block_ids and (5, 0) not in d.fecs
+    assert 6 in d.block_ids
