@@ -603,6 +603,43 @@ class PohAdapter:
         return dict(self.m)
 
 
+@register("sign")
+class SignAdapter:
+    """Identity-key custody tile (ref: src/disco/sign/fd_sign_tile.c).
+    args: seed (hex, 32B private key seed), clients = ordered list of
+    {role: "leader"|"gossip"|"repair"|"send", req: in link,
+    resp: out link} — the role is bound to the ring pair at topology
+    build, so policy is attached to the wire."""
+
+    METRICS = ["signed", "refused", "overruns", "backpressure"]
+
+    def __init__(self, ctx, args):
+        from ..keyguard import SignTile
+        from ..keyguard.keyguard import ROLE_NAMES
+        role_ids = {v: k for k, v in ROLE_NAMES.items()}
+        self.ctx = ctx
+        clients = []
+        for c in args["clients"]:
+            clients.append({
+                "role": role_ids[c["role"]],
+                "in_ring": ctx.in_rings[c["req"]],
+                "out_ring": ctx.out_rings[c["resp"]],
+                "out_fseqs": ctx.out_fseqs[c["resp"]],
+            })
+        self._links = [c["req"] for c in args["clients"]]
+        self.tile = SignTile(bytes.fromhex(args["seed"]), clients)
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def in_seqs(self):
+        return {ln: s for ln, s in
+                zip(self._links, self.tile.seqs)}
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
 @register("metric")
 class MetricAdapter:
     """Prometheus scrape endpoint (ref: src/disco/metrics/fd_metric_tile.c

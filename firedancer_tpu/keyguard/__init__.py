@@ -1,0 +1,7 @@
+"""keyguard: identity-key custody + role-authorized signing
+(ref: src/disco/keyguard/, src/disco/sign/fd_sign_tile.c)."""
+from .keyguard import (  # noqa: F401
+    ROLE_GOSSIP, ROLE_LEADER, ROLE_REPAIR, ROLE_SEND,
+    SIGN_TYPE_ED25519, SIGN_TYPE_SHA256_ED25519, authorize, payload_match,
+)
+from .tile import KeyguardClient, SignTile  # noqa: F401

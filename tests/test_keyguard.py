@@ -1,0 +1,163 @@
+"""keyguard tests: role-based signing authorization + the sign tile's
+request/response rings (ref: src/disco/keyguard/fd_keyguard_authorize.c,
+src/disco/sign/fd_sign_tile.c)."""
+import os
+
+from firedancer_tpu.keyguard import (
+    ROLE_GOSSIP, ROLE_LEADER, ROLE_REPAIR, ROLE_SEND,
+    SIGN_TYPE_ED25519, SIGN_TYPE_SHA256_ED25519,
+    KeyguardClient, SignTile, authorize,
+)
+from firedancer_tpu.keyguard.keyguard import PING_TOKEN_PREFIX
+from firedancer_tpu.protocol.txn import build_message
+from firedancer_tpu.runtime import Ring, Workspace
+from firedancer_tpu.utils.ed25519_ref import keypair, verify
+
+SEED = bytes(range(32))
+_, _, IDENTITY = keypair(SEED)
+
+
+def vote_message() -> bytes:
+    return build_message(
+        [IDENTITY], [b"\x07" * 32], b"\x01" * 32,
+        [(1, bytes([0]), b"vote-ix-data")])
+
+
+# ---------------------------------------------------------------------------
+# authorization matrix
+# ---------------------------------------------------------------------------
+
+def test_leader_signs_only_merkle_roots():
+    root = os.urandom(32)
+    assert authorize(IDENTITY, root, ROLE_LEADER, SIGN_TYPE_ED25519)
+    assert not authorize(IDENTITY, root + b"x", ROLE_LEADER,
+                         SIGN_TYPE_ED25519)
+    assert not authorize(IDENTITY, vote_message(), ROLE_LEADER,
+                         SIGN_TYPE_ED25519)
+
+
+def test_send_signs_only_txn_messages():
+    msg = vote_message()
+    assert authorize(IDENTITY, msg, ROLE_SEND, SIGN_TYPE_ED25519)
+    assert not authorize(IDENTITY, os.urandom(32), ROLE_SEND,
+                         SIGN_TYPE_ED25519)
+    # a gossip-ish blob must not be signable by the send role
+    assert not authorize(IDENTITY, os.urandom(200), ROLE_SEND,
+                         SIGN_TYPE_ED25519)
+
+
+def test_gossip_ping_pong_prune():
+    ping = PING_TOKEN_PREFIX + os.urandom(16)
+    assert authorize(IDENTITY, ping, ROLE_GOSSIP, SIGN_TYPE_ED25519)
+    pong = PING_TOKEN_PREFIX + os.urandom(32)
+    assert authorize(IDENTITY, pong, ROLE_GOSSIP,
+                     SIGN_TYPE_SHA256_ED25519)
+    assert not authorize(IDENTITY, pong, ROLE_GOSSIP, SIGN_TYPE_ED25519)
+    # prune must lead with OUR identity (ref: authorize.c:90)
+    prune_ok = IDENTITY + os.urandom(32)
+    prune_bad = os.urandom(64)
+    assert authorize(IDENTITY, prune_ok, ROLE_GOSSIP, SIGN_TYPE_ED25519)
+    assert authorize(IDENTITY, prune_bad, ROLE_GOSSIP,
+                     SIGN_TYPE_ED25519)  # falls into CRDS-value class
+    # but a repair-shaped request is NOT gossip-signable
+    repair = (9).to_bytes(4, "little") + IDENTITY + os.urandom(60)
+    assert not authorize(IDENTITY, repair, ROLE_GOSSIP, SIGN_TYPE_ED25519)
+
+
+def test_repair_requires_own_sender_pubkey():
+    body = os.urandom(60)
+    ok = (9).to_bytes(4, "little") + IDENTITY + body
+    wrong_key = (9).to_bytes(4, "little") + os.urandom(32) + body
+    wrong_disc = (7).to_bytes(4, "little") + IDENTITY + body
+    assert authorize(IDENTITY, ok, ROLE_REPAIR, SIGN_TYPE_ED25519)
+    assert not authorize(IDENTITY, wrong_key, ROLE_REPAIR,
+                         SIGN_TYPE_ED25519)
+    assert not authorize(IDENTITY, wrong_disc, ROLE_REPAIR,
+                         SIGN_TYPE_ED25519)
+    # shred roots are not repair-signable
+    assert not authorize(IDENTITY, os.urandom(32), ROLE_REPAIR,
+                         SIGN_TYPE_ED25519)
+
+
+def test_oversize_refused():
+    assert not authorize(IDENTITY, b"\x00" * 2000, ROLE_GOSSIP,
+                         SIGN_TYPE_ED25519)
+
+
+# ---------------------------------------------------------------------------
+# sign tile over rings
+# ---------------------------------------------------------------------------
+
+def test_sign_tile_request_response():
+    w = Workspace(f"/fdtpu_kg{os.getpid()}", 1 << 21)
+    try:
+        req_l = Ring.create(w, depth=16, mtu=1280)   # leader leg
+        rsp_l = Ring.create(w, depth=16, mtu=128)
+        req_s = Ring.create(w, depth=16, mtu=1280)   # send leg
+        rsp_s = Ring.create(w, depth=16, mtu=128)
+        tile = SignTile(SEED, [
+            {"role": ROLE_LEADER, "in_ring": req_l, "out_ring": rsp_l,
+             "out_fseqs": []},
+            {"role": ROLE_SEND, "in_ring": req_s, "out_ring": rsp_s,
+             "out_fseqs": []},
+        ])
+        leader = KeyguardClient(req_l, rsp_l)
+        sender = KeyguardClient(req_s, rsp_s)
+
+        root = os.urandom(32)
+        leader.req.publish(bytes([SIGN_TYPE_ED25519]) + root, sig=0)
+        assert tile.poll_once() == 1
+        n, _, buf, sizes, sigs, _ = rsp_l.gather(0, 4, 128)
+        assert n == 1 and buf[0, 0] == 1
+        sig = bytes(buf[0, 1:65])
+        assert verify(sig, IDENTITY, root)
+        assert tile.metrics["signed"] == 1
+
+        # the leader leg must refuse a vote-txn message (role mismatch)
+        msg = vote_message()
+        leader.req.publish(bytes([SIGN_TYPE_ED25519]) + msg, sig=1)
+        tile.poll_once()
+        assert tile.metrics["refused"] == 1
+        # ... while the send leg signs it
+        sender.req.publish(bytes([SIGN_TYPE_ED25519]) + msg, sig=0)
+        tile.poll_once()
+        n, _, buf, sizes, sigs, _ = rsp_s.gather(0, 4, 128)
+        assert n == 1 and buf[0, 0] == 1
+        assert verify(bytes(buf[0, 1:65]), IDENTITY, msg)
+    finally:
+        w.close()
+        w.unlink()
+
+
+def test_keyguard_client_roundtrip_threaded():
+    """Client blocks on the response ring while the tile polls in
+    another thread — the full req/resp discipline."""
+    import threading
+    w = Workspace(f"/fdtpu_kg2_{os.getpid()}", 1 << 21)
+    try:
+        req = Ring.create(w, depth=16, mtu=1280)
+        rsp = Ring.create(w, depth=16, mtu=128)
+        tile = SignTile(SEED, [
+            {"role": ROLE_LEADER, "in_ring": req, "out_ring": rsp,
+             "out_fseqs": []}])
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                tile.poll_once()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            client = KeyguardClient(req, rsp)
+            root = os.urandom(32)
+            sig = client.sign(root)
+            assert sig is not None and verify(sig, IDENTITY, root)
+            # refusal surfaces as None, not a timeout
+            assert client.sign(b"\xff" * 100) is None
+        finally:
+            stop.set()
+            t.join(5)
+    finally:
+        w.close()
+        w.unlink()
