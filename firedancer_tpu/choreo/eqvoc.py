@@ -56,7 +56,12 @@ class EqvocDetector:
             if (prev.merkle_root != meta.merkle_root
                     or prev.signature != meta.signature):
                 return EquivocationProof(meta.slot, prev, meta, "direct")
-            return None
+            if not (prev.data_cnt == 0 and meta.data_cnt):
+                return None
+            # extent was unknown at first sight (partial FEC set): fall
+            # through so the now-known data_cnt is overlap-checked and
+            # recorded — otherwise an early partial insert would disable
+            # overlap detection for this set forever
         # overlap scan against other sets in the same slot
         for (s, idx), other in self.fecs.items():
             if s != meta.slot or idx == meta.fec_set_idx:

@@ -175,9 +175,15 @@ def execute_block(funk, parent_xid, xid, txns) -> list[int]:
     # slot n is the dummy account targeted by padding lanes
     bal_hi = np.zeros((n + 1,), np.uint32)
     bal_lo = np.zeros((n + 1,), np.uint32)
+    from .accdb import Account
+    prior: dict = {}
     for k, i in key_idx.items():
-        v = funk.rec_query(parent_xid, k)
-        v = 0 if v is None else int(v)
+        rec = funk.rec_query(parent_xid, k)
+        prior[k] = rec
+        # funk values are either typed accdb Accounts or bare lamports
+        # ints (legacy genesis path); both carry u64 lamports
+        v = rec.lamports if isinstance(rec, Account) \
+            else (0 if rec is None else int(rec))
         bal_hi[i] = v >> 32
         bal_lo[i] = v & _MASK32
 
@@ -191,6 +197,9 @@ def execute_block(funk, parent_xid, xid, txns) -> list[int]:
             if act[wi, li]:
                 statuses[int(tix[wi, li])] = int(st[wi, li])
 
+    from .accdb import commit_lamports
+    typed = any(isinstance(v, Account) for v in prior.values())
     for k, i in key_idx.items():
-        funk.rec_write(xid, k, (int(bh[i]) << 32) | int(bl[i]))
+        commit_lamports(funk, xid, k,
+                        (int(bh[i]) << 32) | int(bl[i]), typed, prior[k])
     return statuses

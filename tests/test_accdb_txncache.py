@@ -1,0 +1,186 @@
+"""accdb facade + transaction status cache tests
+(ref: src/flamenco/accdb/fd_accdb_user.h vtable semantics,
+src/flamenco/runtime/fd_txncache.c fork-aware status queries)."""
+import numpy as np
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.svm import (
+    AccDb, Account, SystemTxn, TxnCache, execute_block,
+    execute_block_serial,
+)
+
+
+def k(n: int) -> bytes:
+    return n.to_bytes(32, "big")
+
+
+# ---------------------------------------------------------------------------
+# accdb
+# ---------------------------------------------------------------------------
+
+def test_accdb_handles_and_fork_visibility():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(1), Account(lamports=500, data=b"hello"))
+
+    assert db.peek(None, k(1)).lamports == 500
+    assert db.peek(None, k(2)) is None
+
+    funk.txn_prepare(None, "f1")
+    # open_rw is copy-on-write: nothing lands until close_rw
+    h = db.open_rw("f1", k(1))
+    h.account.lamports = 400
+    assert db.peek("f1", k(1)).lamports == 500
+    db.close_rw(h)
+    assert db.peek("f1", k(1)).lamports == 400
+    assert db.peek("f1", k(1)).data == b"hello"    # fields preserved
+    assert db.peek(None, k(1)).lamports == 500     # root untouched
+
+    # discard path: a failed txn drops its handle without landing
+    h2 = db.open_rw("f1", k(1))
+    h2.account.lamports = 1
+    db.close_rw(h2, discard=True)
+    assert db.peek("f1", k(1)).lamports == 400
+
+    # publish folds the fork into the root
+    funk.txn_publish("f1")
+    assert db.peek(None, k(1)).lamports == 400
+    assert db.rw_active == 0 and db.ro_active == 0
+
+
+def test_accdb_create_and_double_close():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.txn_prepare(None, "x")
+    assert db.open_rw("x", k(9)) is None           # absent, no create
+    h = db.open_rw("x", k(9), do_create=True)
+    assert h.created and h.account.lamports == 0
+    h.account.lamports = 77
+    db.close_rw(h)
+    assert db.lamports("x", k(9)) == 77
+    with pytest.raises(RuntimeError, match="double close"):
+        db.close_rw(h)
+
+
+def test_accdb_ro_copy_is_defensive():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(3), Account(lamports=10))
+    ro = db.open_ro(None, k(3))
+    ro.lamports = 999
+    assert db.peek(None, k(3)).lamports == 10
+    db.close_ro(ro)
+
+
+def test_executor_over_typed_accounts():
+    """The wave executor reads/writes accdb-typed Accounts, preserving
+    non-balance fields across a block."""
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(1), Account(lamports=1000, data=b"vote-state"))
+    funk.rec_write(None, k(2), Account(lamports=5))
+    txns = [SystemTxn(src=k(1), dst=k(2), amount=300, fee=10),
+            SystemTxn(src=k(2), dst=k(1), amount=100, fee=0)]
+    oracle = {k(1): 1000, k(2): 5}
+    want = execute_block_serial(oracle, txns)
+    got = execute_block(funk, None, "blk", txns)
+    assert got == want
+    for kk in (k(1), k(2)):
+        assert db.lamports("blk", kk) == oracle.get(kk, 0)
+    assert db.peek("blk", k(1)).data == b"vote-state"
+
+
+# ---------------------------------------------------------------------------
+# txncache
+# ---------------------------------------------------------------------------
+
+def test_txncache_fork_aware_queries():
+    tc = TxnCache()
+    bh, sig = b"h" * 32, b"s" * 64
+    tc.insert(10, bh, sig, status=0)
+    # visible on the fork containing slot 10, invisible on a rival fork
+    assert tc.query(bh, sig, {8, 9, 10}) == 0
+    assert tc.query(bh, sig, {8, 9, 11}) is None
+    assert tc.query(bh, b"z" * 64, {10}) is None
+    assert tc.query(b"x" * 32, sig, {10}) is None
+    # the same sig landing on the rival fork too: each fork sees its own
+    tc.insert(11, bh, sig, status=1)
+    assert tc.query(bh, sig, {11}) == 1
+    assert tc.query(bh, sig, {10}) == 0
+
+
+def test_txncache_rooted_history_always_visible():
+    tc = TxnCache()
+    bh, sig = b"h" * 32, b"s" * 64
+    tc.insert(10, bh, sig)
+    tc.register_root(12)
+    # slot 10 <= root: published history, on every fork
+    assert tc.query(bh, sig, set()) == 0
+
+
+def test_txncache_prunes_aged_blockhashes():
+    tc = TxnCache(max_age_slots=20)
+    old, new = b"o" * 32, b"n" * 32
+    tc.insert(5, old, b"a" * 64)
+    tc.insert(100, new, b"b" * 64)
+    tc.register_root(50)
+    assert tc.query(old, b"a" * 64, {5}) is None      # pruned
+    assert tc.query(new, b"b" * 64, {100}) == 0
+    assert len(tc) == 1
+
+
+def test_executor_typed_block_creates_typed_accounts():
+    """In a typed block, a brand-new destination account must land as a
+    typed Account (visible to accdb), not a bare int."""
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(1), Account(lamports=1000))
+    st = execute_block(funk, None, "blk",
+                       [SystemTxn(src=k(1), dst=k(7), amount=100, fee=0)])
+    assert st == [0]
+    assert isinstance(funk.rec_query("blk", k(7)), Account)
+    assert db.lamports("blk", k(7)) == 100
+
+
+def test_txncache_abandoned_fork_entries_purged_on_root():
+    """An entry recorded on a fork that loses must not become visible
+    as rooted history when the root passes its slot."""
+    tc = TxnCache()
+    bh, sig = b"h" * 32, b"s" * 64
+    tc.insert(5, bh, sig)             # minority fork, slot 5
+    tc.insert(6, bh, b"t" * 64)       # rooted fork, slot 6
+    tc.register_root(10, rooted_slots={6, 7, 8, 9, 10})
+    assert tc.query(bh, sig, {6, 7, 8, 9, 10}) is None
+    assert tc.query(bh, b"t" * 64, set()) == 0
+
+
+def test_eqvoc_partial_then_complete_extent():
+    """A set first seen with unknown extent (data_cnt=0) must still
+    yield an overlap proof once its true extent is known."""
+    from firedancer_tpu.choreo import EqvocDetector, FecMeta
+    d = EqvocDetector()
+    assert d.insert_fec(FecMeta(7, 0, b"r" * 16, b"s" * 32,
+                                data_cnt=0)) is None
+    assert d.insert_fec(FecMeta(7, 16, b"q" * 16, b"t" * 32,
+                                data_cnt=16)) is None
+    # completing set 0's metadata reveals it spans [0, 32) over set 16
+    p = d.insert_fec(FecMeta(7, 0, b"r" * 16, b"s" * 32, data_cnt=32))
+    assert p is not None and p.kind == "overlap"
+
+
+def test_txncache_blocks_replay_within_window():
+    """The consensus property: a txn can't execute twice on one fork
+    while its blockhash is live."""
+    rng = np.random.default_rng(5)
+    tc = TxnCache()
+    ancestors = set()
+    bh = b"r" * 32
+    executed = set()
+    for slot in range(1, 30):
+        ancestors.add(slot)
+        sig = bytes(rng.integers(0, 4, 64, dtype=np.uint8))  # collisions
+        if tc.query(bh, sig, ancestors) is None:
+            tc.insert(slot, bh, sig)
+            assert sig not in executed, "replayed a signature!"
+            executed.add(sig)
