@@ -111,7 +111,10 @@ class VerifyAdapter:
             batch=int(args.get("batch", 256)),
             max_len=int(args.get("max_len", MTU)),
             out_fseqs=_single(ctx.out_fseqs, "out link", ctx.tile_name),
-            dedup_seed=seed)
+            dedup_seed=seed,
+            rr_cnt=int(args.get("rr_cnt", 1)),
+            rr_idx=int(args.get("rr_idx", 0)),
+            devices=int(args.get("devices", 1)))
         self.tile._cnc = ctx.cnc
         self.in_link = next(iter(ctx.in_rings))
 

@@ -369,7 +369,8 @@ int fdtpu_tcache_insert(void *base, uint64_t off, uint64_t tag) {
 int64_t fdtpu_ring_gather(void *base, uint64_t ring_off, uint64_t *seq_io,
                           int64_t max_n, uint8_t *out_buf,
                           uint64_t out_stride, uint32_t *out_sz,
-                          uint64_t *out_sig, uint64_t *overrun_cnt) {
+                          uint64_t *out_sig, uint64_t *overrun_cnt,
+                          uint64_t *out_seq) {
   int64_t n = 0;
   uint64_t seq = *seq_io;
   fdtpu_frag_t frag;
@@ -403,6 +404,8 @@ int64_t fdtpu_ring_gather(void *base, uint64_t ring_off, uint64_t *seq_io,
     if (sz < out_stride) std::memset(dst + sz, 0, out_stride - sz);
     if (out_sz) out_sz[n] = sz;
     if (out_sig) out_sig[n] = frag.sig;
+    if (out_seq) out_seq[n] = seq;  /* per-frag seq: round-robin sharding
+                                       key (ref: fd_verify_tile.c:49-53) */
     n++;
     seq++;
   }

@@ -155,7 +155,8 @@ int      fdtpu_tcache_insert(void *base, uint64_t off, uint64_t tag);
 int64_t fdtpu_ring_gather(void *base, uint64_t ring_off, uint64_t *seq_io,
                           int64_t max_n, uint8_t *out_buf,
                           uint64_t out_stride, uint32_t *out_sz,
-                          uint64_t *out_sig, uint64_t *overrun_cnt);
+                          uint64_t *out_sig, uint64_t *overrun_cnt,
+                          uint64_t *out_seq);
 
 /* Tick counter (ns). */
 uint64_t fdtpu_ticks(void);

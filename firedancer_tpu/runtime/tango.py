@@ -61,7 +61,7 @@ def _load():
         "fdtpu_ring_gather": (i64, [vp, u64, ct.POINTER(u64), i64,
                                     ct.POINTER(ct.c_uint8), u64,
                                     ct.POINTER(u32), ct.POINTER(u64),
-                                    ct.POINTER(u64)]),
+                                    ct.POINTER(u64), ct.POINTER(u64)]),
         "fdtpu_ticks": (u64, []),
         "fdtpu_txn_parse_batch": (i64, [ct.POINTER(ct.c_uint8),
                                         ct.POINTER(u32), i64, u64, u64, u64,
@@ -198,21 +198,29 @@ class Ring:
     def payload(self, frag: Frag) -> np.ndarray:
         return self.wksp.view(frag.off, frag.sz)
 
-    def gather(self, seq: int, max_n: int, stride: int):
+    def gather(self, seq: int, max_n: int, stride: int,
+               want_seqs: bool = False):
         """Drain up to max_n frags into a fresh (max_n, stride) buffer.
 
         Returns (n, new_seq, buf, sizes, sigs, overruns) — the microbatch
-        assembly step of the TPU bridge tile."""
+        assembly step of the TPU bridge tile. With want_seqs, appends the
+        per-frag seq array (the round-robin sharding key,
+        ref: src/disco/verify/fd_verify_tile.c:49-53)."""
         buf = np.zeros((max_n, stride), np.uint8)
         sizes = np.zeros(max_n, np.uint32)
         sigs = np.zeros(max_n, np.uint64)
+        seqs = np.zeros(max_n, np.uint64) if want_seqs else None
         seq_io = ct.c_uint64(seq)
         ovr = ct.c_uint64(0)
         n = lib.fdtpu_ring_gather(
             self.wksp.base, self.off, ct.byref(seq_io), max_n,
             buf.ctypes.data_as(ct.POINTER(ct.c_uint8)), stride,
             sizes.ctypes.data_as(ct.POINTER(ct.c_uint32)),
-            sigs.ctypes.data_as(ct.POINTER(ct.c_uint64)), ct.byref(ovr))
+            sigs.ctypes.data_as(ct.POINTER(ct.c_uint64)), ct.byref(ovr),
+            seqs.ctypes.data_as(ct.POINTER(ct.c_uint64))
+            if want_seqs else None)
+        if want_seqs:
+            return n, seq_io.value, buf, sizes, sigs, ovr.value, seqs
         return n, seq_io.value, buf, sizes, sigs, ovr.value
 
     def credits(self, fseqs: list["Fseq"]) -> int:

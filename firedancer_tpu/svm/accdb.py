@@ -48,10 +48,17 @@ class AccDb:
     # -- reads --------------------------------------------------------------
 
     def peek(self, xid, pubkey: bytes) -> Account | None:
-        """Zero-copy borrow: the caller MUST NOT mutate or hold across a
-        write (ref: fd_accdb_peek_t semantics)."""
+        """Borrow: the caller MUST NOT mutate or hold across a write
+        (ref: fd_accdb_peek_t semantics). Legacy bare-int records (the
+        genesis lamports path) read as balance-only system Accounts, so
+        a funded key is never mistaken for absent — an open_rw over one
+        upgrades it to a typed record on close."""
         v = self.funk.rec_query(xid, pubkey)
-        return v if isinstance(v, Account) else None
+        if isinstance(v, Account):
+            return v
+        if isinstance(v, int):
+            return Account(lamports=v)
+        return None
 
     def open_ro(self, xid, pubkey: bytes) -> Account | None:
         acct = self.peek(xid, pubkey)

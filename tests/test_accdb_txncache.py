@@ -130,6 +130,24 @@ def test_txncache_prunes_aged_blockhashes():
     assert len(tc) == 1
 
 
+def test_accdb_reads_legacy_int_records():
+    """Genesis writes bare lamport ints; the facade must see the
+    balance, and an rw open over one must preserve it (upgrade to a
+    typed record on close), never wipe it."""
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(4), 500)
+    assert db.lamports(None, k(4)) == 500
+    assert db.peek(None, k(4)).lamports == 500
+    funk.txn_prepare(None, "x")
+    h = db.open_rw("x", k(4), do_create=True)
+    assert not h.created and h.account.lamports == 500
+    h.account.data = b"upgraded"
+    db.close_rw(h)
+    assert db.peek("x", k(4)).lamports == 500
+    assert funk.rec_query("x", k(4)).data == b"upgraded"
+
+
 def test_executor_typed_block_creates_typed_accounts():
     """In a typed block, a brand-new destination account must land as a
     typed Account (visible to accdb), not a bare int."""

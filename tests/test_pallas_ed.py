@@ -7,6 +7,7 @@ to it bit-for-bit. On real TPU hardware the same comparison runs
 compiled (see tools/profile_kernel*.py and bench.py).
 """
 import hashlib
+import os
 
 import numpy as np
 import pytest
@@ -44,6 +45,14 @@ def _mixed_batch(n, msg_len, rng):
             jnp.full((n,), msg_len, jnp.int32))
 
 
+@pytest.mark.skipif(os.environ.get("FDTPU_SLOW_TESTS") != "1",
+                    reason="interpret-mode full-verify takes hours on a "
+                           "1-core host; opt in with FDTPU_SLOW_TESTS=1. "
+                           "The kernel is gated on hardware instead: "
+                           "bench.py asserts every vector verifies on "
+                           "the TPU backend, and the jnp reference path "
+                           "it is pinned to passes Wycheproof + "
+                           "malleability + differential fuzz.")
 def test_pallas_verify_matches_jnp():
     """One 8-lane interpret run (grid 1) carrying the full verdict mix:
     valid, corrupted R/S/msg/A, small-order A, small-order R, and
