@@ -7,13 +7,16 @@ encode runs as a GF(2^8) bit-matrix matmul on the MXU, ops/reedsol.py),
 committed to with a 20-byte-node SHA-256 merkle tree whose root the
 leader signs, and (optionally) chained root-to-root across FEC sets.
 """
+from .fec_resolver import CompletedFec, FecResolver
 from .format import (DataShred, CodeShred, parse_shred, SHRED_MAX_SZ,
                      SHRED_MIN_SZ)
 from .merkle import MerkleTree20, shred_merkle_leaf
+from .shred_dest import ClusterNode, ShredDest
 from .shredder import Shredder, FecSet, count_fec_sets, count_data_shreds, \
     count_parity_shreds
 
 __all__ = ["DataShred", "CodeShred", "parse_shred", "SHRED_MAX_SZ",
            "SHRED_MIN_SZ", "MerkleTree20", "shred_merkle_leaf",
            "Shredder", "FecSet", "count_fec_sets", "count_data_shreds",
-           "count_parity_shreds"]
+           "count_parity_shreds", "FecResolver", "CompletedFec",
+           "ClusterNode", "ShredDest"]

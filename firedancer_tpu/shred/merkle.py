@@ -89,9 +89,8 @@ class MerkleTree20:
         return out
 
 
-def verify_proof(leaf_hash: bytes, leaf_idx: int, proof: list,
-                 root: bytes) -> bool:
-    """Recompute the root from one leaf + proof
+def root_from_proof(leaf_hash: bytes, leaf_idx: int, proof: list) -> bytes:
+    """Root implied by one leaf + inclusion proof
     (fd_bmtree_from_proof semantics, fd_bmtree.c:356-380)."""
     node = leaf_hash
     idx = leaf_idx
@@ -101,4 +100,9 @@ def verify_proof(leaf_hash: bytes, leaf_idx: int, proof: list,
         else:
             node = _merge(node, sib)
         idx >>= 1
-    return node == root
+    return node
+
+
+def verify_proof(leaf_hash: bytes, leaf_idx: int, proof: list,
+                 root: bytes) -> bool:
+    return root_from_proof(leaf_hash, leaf_idx, proof) == root

@@ -1,0 +1,101 @@
+"""Turbine retransmit tree: stake-weighted destination selection.
+
+The reference computes, per shred, a deterministic stake-weighted
+shuffle of the cluster and a fanout tree over it: the leader sends to
+the tree root, every node retransmits to its children
+(ref: src/disco/shred/fd_shred_dest.c — fd_shred_dest_compute_first /
+_compute_children; weighted sampling via src/ballet/wsample).
+
+Shuffle: deterministic weighted sampling WITHOUT replacement, seeded by
+(slot, shred idx, shred type, leader pubkey). Each node draws a key from
+a seeded keyed-hash stream and the order is descending stake-scaled
+priority (Efraimidis-Karypis: key = u^(1/stake) ranks a weighted shuffle;
+we use the equivalent -log(u)/stake form with exact integer-safe
+comparisons via floats on log space — propagation topology only, never
+consensus state, so float determinism across our own build is
+sufficient; DIVERGENCE from the reference's wsample bit-stream is
+intentional and documented).
+
+Tree: positions laid out in the shuffled order; node at position i has
+children at positions [i*fanout+1+k*? ...] — we use the classic
+contiguous layout: children(i) = positions i*fanout+1 .. i*fanout+fanout
+(ref: Agave's turbine layout; fd_shred_dest mirrors it). The leader is
+NOT part of the tree; it transmits to the root (position 0).
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+from dataclasses import dataclass
+
+DATA_PLANE_FANOUT = 200
+
+
+@dataclass(frozen=True)
+class ClusterNode:
+    pubkey: bytes
+    stake: int
+    addr: tuple = ("", 0)          # (ip, port) the net tile sends to
+
+
+class ShredDest:
+    def __init__(self, nodes: list[ClusterNode], self_pubkey: bytes,
+                 fanout: int = DATA_PLANE_FANOUT):
+        if fanout < 1:
+            raise ValueError("fanout >= 1")
+        self.nodes = {n.pubkey: n for n in nodes}
+        self.self_pubkey = self_pubkey
+        self.fanout = fanout
+
+    # -- deterministic weighted shuffle -------------------------------------
+
+    def _shuffle(self, slot: int, idx: int, shred_type: int,
+                 leader: bytes) -> list[ClusterNode]:
+        seed = hashlib.sha256(
+            b"fdtpu-turbine" + slot.to_bytes(8, "little")
+            + idx.to_bytes(4, "little") + bytes([shred_type & 0xFF])
+            + leader).digest()
+        keyed = []
+        for n in self.nodes.values():
+            if n.pubkey == leader:
+                continue           # the leader never retransmits to itself
+            if n.stake <= 0:
+                # unstaked nodes sort after all staked ones,
+                # deterministically shuffled among themselves
+                h = hashlib.sha256(seed + b"u" + n.pubkey).digest()
+                keyed.append((1, int.from_bytes(h[:8], "little"), n))
+                continue
+            h = hashlib.sha256(seed + n.pubkey).digest()
+            u = (int.from_bytes(h[:8], "little") + 1) / float(1 << 64)
+            # Efraimidis-Karypis: ascending -log(u)/w == descending
+            # stake-weighted priority
+            keyed.append((0, -math.log(u) / n.stake, n))
+        keyed.sort(key=lambda t: (t[0], t[1]))
+        return [n for _, _, n in keyed]
+
+    # -- tree queries -------------------------------------------------------
+
+    def first_hop(self, slot: int, idx: int, shred_type: int,
+                  leader: bytes) -> ClusterNode | None:
+        """Where the LEADER sends this shred (the tree root,
+        fd_shred_dest_compute_first)."""
+        order = self._shuffle(slot, idx, shred_type, leader)
+        return order[0] if order else None
+
+    def children(self, slot: int, idx: int, shred_type: int,
+                 leader: bytes) -> list[ClusterNode]:
+        """Who WE retransmit this shred to (empty if we are a leaf or
+        not in the tree; fd_shred_dest_compute_children)."""
+        order = self._shuffle(slot, idx, shred_type, leader)
+        pos = next((i for i, n in enumerate(order)
+                    if n.pubkey == self.self_pubkey), None)
+        if pos is None:
+            return []
+        lo = pos * self.fanout + 1
+        return order[lo:lo + self.fanout]
+
+    def tree_positions(self, slot: int, idx: int, shred_type: int,
+                       leader: bytes) -> list[bytes]:
+        """Full shuffled order (tests / debugging)."""
+        return [n.pubkey
+                for n in self._shuffle(slot, idx, shred_type, leader)]

@@ -1,0 +1,178 @@
+"""FEC resolver + turbine destination tests
+(ref: src/disco/shred/fd_fec_resolver.c, fd_shred_dest.c).
+
+The resolver is exercised against the repo's own Shredder output —
+shred -> drop a random subset -> resolve -> the recovered entry batch
+must be byte-identical to the original."""
+import os
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.shred import ClusterNode, FecResolver, ShredDest, Shredder
+from firedancer_tpu.utils.ed25519_ref import keypair, sign, verify
+
+SEED = bytes(range(32))
+_, _, LEADER_PK = keypair(SEED)
+
+
+def make_sets(batch: bytes, chained=False):
+    sh = Shredder(sign_fn=lambda root: sign(SEED, root), shred_version=7)
+    return sh.shred_batch(batch, slot=9, parent_off=1, ref_tick=3,
+                          block_complete=True,
+                          chained_root=bytes(32) if chained else None)
+
+
+def resolver():
+    return FecResolver(
+        verify_sig=lambda sig, root, slot: verify(sig, LEADER_PK, root))
+
+
+def roundtrip(batch: bytes, drop, chained=False):
+    """Shred, deliver all shreds except indices in `drop` (per set,
+    data-first ordering), return concatenated resolved payloads."""
+    sets = make_sets(batch, chained)
+    r = resolver()
+    out = {}
+    for fs in sets:
+        wires = list(fs.data_shreds) + list(fs.parity_shreds)
+        keep = [w for i, w in enumerate(wires) if i not in drop]
+        for w in keep:
+            done, eq = r.add_shred(w)
+            assert eq is None
+            if done:
+                assert done.merkle_root == fs.merkle_root
+                out[done.fec_set_idx] = b"".join(done.data_payloads)
+    assert len(out) == len(sets), (len(out), r.metrics)
+    return b"".join(out[k] for k in sorted(out)), r
+
+
+def test_resolve_no_loss():
+    rng = np.random.default_rng(1)
+    batch = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    got, r = roundtrip(batch, drop=set())
+    assert got == batch
+    assert r.metrics["recovered"] == 0
+
+
+@pytest.mark.parametrize("chained", [False, True])
+def test_resolve_with_data_loss(chained):
+    """Drop data shreds; parity must reconstruct them bit-exactly."""
+    rng = np.random.default_rng(2)
+    batch = rng.integers(0, 256, 12_000, dtype=np.uint8).tobytes()
+    got, r = roundtrip(batch, drop={0, 3, 5}, chained=chained)
+    assert got == batch
+    assert r.metrics["recovered"] >= 3
+    assert r.metrics["root_mismatch"] == 0
+
+
+def test_resolve_data_only_completion():
+    """All data shreds arrive, no parity needed."""
+    rng = np.random.default_rng(3)
+    batch = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+    sets = make_sets(batch)
+    r = resolver()
+    done = None
+    for w in sets[0].data_shreds:
+        done, _ = r.add_shred(w)
+    assert done is not None and b"".join(done.data_payloads) == batch
+
+
+def test_resolver_rejects_bad_signature():
+    rng = np.random.default_rng(4)
+    batch = rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()
+    sets = make_sets(batch)
+    r = FecResolver(verify_sig=lambda sig, root, slot: False)
+    for w in sets[0].data_shreds:
+        done, _ = r.add_shred(w)
+        assert done is None
+    assert r.metrics["bad_sig"] > 0
+
+
+def test_resolver_rejects_corrupt_payload():
+    """A flipped payload byte breaks the inclusion proof."""
+    rng = np.random.default_rng(5)
+    batch = rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()
+    sets = make_sets(batch)
+    w = bytearray(sets[0].data_shreds[0])
+    w[0x100] ^= 1
+    r = resolver()
+    done, _ = r.add_shred(bytes(w))
+    assert done is None
+    assert r.metrics["bad_sig"] + r.metrics["bad_proof"] == 1
+
+
+def test_resolver_flags_equivocation():
+    """Two shredder runs over different content for the same slot/set
+    key must produce an equivocation signal, not a silent overwrite."""
+    rng = np.random.default_rng(6)
+    a = rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()
+    b = rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()
+    sa = make_sets(a)[0]
+    sb = make_sets(b)[0]
+    r = resolver()
+    r.add_shred(sa.data_shreds[0])
+    done, eq = r.add_shred(sb.data_shreds[1])
+    assert done is None and eq == (9, 0)
+    assert r.metrics["eqvoc"] == 1
+
+
+# ---------------------------------------------------------------------------
+# turbine destinations
+# ---------------------------------------------------------------------------
+
+def _cluster(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return [ClusterNode(pubkey=bytes([i]) * 32,
+                        stake=int(rng.integers(1, 1000)) * 1000,
+                        addr=(f"10.0.0.{i}", 8000 + i))
+            for i in range(n)]
+
+
+def test_turbine_tree_partition():
+    """Every non-leader node appears exactly once; children sets are
+    disjoint; the union of root + all children covers the cluster."""
+    nodes = _cluster(50)
+    leader = nodes[0].pubkey
+    sd = ShredDest(nodes, self_pubkey=nodes[1].pubkey, fanout=4)
+    order = sd.tree_positions(5, 17, 0x80, leader)
+    assert len(order) == 49 and leader not in order
+    assert len(set(order)) == 49
+    seen = set()
+    for n in nodes:
+        if n.pubkey == leader:
+            continue
+        sdn = ShredDest(nodes, self_pubkey=n.pubkey, fanout=4)
+        for c in sdn.children(5, 17, 0x80, leader):
+            assert c.pubkey not in seen, "child claimed twice"
+            seen.add(c.pubkey)
+    root = sd.first_hop(5, 17, 0x80, leader).pubkey
+    assert seen | {root} == set(order)
+
+
+def test_turbine_deterministic_and_shred_dependent():
+    nodes = _cluster(30)
+    leader = nodes[3].pubkey
+    sd = ShredDest(nodes, self_pubkey=nodes[1].pubkey)
+    a = sd.tree_positions(5, 17, 0x80, leader)
+    b = sd.tree_positions(5, 17, 0x80, leader)
+    assert a == b                       # deterministic
+    c = sd.tree_positions(5, 18, 0x80, leader)
+    assert a != c                       # different shred -> different tree
+
+
+def test_turbine_stake_weighting():
+    """A dominant-stake node should be the first hop for most shreds."""
+    nodes = _cluster(20)
+    whale = ClusterNode(pubkey=b"\xaa" * 32, stake=10**12)
+    nodes.append(whale)
+    leader = nodes[0].pubkey
+    sd = ShredDest(nodes, self_pubkey=whale.pubkey, fanout=6)
+    hits = sum(sd.first_hop(5, i, 0x80, leader).pubkey == whale.pubkey
+               for i in range(40))
+    assert hits >= 30, hits
+    # unstaked nodes sort after all staked nodes
+    nodes.append(ClusterNode(pubkey=b"\xbb" * 32, stake=0))
+    sd2 = ShredDest(nodes, self_pubkey=whale.pubkey)
+    order = sd2.tree_positions(6, 1, 0x80, leader)
+    assert order[-1] == b"\xbb" * 32
