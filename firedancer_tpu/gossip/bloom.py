@@ -1,0 +1,66 @@
+"""Bloom filter for gossip pull requests (ref: src/flamenco/gossip/
+fd_bloom.h — seeded keyed hashes, false-positive-rate-sized).
+
+Pull requests carry a bloom of every CRDS hash the requester already
+holds; responders send only values whose hash misses the filter. Keys
+are the 32-byte CRDS value hashes; hashing is sha256(seed_i || key)
+truncated — deterministic across nodes given the serialized (seeds,
+bits) pair, which is what rides the wire.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+
+
+class Bloom:
+    def __init__(self, num_bits: int, num_keys: int, seed: int = 0):
+        if num_bits < 8:
+            num_bits = 8
+        self.num_bits = num_bits
+        self.num_keys = max(1, num_keys)
+        self.seed = seed
+        self.bits = bytearray((num_bits + 7) // 8)
+
+    @classmethod
+    def for_items(cls, n_items: int, fp_rate: float = 0.1,
+                  seed: int = 0) -> "Bloom":
+        """Size for a target false-positive rate (standard formulas)."""
+        n = max(1, n_items)
+        m = max(8, int(-n * math.log(max(fp_rate, 1e-9))
+                       / (math.log(2) ** 2)))
+        k = max(1, round(m / n * math.log(2)))
+        return cls(m, k, seed)
+
+    def _positions(self, key: bytes):
+        for i in range(self.num_keys):
+            h = hashlib.sha256(
+                self.seed.to_bytes(8, "little")
+                + i.to_bytes(4, "little") + key).digest()
+            yield int.from_bytes(h[:8], "little") % self.num_bits
+
+    def insert(self, key: bytes):
+        for p in self._positions(key):
+            self.bits[p >> 3] |= 1 << (p & 7)
+
+    def contains(self, key: bytes) -> bool:
+        return all(self.bits[p >> 3] & (1 << (p & 7))
+                   for p in self._positions(key))
+
+    # -- wire ---------------------------------------------------------------
+
+    def to_wire(self) -> bytes:
+        import struct
+        return struct.pack("<IIQ", self.num_bits, self.num_keys,
+                           self.seed) + bytes(self.bits)
+
+    @classmethod
+    def from_wire(cls, b: bytes) -> "Bloom":
+        import struct
+        num_bits, num_keys, seed = struct.unpack_from("<IIQ", b, 0)
+        f = cls(num_bits, num_keys, seed)
+        payload = b[16:16 + len(f.bits)]
+        if len(payload) != len(f.bits):
+            raise ValueError("truncated bloom")
+        f.bits = bytearray(payload)
+        return f

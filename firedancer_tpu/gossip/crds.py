@@ -1,0 +1,123 @@
+"""CRDS: the conflict-free replicated data store under gossip
+(ref: src/flamenco/gossip/crds/ — the value table; data model per the
+public gossip spec the reference cites in fd_gossip.h).
+
+Values are keyed by (origin pubkey, kind, index): one ContactInfo per
+node, one Vote per (node, vote index), etc. Upserts resolve by
+wallclock — strictly newer wins, ties keep the incumbent — so the store
+converges regardless of arrival order (last-writer-wins CRDT). Each
+value's 32-byte hash (over the signed payload) is the identity used by
+pull-request bloom filters.
+"""
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+# value kinds (the reference's CRDS discriminants; subset)
+KIND_CONTACT_INFO = 0
+KIND_VOTE = 1
+KIND_LOWEST_SLOT = 2
+KIND_SNAPSHOT_HASHES = 3
+KIND_EPOCH_SLOTS = 4
+KIND_DUPLICATE_SHRED = 5
+
+
+@dataclass(frozen=True)
+class CrdsValue:
+    origin: bytes          # 32B pubkey of the producing node
+    kind: int
+    index: int             # distinguishes multiple values of one kind
+    wallclock: int         # producer's clock, ms — LWW resolution key
+    data: bytes            # kind-specific payload
+    signature: bytes = b""
+
+    def key(self) -> tuple:
+        return (self.origin, self.kind, self.index)
+
+    def signable(self) -> bytes:
+        return (self.origin + bytes([self.kind])
+                + struct.pack("<IQ", self.index, self.wallclock)
+                + self.data)
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.signable() + self.signature).digest()
+
+    def to_wire(self) -> bytes:
+        return (self.origin + bytes([self.kind])
+                + struct.pack("<IQHH", self.index, self.wallclock,
+                              len(self.data), len(self.signature))
+                + self.data + self.signature)
+
+    @classmethod
+    def from_wire(cls, b: bytes, off: int = 0) -> tuple["CrdsValue", int]:
+        origin = b[off:off + 32]
+        if len(origin) != 32:
+            raise ValueError("truncated CRDS value")
+        kind = b[off + 32]
+        index, wallclock, dlen, slen = struct.unpack_from(
+            "<IQHH", b, off + 33)
+        p = off + 33 + 16
+        data = b[p:p + dlen]
+        sig = b[p + dlen:p + dlen + slen]
+        if len(data) != dlen or len(sig) != slen:
+            raise ValueError("truncated CRDS value body")
+        return cls(bytes(origin), kind, index, wallclock, bytes(data),
+                   bytes(sig)), p + dlen + slen
+
+
+class CrdsStore:
+    def __init__(self, max_age_ms: int = 60_000):
+        self.values: dict[tuple, CrdsValue] = {}
+        self.hashes: set[bytes] = set()
+        self.max_age_ms = max_age_ms
+        self.metrics = {"upserts": 0, "stale": 0, "purged": 0}
+
+    def upsert(self, v: CrdsValue) -> bool:
+        """True if inserted (new or strictly newer wallclock)."""
+        cur = self.values.get(v.key())
+        if cur is not None and cur.wallclock >= v.wallclock:
+            self.metrics["stale"] += 1
+            return False
+        if cur is not None:
+            self.hashes.discard(cur.hash())
+        self.values[v.key()] = v
+        self.hashes.add(v.hash())
+        self.metrics["upserts"] += 1
+        return True
+
+    def get(self, origin: bytes, kind: int, index: int = 0):
+        return self.values.get((origin, kind, index))
+
+    def contact_infos(self):
+        return [v for v in self.values.values()
+                if v.kind == KIND_CONTACT_INFO]
+
+    def missing_for(self, bloom, limit: int = 64) -> list[CrdsValue]:
+        """Pull-response: values whose hash the requester's bloom lacks
+        (ref: pull protocol in fd_gossip.h)."""
+        out = []
+        for v in self.values.values():
+            if not bloom.contains(v.hash()):
+                out.append(v)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def bloom_of_contents(self, fp_rate: float = 0.05, seed: int = 0):
+        from .bloom import Bloom
+        f = Bloom.for_items(max(len(self.hashes), 8), fp_rate, seed)
+        for h in self.hashes:
+            f.insert(h)
+        return f
+
+    def purge(self, now_ms: int):
+        """Drop values older than the age window (the reference purges
+        by wallclock the same way; ContactInfos keep peers alive)."""
+        dead = [k for k, v in self.values.items()
+                if now_ms - v.wallclock > self.max_age_ms]
+        for k in dead:
+            self.hashes.discard(self.values[k].hash())
+            del self.values[k]
+        self.metrics["purged"] += len(dead)

@@ -1,0 +1,172 @@
+"""Gossip tests: CRDS LWW convergence, bloom pull anti-entropy, push
+fan-out with prunes, and a randomized multi-node network simulation
+converging to a consistent store (ref: src/flamenco/gossip/fd_gossip.h
+protocol description; test tiers per test_gossip.c / test_bloom.c)."""
+import numpy as np
+
+from firedancer_tpu.gossip import (
+    KIND_CONTACT_INFO, KIND_VOTE, Bloom, CrdsStore, CrdsValue, GossipNode,
+)
+
+
+def pk(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+# ---------------------------------------------------------------------------
+# bloom
+# ---------------------------------------------------------------------------
+
+def test_bloom_membership_and_wire():
+    rng = np.random.default_rng(1)
+    f = Bloom.for_items(128, fp_rate=0.01, seed=42)
+    keys = [rng.bytes(32) for _ in range(128)]
+    for k in keys:
+        f.insert(k)
+    assert all(f.contains(k) for k in keys)          # no false negatives
+    others = [rng.bytes(32) for _ in range(500)]
+    fp = sum(f.contains(k) for k in others)
+    assert fp < 25, f"false positive rate way off: {fp}/500"
+    g = Bloom.from_wire(f.to_wire())
+    assert all(g.contains(k) for k in keys)
+    assert g.num_keys == f.num_keys and g.seed == f.seed
+
+
+# ---------------------------------------------------------------------------
+# crds
+# ---------------------------------------------------------------------------
+
+def test_crds_lww_upsert():
+    s = CrdsStore()
+    v1 = CrdsValue(pk(1), KIND_VOTE, 0, wallclock=100, data=b"a")
+    v2 = CrdsValue(pk(1), KIND_VOTE, 0, wallclock=200, data=b"b")
+    v0 = CrdsValue(pk(1), KIND_VOTE, 0, wallclock=50, data=b"z")
+    assert s.upsert(v1)
+    assert s.upsert(v2)                 # newer wins
+    assert not s.upsert(v0)             # stale rejected
+    assert not s.upsert(v2)             # tie keeps incumbent
+    assert s.get(pk(1), KIND_VOTE).data == b"b"
+    # distinct indices coexist
+    assert s.upsert(CrdsValue(pk(1), KIND_VOTE, 1, 100, b"c"))
+    assert len(s.values) == 2
+    # the replaced value's hash left the bloom identity set
+    assert v1.hash() not in s.hashes and v2.hash() in s.hashes
+
+
+def test_crds_wire_roundtrip():
+    v = CrdsValue(pk(3), KIND_CONTACT_INFO, 0, 777, b"10.0.0.3:8000",
+                  b"s" * 64)
+    w, end = CrdsValue.from_wire(v.to_wire())
+    assert w == v and end == len(v.to_wire())
+
+
+def test_crds_pull_missing():
+    a, b = CrdsStore(), CrdsStore()
+    vals = [CrdsValue(pk(i), KIND_VOTE, 0, 100 + i, bytes([i]))
+            for i in range(1, 9)]
+    for v in vals:
+        a.upsert(v)
+    for v in vals[:4]:
+        b.upsert(v)
+    missing = a.missing_for(b.bloom_of_contents(fp_rate=0.01))
+    got = {v.key() for v in missing}
+    assert got == {v.key() for v in vals[4:]}
+
+
+def test_crds_purge():
+    s = CrdsStore(max_age_ms=1000)
+    s.upsert(CrdsValue(pk(1), KIND_VOTE, 0, 100, b"old"))
+    s.upsert(CrdsValue(pk(2), KIND_VOTE, 0, 1900, b"new"))
+    s.purge(now_ms=2000)
+    assert s.get(pk(1), KIND_VOTE) is None
+    assert s.get(pk(2), KIND_VOTE) is not None
+
+
+# ---------------------------------------------------------------------------
+# push / prune / network sim
+# ---------------------------------------------------------------------------
+
+def test_push_and_prune_flow():
+    n = GossipNode(pk(1))
+    # two relayers deliver the same values; the second accumulates
+    # duplicates and gets pruned for that origin
+    vals = [CrdsValue(pk(9), KIND_VOTE, i, 100 + i, bytes([i]))
+            for i in range(4)]
+    fresh = n.handle_push(vals, relayer=pk(2))
+    assert len(fresh) == 4
+    n.handle_push(vals, relayer=pk(3))
+    due = n.prunes_due()
+    assert pk(3) in due and due[pk(3)] == [pk(9)]
+    assert not n.prunes_due()           # reported once
+
+
+def test_network_convergence():
+    """12 nodes, random sparse delivery of pushes + periodic bloom pulls:
+    every node converges on every origin's LATEST value."""
+    rng = np.random.default_rng(7)
+    N = 12
+    stakes = {pk(i): int(rng.integers(1, 100)) * 1000 for i in range(N)}
+    nodes = [GossipNode(pk(i), stake_of=lambda p: stakes.get(p, 1),
+                        active_set_size=4) for i in range(N)]
+    # everyone learns everyone's contact info out of band (entrypoint
+    # bootstrap abstracted away)
+    for now, n in enumerate(nodes):
+        n.tick(now_ms=1000)
+        n.publish_contact_info((f"10.0.0.{n.pubkey[0]}", 8000))
+    for n in nodes:
+        for m in nodes:
+            if n is not m:
+                n.crds.upsert(m.crds.get(m.pubkey, KIND_CONTACT_INFO))
+
+    # each node publishes 2 generations of a vote value
+    for gen in range(2):
+        for i, n in enumerate(nodes):
+            n.tick(2000 + gen)
+            n.make_value(KIND_VOTE, 0, b"gen%d-%d" % (gen, i))
+
+    by_pk = {n.pubkey: n for n in nodes}
+    # rounds of push gossip along each node's active set
+    for _ in range(6):
+        for n in nodes:
+            for v in list(n.crds.values.values()):
+                for tgt in n.push_targets_for(v):
+                    if tgt == n.pubkey or tgt not in by_pk:
+                        continue
+                    if rng.random() < 0.3:
+                        continue        # lossy network
+                    by_pk[tgt].handle_push([v], relayer=n.pubkey)
+    # anti-entropy: random pulls patch the holes
+    for _ in range(4):
+        for n in nodes:
+            peer = by_pk[pk(int(rng.integers(0, N)))]
+            if peer is n:
+                continue
+            resp = peer.handle_pull_request(n.make_pull_request(seed=3),
+                                            limit=256)
+            n.handle_pull_response(resp)
+
+    for n in nodes:
+        for i in range(N):
+            v = n.crds.get(pk(i), KIND_VOTE)
+            assert v is not None, f"node {n.pubkey[0]} missing origin {i}"
+            assert v.data == b"gen1-%d" % i, "stale generation survived"
+
+
+def test_push_respects_prunes():
+    stakes = {pk(i): 1000 for i in range(6)}
+    n = GossipNode(pk(1), stake_of=lambda p: stakes.get(p, 1),
+                   active_set_size=5)
+    n.tick(1000)
+    for i in range(6):
+        n.crds.upsert(CrdsValue(pk(i), KIND_CONTACT_INFO, 0, 1000,
+                                b"addr"))
+    v = CrdsValue(pk(9), KIND_VOTE, 0, 500, b"x")
+    n.crds.upsert(v)
+    tgts = n.push_targets_for(v)
+    assert tgts
+    n.handle_prune(tgts[0], [pk(9)])
+    assert tgts[0] not in n.push_targets_for(v)
+    # prune is per-origin: other origins still flow to that peer
+    w = CrdsValue(pk(8), KIND_VOTE, 0, 500, b"y")
+    n.crds.upsert(w)
+    assert tgts[0] in n.push_targets_for(w)
