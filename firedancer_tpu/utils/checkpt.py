@@ -1,0 +1,169 @@
+"""Streaming checkpoint frames + funk state snapshot/restore.
+
+The reference's fd_checkpt writes framed, optionally-compressed streams
+that restore bit-identically (ref: src/util/checkpt/fd_checkpt.h:10-60 —
+RAW and LZ4 frame styles, size limits, integrity discipline); wksps and
+funk are persistent via the same machinery, and the snapshot pipeline
+rebuilds an account DB from a serialized stream (ref: src/discof/
+restore/fd_snapin_tile.c). This module re-expresses both seams:
+
+  * CheckptWriter/CheckptReader: magic + version header, then frames
+    [u8 style | u64 raw_sz | u64 enc_sz | bytes], style RAW or ZLIB
+    (zlib stands in for LZ4 — not in this image; same contract), closed
+    by a sha256 trailer over every raw byte, verified on restore.
+  * funk_checkpt / funk_restore: the published root of a Funk instance
+    (records sorted by key for determinism) -> frames -> an equal Funk.
+
+Account record values serialize tagged: ints (legacy lamports) and
+accdb Accounts both round-trip exactly.
+"""
+from __future__ import annotations
+
+import hashlib
+import struct
+import zlib
+
+MAGIC = b"FDTPUCK1"
+STYLE_RAW = 0
+STYLE_ZLIB = 1
+FRAME_MAX = 1 << 30
+
+
+class CheckptError(ValueError):
+    pass
+
+
+class CheckptWriter:
+    def __init__(self, fp, compress: bool = True, level: int = 3):
+        self.fp = fp
+        self.compress = compress
+        self.level = level
+        self._sha = hashlib.sha256()
+        self.fp.write(MAGIC)
+
+    def frame(self, data: bytes):
+        if len(data) > FRAME_MAX:
+            raise CheckptError("frame too large")
+        self._sha.update(data)
+        enc = zlib.compress(data, self.level) if self.compress else data
+        style = STYLE_ZLIB if self.compress and len(enc) < len(data) \
+            else STYLE_RAW
+        if style == STYLE_RAW:
+            enc = data
+        self.fp.write(struct.pack("<BQQ", style, len(data), len(enc)))
+        self.fp.write(enc)
+
+    def fini(self):
+        """Terminal frame + integrity trailer."""
+        self.fp.write(struct.pack("<BQQ", 0xFF, 0, 0))
+        self.fp.write(self._sha.digest())
+
+
+class CheckptReader:
+    def __init__(self, fp):
+        self.fp = fp
+        self._sha = hashlib.sha256()
+        if fp.read(len(MAGIC)) != MAGIC:
+            raise CheckptError("bad checkpoint magic")
+
+    def frames(self):
+        while True:
+            hdr = self.fp.read(17)
+            if len(hdr) != 17:
+                raise CheckptError("truncated frame header")
+            style, raw_sz, enc_sz = struct.unpack("<BQQ", hdr)
+            if style == 0xFF:
+                want = self.fp.read(32)
+                if want != self._sha.digest():
+                    raise CheckptError("checkpoint integrity mismatch")
+                return
+            enc = self.fp.read(enc_sz)
+            if len(enc) != enc_sz:
+                raise CheckptError("truncated frame")
+            if style == STYLE_ZLIB:
+                data = zlib.decompress(enc)
+            elif style == STYLE_RAW:
+                data = enc
+            else:
+                raise CheckptError(f"unknown frame style {style}")
+            if len(data) != raw_sz:
+                raise CheckptError("frame size mismatch")
+            self._sha.update(data)
+            yield data
+
+
+# ---------------------------------------------------------------------------
+# value (de)serialization — tagged, deterministic
+# ---------------------------------------------------------------------------
+
+_TAG_INT = 0
+_TAG_ACCOUNT = 1
+_TAG_BYTES = 2
+
+
+def _enc_val(v) -> bytes:
+    from ..svm.accdb import Account
+    if isinstance(v, int):
+        return bytes([_TAG_INT]) + struct.pack("<q", v)
+    if isinstance(v, Account):
+        return (bytes([_TAG_ACCOUNT])
+                + struct.pack("<QI", v.lamports, len(v.data)) + v.data
+                + v.owner + bytes([1 if v.executable else 0])
+                + struct.pack("<Q", v.rent_epoch))
+    if isinstance(v, bytes):
+        return bytes([_TAG_BYTES]) + v
+    raise CheckptError(f"unsupported record value type {type(v)}")
+
+
+def _dec_val(b: bytes):
+    from ..svm.accdb import Account
+    tag = b[0]
+    if tag == _TAG_INT:
+        return struct.unpack_from("<q", b, 1)[0]
+    if tag == _TAG_ACCOUNT:
+        lamports, dlen = struct.unpack_from("<QI", b, 1)
+        p = 13
+        data = b[p:p + dlen]
+        owner = b[p + dlen:p + dlen + 32]
+        executable = bool(b[p + dlen + 32])
+        rent_epoch = struct.unpack_from("<Q", b, p + dlen + 33)[0]
+        return Account(lamports, bytes(data), bytes(owner), executable,
+                       rent_epoch)
+    if tag == _TAG_BYTES:
+        return b[1:]
+    raise CheckptError(f"unknown value tag {tag}")
+
+
+def funk_checkpt(funk, fp, compress: bool = True):
+    """Serialize the PUBLISHED root (in-preparation forks are transient
+    by definition — the reference checkpoints published state the same
+    way). Deterministic: records sorted by key."""
+    w = CheckptWriter(fp, compress)
+    items = sorted(funk.root_items().items())
+    w.frame(struct.pack("<Q", len(items)))
+    for k, v in items:
+        ev = _enc_val(v)
+        w.frame(struct.pack("<II", len(k), len(ev)) + k + ev)
+    w.fini()
+
+
+def funk_restore(funk_cls, fp):
+    """-> a fresh Funk whose root equals the checkpointed one."""
+    funk = funk_cls()
+    r = CheckptReader(fp)
+    it = r.frames()
+    try:
+        hdr = next(it)
+    except StopIteration:
+        raise CheckptError("empty checkpoint") from None
+    (cnt,) = struct.unpack("<Q", hdr)
+    got = 0
+    for data in it:
+        klen, vlen = struct.unpack_from("<II", data, 0)
+        k = data[8:8 + klen]
+        v = _dec_val(data[8 + klen:8 + klen + vlen])
+        funk.rec_write(None, bytes(k), v)
+        got += 1
+    if got != cnt:
+        raise CheckptError(f"record count mismatch: {got} != {cnt}")
+    return funk

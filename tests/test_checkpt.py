@@ -1,0 +1,93 @@
+"""Checkpoint frame + funk snapshot tests (ref: src/util/checkpt/
+fd_checkpt.h — bit-identical restore, integrity; src/discof/restore/
+fd_snapin_tile.c — stream -> account DB)."""
+import io
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.svm import Account, SystemTxn, execute_block
+from firedancer_tpu.utils.checkpt import (
+    CheckptError, CheckptReader, CheckptWriter, funk_checkpt, funk_restore,
+)
+
+
+def test_frames_roundtrip_and_integrity():
+    rng = np.random.default_rng(3)
+    frames = [rng.bytes(int(rng.integers(0, 5000))) for _ in range(20)]
+    frames.append(b"\x00" * 100_000)          # compressible
+    buf = io.BytesIO()
+    w = CheckptWriter(buf, compress=True)
+    for f in frames:
+        w.frame(f)
+    w.fini()
+    raw = buf.getvalue()
+    got = list(CheckptReader(io.BytesIO(raw)).frames())
+    assert got == frames
+    # compression engaged for the compressible frame
+    assert len(raw) < sum(len(f) for f in frames)
+
+    # single flipped byte in any frame body is caught by the trailer
+    bad = bytearray(raw)
+    bad[len(bad) // 2] ^= 1
+    with pytest.raises(CheckptError):
+        list(CheckptReader(io.BytesIO(bytes(bad))).frames())
+
+
+def test_frames_raw_mode():
+    buf = io.BytesIO()
+    w = CheckptWriter(buf, compress=False)
+    w.frame(b"hello")
+    w.fini()
+    assert list(CheckptReader(io.BytesIO(buf.getvalue())).frames()) \
+        == [b"hello"]
+
+
+def test_funk_checkpt_bit_identical():
+    rng = np.random.default_rng(5)
+    funk = Funk()
+    for i in range(50):
+        k = rng.bytes(32)
+        if i % 3 == 0:
+            funk.rec_write(None, k, int(rng.integers(0, 1 << 60)))
+        elif i % 3 == 1:
+            funk.rec_write(None, k, Account(
+                lamports=int(rng.integers(0, 1 << 50)),
+                data=rng.bytes(int(rng.integers(0, 200))),
+                owner=rng.bytes(32),
+                executable=bool(i % 2), rent_epoch=i))
+        else:
+            funk.rec_write(None, k, rng.bytes(40))
+
+    buf = io.BytesIO()
+    funk_checkpt(funk, buf)
+    buf.seek(0)
+    restored = funk_restore(Funk, buf)
+    assert restored.root_items() == funk.root_items()
+
+    # determinism: same state -> byte-identical checkpoint
+    buf2 = io.BytesIO()
+    funk_checkpt(funk, buf2)
+    assert buf2.getvalue() == buf.getvalue()
+
+
+def test_checkpt_resume_execution():
+    """Snapshot -> restore -> continue executing blocks: the restored
+    node's state matches the uninterrupted node's (the snapshot-load
+    cold-start path, ref fd_snapin_tile.c)."""
+    k1, k2 = b"\x01" * 32, b"\x02" * 32
+    funk = Funk()
+    funk.rec_write(None, k1, Account(lamports=10_000))
+    execute_block(funk, None, "b1", [SystemTxn(k1, k2, 1000, 10)])
+    funk.txn_publish("b1")
+
+    buf = io.BytesIO()
+    funk_checkpt(funk, buf)
+    buf.seek(0)
+    cold = funk_restore(Funk, buf)
+
+    for f in (funk, cold):
+        execute_block(f, None, "b2", [SystemTxn(k2, k1, 500, 0)])
+        f.txn_publish("b2")
+    assert cold.root_items() == funk.root_items()
