@@ -116,6 +116,23 @@ def _sub_l_if_ge(d):
         d - jnp.where(need[..., None], l_dig, 0), d.shape[-1])
 
 
+def _reduce_digits_mod_l(d, nd: int):
+    """Exact non-negative nd-digit value -> canonical digits mod l."""
+    while nd > 21:
+        d, nd = _fold_step(d, nd)
+    if nd == 20:
+        d = jnp.concatenate(
+            [d, jnp.zeros(d.shape[:-1] + (1,), jnp.int32)], axis=-1)
+    # value < 2^261: split at bit 252 (digit 19 bit 5).
+    hi = (d[..., 19] >> 5) + (d[..., 20] << 8)       # < 2^9
+    lo = d[..., :20].at[..., 19].set(d[..., 19] & 31)
+    z = lo + jnp.asarray(L_DIGITS)
+    z = z.at[..., :10].add(-hi[..., None] * jnp.asarray(DELTA_DIGITS))
+    z = _exact_digit_pass(z, NLIMB)                  # < 2l
+    z = _sub_l_if_ge(z)
+    return _sub_l_if_ge(z)
+
+
 def sc_reduce64(b):
     """(..., 64) uint8 little-endian -> canonical scalar digits mod l.
 
@@ -130,16 +147,29 @@ def sc_reduce64(b):
     for i in range(512):
         b2l[i, i // BITS] = 1 << (i % BITS)
     d = bits @ jnp.asarray(b2l)
-    while nd > 21:
-        d, nd = _fold_step(d, nd)
-    # value < 2^261: split at bit 252 (digit 19 bit 5).
-    hi = (d[..., 19] >> 5) + (d[..., 20] << 8)       # < 2^9
-    lo = d[..., :20].at[..., 19].set(d[..., 19] & 31)
-    z = lo + jnp.asarray(L_DIGITS)
-    z = z.at[..., :10].add(-hi[..., None] * jnp.asarray(DELTA_DIGITS))
-    z = _exact_digit_pass(z, NLIMB)                  # < 2l
-    z = _sub_l_if_ge(z)
-    return _sub_l_if_ge(z)
+    return _reduce_digits_mod_l(d, nd)
+
+
+def sc_mul_mod_l(a20, b10):
+    """(..., 20) canonical digits × (..., 10) 130-bit digits mod l.
+
+    Schoolbook digit convolution (term magnitude ≤ 10·2^26 < 2^31,
+    int32-safe) then fold-reduce. The z·k products of RLC batch
+    verification (see rlc_verify_batch)."""
+    prod = jnp.zeros(a20.shape[:-1] + (30,), jnp.int32)
+    for i in range(10):
+        prod = prod.at[..., i:i + 20].add(b10[..., i:i + 1] * a20)
+    return _reduce_digits_mod_l(_exact_digit_pass(prod, 30), 30)
+
+
+def sc_sum_mod_l(d20, axis: int = 0):
+    """Sum canonical 20-digit scalars over an axis, mod l (digit sums
+    stay < 2^13·n — int32-safe up to n = 2^18 lanes)."""
+    n = d20.shape[axis]
+    assert n <= (1 << 18), "digit sum would overflow int32"
+    s = jnp.sum(d20, axis=axis)
+    # value < n·l < 2^(253+18): exact pass to 21 digits then reduce
+    return _reduce_digits_mod_l(_exact_digit_pass(s, 21), 21)
 
 
 def sc_from_bytes32(b):
@@ -424,23 +454,17 @@ def _double_scalar_mul(s_w, k_w, a_neg):
     fb_acc, _ = jax.lax.scan(
         fb_step, pt_identity(batch), (tab, jnp.moveaxis(s_w, -1, 0)))
 
-    # variable-base: per-lane 16-entry table of w·(−A)
-    entries = [pt_identity(batch), a_neg]
-    for _ in range(14):
-        entries.append(pt_add(entries[-1], a_neg))
-    ptab = tuple(jnp.stack([e[i] for e in entries], axis=-2)
-                 for i in range(4))                  # (batch,16,NLIMB) each
+    # variable-base: per-lane 16-entry table of w·(−A) (shared helpers
+    # with the RLC MSM path — one table/select implementation)
+    ptab = _lane_table16(a_neg, batch)
 
-    def vb_step(acc, wj):
+    def vb_step(acc, entry):
         acc = pt_dbl(pt_dbl(pt_dbl(pt_dbl(acc))))
-        entry = tuple(
-            jnp.take_along_axis(ptab[i], wj[..., None, None], axis=-2)
-            [..., 0, :]
-            for i in range(4))
         return pt_add(acc, entry), None
 
-    kw_rev = jnp.moveaxis(k_w, -1, 0)[::-1]          # msb window first
-    vb_acc, _ = jax.lax.scan(vb_step, pt_identity(batch), kw_rev)
+    sel = _select_windows(ptab, k_w)                 # (64, batch, NLIMB) x4
+    vb_acc, _ = jax.lax.scan(
+        vb_step, pt_identity(batch), tuple(c[::-1] for c in sel))
 
     return pt_add(fb_acc, vb_acc)
 
@@ -477,3 +501,169 @@ def verify_batch(sig, pub, msg, msg_len):
         sc_windows4(s_digits), sc_windows4(k_digits), pt_neg(a_pt))
     match = jnp.all(pt_tobytes(rprime) == r_bytes, axis=-1)
     return s_ok & a_ok & r_ok & match
+
+
+# ---------------------------------------------------------------------------
+# RLC batch verification (the 1M/s path)
+# ---------------------------------------------------------------------------
+
+def _tree_sum_points(pts, n: int):
+    """Pairwise-add reduction of (..., n, NLIMB)-coordinate points along
+    axis -2; log2(n) vectorized levels (the whole level adds at once)."""
+    while n > 1:
+        half = n // 2
+        a = tuple(c[..., :half, :] for c in pts)
+        b = tuple(c[..., half:2 * half, :] for c in pts)
+        s = pt_add(a, b)
+        if n & 1:
+            tail = tuple(c[..., -1:, :] for c in pts)
+            s = tuple(jnp.concatenate([sc, tc], axis=-2)
+                      for sc, tc in zip(s, tail))
+            n = half + 1
+        else:
+            n = half
+        pts = s
+    return tuple(c[..., 0, :] for c in pts)
+
+
+def _lane_table16(pt, batch):
+    """Per-lane 16-entry table [0..15]·pt: (..., 16, NLIMB) coords."""
+    entries = [pt_identity(batch), pt]
+    for _ in range(14):
+        entries.append(pt_add(entries[-1], pt))
+    return tuple(jnp.stack([e[i] for e in entries], axis=-2)
+                 for i in range(4))
+
+
+def _select_windows(tab, w):
+    """tab (..., 16, NLIMB) x4; w (..., nw) -> (nw, ..., NLIMB) x4."""
+    wt = jnp.moveaxis(w, -1, 0)                      # (nw, ...)
+    def sel(coord, wj):
+        return jnp.take_along_axis(
+            coord, wj[..., None, None], axis=-2)[..., 0, :]
+    return tuple(jax.vmap(sel, in_axes=(None, 0))(tab[i], wt)
+                 for i in range(4))
+
+
+def rlc_verify_batch(sig, pub, msg, msg_len, z_bytes):
+    """Random-linear-combination batch verification: checks
+
+        Σ_i z_i · ( [S_i]B − [k_i]A_i − R_i )  ==  identity
+
+    as ONE multi-scalar multiplication, sharing the 252 Horner doublings
+    across the whole batch (per-window per-lane table selects,
+    cross-lane tree reduction; honest VPU cost model in PERF.md —
+    ~1.5–1.7× over the individual kernel, not the classical 3×). z_i are
+    HOST-SUPPLIED random 128-bit coefficients, unpredictable to
+    transaction senders. The reference's batch entry point is
+    fd_ed25519_verify_batch_single_msg (src/ballet/ed25519/
+    fd_ed25519_user.c:232).
+
+    **Semantics: COFACTORED batch verification, NOT a consensus drop-in
+    for verify_batch.** A prime-order-component failure is caught with
+    soundness 2^-128, but a lane whose residual [S]B − [k]A − R is a
+    nonzero pure-TORSION point (crafted R* = R + T with T in E[8] but
+    outside the small-order-encoding set) contributes z_i·T_i, and an
+    adversary can cancel torsion across lanes (or win the z mod 8 draw,
+    p = 1/8 per batch) — so this check equals the cofactored equation
+    [8](…) = 0 in adversarial settings, while verify_batch (like the
+    reference) is cofactorless and rejects such sigs. No cofactorless
+    batch scheme can close that gap without a per-lane subgroup check
+    (≈3 Legendre exponentiations/point — costlier than the savings).
+    Use where cofactored semantics suffice (bulk pre-filtering, e.g.
+    repair/gossip floods, with final consensus verdicts still from
+    verify_batch); the consensus verify tile keeps individual
+    verification. tests/test_rlc.py pins the divergence class
+    explicitly.
+
+    sig/pub/msg/msg_len: as verify_batch, leading dim = batch (1-D).
+    z_bytes: (batch, 16) uint8 random (host RNG).
+    Returns (batch_ok: () bool, lane_pre: (batch,) bool):
+      batch_ok  -> every lane with lane_pre True verified under the
+                   COFACTORED equation (whp); lanes with lane_pre False
+                   are individually invalid regardless of batch_ok.
+    """
+    batch = sig.shape[:-1]
+    r_bytes = sig[..., :32]
+    s_bytes = sig[..., 32:]
+
+    s_digits, s_ok = sc_from_bytes32(s_bytes)
+    a_pt, a_ok = decompress(pub)
+    r_pt, r_dec_ok = decompress(r_bytes)
+    lane_pre = (s_ok & a_ok & r_dec_ok
+                & ~is_small_order_encoding(pub)
+                & ~is_small_order_encoding(r_bytes))
+
+    # k = SHA-512(R ‖ A ‖ msg) mod l
+    kmsg = jnp.concatenate([r_bytes, pub, msg], axis=-1)
+    k_digits = sc_reduce64(sha512(kmsg, msg_len + 64))
+
+    # z digits (padded to the full 20-digit scalar width so window
+    # extraction never indexes past the array); failed lanes get z = 0
+    # so their contribution to every term is the identity
+    bits = fe.bytes_to_bits(z_bytes)                 # (..., 128)
+    b2l = np.zeros((128, NLIMB), np.int32)
+    for i in range(128):
+        b2l[i, i // BITS] = 1 << (i % BITS)
+    z_digits = jnp.where(lane_pre[..., None], bits @ jnp.asarray(b2l), 0)
+
+    zk = sc_mul_mod_l(k_digits, z_digits)            # (batch, 20)
+    zs = sc_mul_mod_l(s_digits, z_digits)
+    s_sum = sc_sum_mod_l(zs, axis=0)                 # (20,)
+
+    # per-window lane sums, tree-reduced across the batch
+    tab_a = _lane_table16(pt_neg(a_pt), batch)
+    tab_r = _lane_table16(pt_neg(r_pt), batch)
+    sel_a = _select_windows(tab_a, sc_windows4(zk))  # (64, B, NLIMB) x4
+    z_w = sc_windows4(z_digits)[..., :32]            # z < 2^128
+    sel_r = _select_windows(tab_r, z_w)              # (32, B, NLIMB) x4
+    n = int(np.prod(batch))
+    sum_a = _tree_sum_points(sel_a, n)               # (64, NLIMB) x4
+    sum_r = _tree_sum_points(sel_r, n)               # (32, NLIMB) x4
+    pad = pt_identity((32,))
+    sum_r = tuple(jnp.concatenate([sum_r[i], pad[i]], axis=0)
+                  for i in range(4))
+
+    contrib = pt_add(sum_a, sum_r)                   # (64, ...) points
+
+    def horner(acc, cw):
+        acc = pt_dbl(pt_dbl(pt_dbl(pt_dbl(acc))))
+        return pt_add(acc, cw), None
+
+    acc, _ = jax.lax.scan(
+        horner, pt_identity(()), tuple(c[::-1] for c in contrib))
+
+    # fixed-base term OUTSIDE the Horner loop: the j-scaled table
+    # entries (w·16^j·B) already carry their window weight, so the sum
+    # is doubling-free (same trick as _double_scalar_mul's fb scan)
+    fb_tab = jnp.asarray(_fixed_base_table())        # (64, 16, 4, NLIMB)
+    s_w = sc_windows4(s_sum)                         # (64,)
+    fb = tuple(fb_tab[jnp.arange(64), s_w, i] for i in range(4))
+    fb_acc = _tree_sum_points(tuple(jnp.moveaxis(c, 0, -2) for c in fb),
+                              64)
+    acc = pt_add(acc, fb_acc)
+
+    x, y, z, _ = acc
+    is_id = (jnp.all(fe.canonical(x) == 0)
+             & jnp.all(fe.canonical(fe.sub(y, z)) == 0))
+    return is_id, lane_pre
+
+
+def verify_batch_rlc(sig, pub, msg, msg_len, rng=None):
+    """Cofactored-batch wrapper: RLC fast path with individual fallback
+    on batch failure.
+
+    Per-lane verdicts equal verify_batch EXCEPT on the crafted
+    pure-torsion-residual class documented in rlc_verify_batch (where
+    this returns the cofactored verdict) — hence NOT wired into the
+    consensus verify tile; suitable for bulk pre-filtering where the
+    final gate re-verifies individually. An adversary forcing fallback
+    costs ≤ (RLC + individual) ≈ 1.4× the individual-only path."""
+    rng = rng or np.random.default_rng()
+    z = np.asarray(rng.integers(0, 256, (sig.shape[0], 16),
+                                dtype=np.uint8))
+    ok, lane_pre = rlc_verify_batch(sig, pub, msg, msg_len,
+                                    jnp.asarray(z))
+    if bool(ok):
+        return np.asarray(lane_pre)
+    return np.asarray(verify_batch(sig, pub, msg, msg_len))

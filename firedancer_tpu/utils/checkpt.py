@@ -77,15 +77,21 @@ class CheckptReader:
                 if want != self._sha.digest():
                     raise CheckptError("checkpoint integrity mismatch")
                 return
+            # bound BEFORE reading/decompressing: a corrupt or hostile
+            # header (snapshots arrive over the network in production)
+            # must not drive a huge allocation or a zip bomb ahead of
+            # the integrity trailer
+            if style not in (STYLE_RAW, STYLE_ZLIB):
+                raise CheckptError(f"unknown frame style {style}")
+            if raw_sz > FRAME_MAX or enc_sz > FRAME_MAX:
+                raise CheckptError("frame size exceeds FRAME_MAX")
             enc = self.fp.read(enc_sz)
             if len(enc) != enc_sz:
                 raise CheckptError("truncated frame")
             if style == STYLE_ZLIB:
                 data = zlib.decompress(enc)
-            elif style == STYLE_RAW:
-                data = enc
             else:
-                raise CheckptError(f"unknown frame style {style}")
+                data = enc
             if len(data) != raw_sz:
                 raise CheckptError("frame size mismatch")
             self._sha.update(data)
@@ -104,7 +110,10 @@ _TAG_BYTES = 2
 def _enc_val(v) -> bytes:
     from ..svm.accdb import Account
     if isinstance(v, int):
-        return bytes([_TAG_INT]) + struct.pack("<q", v)
+        # lamports are u64 (the legacy genesis path can hold any u64)
+        if not 0 <= v < (1 << 64):
+            raise CheckptError(f"int record out of u64 range: {v}")
+        return bytes([_TAG_INT]) + struct.pack("<Q", v)
     if isinstance(v, Account):
         return (bytes([_TAG_ACCOUNT])
                 + struct.pack("<QI", v.lamports, len(v.data)) + v.data
@@ -119,7 +128,7 @@ def _dec_val(b: bytes):
     from ..svm.accdb import Account
     tag = b[0]
     if tag == _TAG_INT:
-        return struct.unpack_from("<q", b, 1)[0]
+        return struct.unpack_from("<Q", b, 1)[0]
     if tag == _TAG_ACCOUNT:
         lamports, dlen = struct.unpack_from("<QI", b, 1)
         p = 13

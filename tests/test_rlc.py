@@ -1,0 +1,167 @@
+"""RLC batch verification tests: the batch check must accept exactly
+the batches whose prechecked lanes all verify individually, and the
+wrapper's per-lane verdicts must equal verify_batch bit-for-bit
+(ref: src/ballet/ed25519/fd_ed25519_user.c:232 batch entry point;
+PERF.md path-to-1M item 1)."""
+import hashlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from firedancer_tpu.ops import ed25519 as ed  # noqa: E402
+from firedancer_tpu.utils import ed25519_ref as ref  # noqa: E402
+
+B, MLEN = 8, 48
+
+
+def _batch(rng, corrupt=()):
+    sig = np.zeros((B, 64), np.uint8)
+    pub = np.zeros((B, 32), np.uint8)
+    msg = np.zeros((B, MLEN), np.uint8)
+    ln = np.full((B,), MLEN, np.int32)
+    for i in range(B):
+        seed = hashlib.sha256(b"rlc-%d" % i).digest()
+        _, _, pk = ref.keypair(seed)
+        m = rng.bytes(MLEN)
+        s = ref.sign(seed, m)
+        sig[i] = np.frombuffer(s, np.uint8)
+        pub[i] = np.frombuffer(pk, np.uint8)
+        msg[i] = np.frombuffer(m, np.uint8)
+    for i in corrupt:
+        sig[i, 40] ^= 1                   # corrupt S
+    return (jnp.asarray(sig), jnp.asarray(pub), jnp.asarray(msg),
+            jnp.asarray(ln))
+
+
+def _z(rng):
+    return jnp.asarray(rng.integers(0, 256, (B, 16), dtype=np.uint8))
+
+
+def test_sc_mul_sum_mod_l():
+    rng = np.random.default_rng(1)
+    a = int.from_bytes(rng.bytes(32), "little") % ed.L
+    z = int.from_bytes(rng.bytes(16), "little")
+    a_d = jnp.asarray(ed._int_digits(a, 20))[None]
+    z_d = jnp.asarray(ed._int_digits(z, 10))[None]
+    got = np.asarray(ed.sc_mul_mod_l(a_d, z_d))[0]
+    want = ed._int_digits(a * z % ed.L, 20)
+    assert (got == want).all()
+    # sum
+    vals = [int.from_bytes(rng.bytes(32), "little") % ed.L
+            for _ in range(50)]
+    d = jnp.asarray(np.stack([ed._int_digits(v, 20) for v in vals]))
+    got = np.asarray(ed.sc_sum_mod_l(d, axis=0))
+    assert (got == ed._int_digits(sum(vals) % ed.L, 20)).all()
+
+
+def test_rlc_accepts_valid_batch():
+    rng = np.random.default_rng(2)
+    sig, pub, msg, ln = _batch(rng)
+    ok, lane_pre = ed.rlc_verify_batch(sig, pub, msg, ln, _z(rng))
+    assert bool(ok)
+    assert np.asarray(lane_pre).all()
+
+
+def test_rlc_rejects_corrupt_batch():
+    rng = np.random.default_rng(3)
+    sig, pub, msg, ln = _batch(rng, corrupt=(3,))
+    ok, _ = ed.rlc_verify_batch(sig, pub, msg, ln, _z(rng))
+    assert not bool(ok)
+
+
+def test_rlc_masks_structural_rejects():
+    """Lanes failing prechecks (non-canonical S, bad A encoding) are
+    excluded from the sum: the REST of the batch still passes, and the
+    bad lanes report lane_pre False."""
+    rng = np.random.default_rng(4)
+    sig, pub, msg, ln = _batch(rng)
+    sig = np.array(sig)
+    pub = np.array(pub)
+    s_big = (ed.L + 7).to_bytes(32, "little")
+    sig[1, 32:] = np.frombuffer(s_big, np.uint8)      # S >= l
+    pub[2] = np.frombuffer((1).to_bytes(32, "little"), np.uint8)  # small order
+    ok, lane_pre = ed.rlc_verify_batch(jnp.asarray(sig), jnp.asarray(pub),
+                                       msg, ln, _z(rng))
+    lane_pre = np.asarray(lane_pre)
+    assert bool(ok)
+    assert not lane_pre[1] and not lane_pre[2]
+    assert lane_pre[[0, 3, 4, 5, 6, 7]].all()
+
+
+def test_wrapper_matches_verify_batch():
+    rng = np.random.default_rng(5)
+    for corrupt in ((), (0,), (2, 5)):
+        sig, pub, msg, ln = _batch(rng, corrupt=corrupt)
+        got = ed.verify_batch_rlc(sig, pub, msg, ln,
+                                  rng=np.random.default_rng(9))
+        want = np.asarray(ed.verify_batch(sig, pub, msg, ln))
+        assert (np.asarray(got) == want).all(), corrupt
+
+
+def _order8_torsion_point():
+    """A torsion point of exact order 8 from the small-order encoding
+    table (host oracle arithmetic)."""
+    from firedancer_tpu.ops.ed25519 import _small_order_encodings
+    for enc in np.asarray(_small_order_encodings()):
+        pt = ref.pt_decompress(bytes(enc))
+        if pt is None:
+            continue
+        p2 = ref.pt_add(pt, pt)
+        p4 = ref.pt_add(p2, p2)
+        if not ref.is_small_order(p4):      # [4]T has order 2 -> ord 8
+            continue
+        # exact order 8: [4]T != identity
+        zi = pow(p4[2], -1, ref.P)
+        if (p4[0] * zi % ref.P, p4[1] * zi % ref.P) != (0, 1):
+            return pt
+    raise AssertionError("no order-8 point found")
+
+
+def test_rlc_is_cofactored_not_consensus_exact():
+    """The documented divergence class: R* = R + T (T pure 8-torsion,
+    not a small-order encoding) gives a residual −zT. Individual verify
+    ALWAYS rejects; the RLC batch verdict equals the cofactored
+    equation, so over many random z draws it must accept sometimes
+    (z ≡ 0 mod 8, p = 1/8) and reject otherwise — pinning exactly why
+    rlc stays out of the consensus verify tile."""
+    rng = np.random.default_rng(11)
+    seed = hashlib.sha256(b"torsion").digest()
+    a_int, _, pk = ref.keypair(seed)
+    m = rng.bytes(MLEN)
+    t_pt = _order8_torsion_point()
+    # forge: R* = rB + T; k = H(R*, A, m); S = r + k·a (valid relation
+    # up to the torsion component)
+    r_scalar = int.from_bytes(hashlib.sha512(b"r" + m).digest(), "little") % ed.L
+    r_pt = ref.pt_mul(r_scalar, ref._basepoint())
+    r_star = ref.pt_add(r_pt, t_pt)
+    r_bytes = ref.pt_compress(r_star)
+    k = int.from_bytes(hashlib.sha512(
+        r_bytes + pk + m).digest(), "little") % ed.L
+    s = (r_scalar + k * a_int) % ed.L
+    sig_t = r_bytes + s.to_bytes(32, "little")
+
+    sig, pub, msg, ln = _batch(rng)
+    sig = np.array(sig)
+    pub = np.array(pub)
+    msg = np.array(msg)
+    sig[0] = np.frombuffer(sig_t, np.uint8)
+    pub[0] = np.frombuffer(pk, np.uint8)
+    msg[0] = np.frombuffer(m, np.uint8)
+    args = (jnp.asarray(sig), jnp.asarray(pub), jnp.asarray(msg), ln)
+
+    # individual (cofactorless, reference semantics): always rejects
+    assert not np.asarray(ed.verify_batch(*args))[0]
+
+    # batch: z with low 3 bits zero kills the torsion -> accepts;
+    # z odd keeps it -> rejects. Both outcomes must occur as documented.
+    z = np.array(np.random.default_rng(1).integers(
+        0, 256, (B, 16), dtype=np.uint8))
+    z[0, 0] &= 0xF8                       # z_0 ≡ 0 (mod 8)
+    ok, lane_pre = ed.rlc_verify_batch(*args, jnp.asarray(z))
+    assert bool(ok) and np.asarray(lane_pre)[0]      # cofactored accept
+    z[0, 0] |= 1                          # z_0 odd: torsion survives
+    ok, _ = ed.rlc_verify_batch(*args, jnp.asarray(z))
+    assert not bool(ok)
