@@ -1,0 +1,179 @@
+"""Batched BLAKE3 + lthash kernels (jnp, VPU-lane batch axis).
+
+The reference's blake3 backends batch across SIMD lanes
+(ref: src/ballet/blake3/fd_blake3_avx512.c); here the batch IS the lane
+axis: one traced program hashes B messages, per-lane lengths handled
+with masked block updates exactly like ops/sha2.py. Supports messages
+up to 2 chunks (2048 B) in-graph — covering txn hashing and
+account-delta leaves (txn MTU 1232, ref src/ballet/txn/fd_txn.h:102);
+longer inputs use the host oracle (utils/blake3_ref.py), which the
+standard BLAKE3 vectors pin (tests/vectors/blake3_vectors.json).
+
+lthash (ref: src/ballet/lthash/fd_lthash.h): XOF-2048 per message
+(32 root-counter compressions) viewed as 1024 u16 lanes; add/sub are
+wrapping u16 vector ops — the homomorphic accumulation the snapshot
+pipeline fans across tiles (snapla/snapls)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.blake3_ref import (
+    BLOCK_LEN, CHUNK_END, CHUNK_LEN, CHUNK_START, IV, MSG_PERM, PARENT, ROOT,
+)
+
+MAX_IN_GRAPH = 2 * CHUNK_LEN
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _g(v, a, b, c, d, mx, my):
+    v[a] = v[a] + v[b] + mx
+    v[d] = _rotr(v[d] ^ v[a], 16)
+    v[c] = v[c] + v[d]
+    v[b] = _rotr(v[b] ^ v[c], 12)
+    v[a] = v[a] + v[b] + my
+    v[d] = _rotr(v[d] ^ v[a], 8)
+    v[c] = v[c] + v[d]
+    v[b] = _rotr(v[b] ^ v[c], 7)
+
+
+def _compress(cv, m, counter, block_len, flags):
+    """All args batched (B,) uint32 lists/arrays -> 16 output words."""
+    v = list(cv) + [jnp.full_like(cv[0], IV[i]) for i in range(4)] + [
+        counter, jnp.zeros_like(counter), block_len, flags]
+    m = list(m)
+    for r in range(7):
+        _g(v, 0, 4, 8, 12, m[0], m[1])
+        _g(v, 1, 5, 9, 13, m[2], m[3])
+        _g(v, 2, 6, 10, 14, m[4], m[5])
+        _g(v, 3, 7, 11, 15, m[6], m[7])
+        _g(v, 0, 5, 10, 15, m[8], m[9])
+        _g(v, 1, 6, 11, 12, m[10], m[11])
+        _g(v, 2, 7, 8, 13, m[12], m[13])
+        _g(v, 3, 4, 9, 14, m[14], m[15])
+        if r < 6:
+            m = [m[p] for p in MSG_PERM]
+    out = [v[i] ^ v[i + 8] for i in range(8)]
+    out += [v[i + 8] ^ cv[i] for i in range(8)]
+    return out
+
+
+def _block_words(msg, off, msg_len):
+    """(B, L) uint8 zero-masked beyond msg_len -> 16 (B,) uint32 words
+    of the 64-byte block at `off`."""
+    b = msg.shape[0]
+    blk = jnp.zeros((b, BLOCK_LEN), jnp.uint32)
+    take = min(BLOCK_LEN, msg.shape[1] - off)
+    if take > 0:
+        idx = jnp.arange(off, off + take)
+        data = jnp.where(idx[None, :] < msg_len[:, None],
+                         msg[:, off:off + take].astype(jnp.uint32), 0)
+        blk = blk.at[:, :take].set(data)
+    w = blk.reshape(b, 16, 4)
+    mult = jnp.asarray(np.array([1, 1 << 8, 1 << 16, 1 << 24], np.uint32))
+    return [jnp.sum(w[:, i] * mult, axis=-1, dtype=jnp.uint32)
+            for i in range(16)]
+
+
+def _root_state(msg, msg_len):
+    """-> (cv, m, block_len, base_flags) of the per-lane ROOT
+    compression (counter supplied by the caller — XOF position)."""
+    bsz = msg.shape[0]
+    if msg.shape[1] > MAX_IN_GRAPH:
+        raise ValueError(f"in-graph blake3 caps at {MAX_IN_GRAPH} bytes")
+    msg_len = msg_len.astype(jnp.int32)
+    single = msg_len <= CHUNK_LEN
+
+    def chunk_cv(c):
+        """Chaining value of chunk c (no ROOT), plus the final-block
+        state for single-chunk roots."""
+        clen = jnp.clip(msg_len - c * CHUNK_LEN, 0, CHUNK_LEN)
+        nb = jnp.maximum(1, -(-clen // BLOCK_LEN))     # blocks in chunk
+        cv = [jnp.full((bsz,), IV[i], jnp.uint32) for i in range(8)]
+        fin = None
+        for bi in range(CHUNK_LEN // BLOCK_LEN):
+            off = c * CHUNK_LEN + bi * BLOCK_LEN
+            if off >= msg.shape[1] and bi > 0:
+                break
+            m = _block_words(msg, min(off, msg.shape[1]), msg_len)
+            blen = jnp.clip(clen - bi * BLOCK_LEN, 0, BLOCK_LEN) \
+                .astype(jnp.uint32)
+            is_last = jnp.uint32(bi) == (nb - 1).astype(jnp.uint32)
+            flags = (jnp.full((bsz,), CHUNK_START if bi == 0 else 0,
+                              jnp.uint32)
+                     | jnp.where(is_last, jnp.uint32(CHUNK_END), 0))
+            out = _compress(cv, m, jnp.full((bsz,), c, jnp.uint32),
+                            blen, flags)
+            active = jnp.uint32(bi) < nb.astype(jnp.uint32)
+            if fin is None:
+                fin = (list(cv), m, blen, flags)
+            else:
+                upd = is_last & (jnp.uint32(bi) < nb.astype(jnp.uint32))
+                fin = (
+                    [jnp.where(upd, c_, f_) for c_, f_ in zip(cv, fin[0])],
+                    [jnp.where(upd, a, b) for a, b in zip(m, fin[1])],
+                    jnp.where(upd, blen, fin[2]),
+                    jnp.where(upd, flags, fin[3]),
+                )
+            cv = [jnp.where(active, out[i], cv[i]) for i in range(8)]
+        return cv, fin
+
+    cv0, fin0 = chunk_cv(0)
+    cv1, _ = chunk_cv(1)
+
+    # two-chunk lanes: ROOT is the parent merge of (cv0, cv1)
+    parent_m = cv0 + cv1
+    # single-chunk lanes: ROOT re-runs chunk0's final block compression
+    cv = [jnp.where(single, f, jnp.uint32(IV[i]))
+          for i, f in enumerate(fin0[0])]
+    m = [jnp.where(single, a, b) for a, b in zip(fin0[1], parent_m)]
+    blen = jnp.where(single, fin0[2], jnp.uint32(BLOCK_LEN))
+    flags = jnp.where(single, fin0[3], jnp.uint32(PARENT))
+    return cv, m, blen, flags
+
+
+def blake3_batch(msg, msg_len):
+    """(B, L<=2048) uint8 (zero-padded), (B,) int -> (B, 32) uint8."""
+    cv, m, blen, flags = _root_state(msg, msg_len)
+    out = _compress(cv, m, jnp.zeros_like(blen),
+                    blen, flags | jnp.uint32(ROOT))[:8]
+    words = jnp.stack(out, axis=-1)                     # (B, 8)
+    sh = jnp.asarray(np.array([0, 8, 16, 24], np.uint32))
+    return ((words[..., None] >> sh) & 0xFF).astype(jnp.uint8) \
+        .reshape(msg.shape[0], 32)
+
+
+def lthash_batch(msg, msg_len):
+    """(B, L<=2048) uint8 -> (B, 1024) uint16 lattice elements
+    (XOF-2048: 32 root compressions with incrementing output counter,
+    ref fd_blake3_fini_2048 / fd_lthash.h)."""
+    cv, m, blen, flags = _root_state(msg, msg_len)
+    bsz = msg.shape[0]
+    words = []
+    for ctr in range(32):
+        o = _compress(cv, m, jnp.full((bsz,), ctr, jnp.uint32),
+                      blen, flags | jnp.uint32(ROOT))
+        words.extend(o)                                 # 16 u32 each
+    w = jnp.stack(words, axis=-1)                       # (B, 512)
+    lo = (w & 0xFFFF).astype(jnp.uint16)
+    hi = (w >> 16).astype(jnp.uint16)
+    return jnp.stack([lo, hi], axis=-1).reshape(bsz, 1024)
+
+
+def lthash_add(acc, vals):
+    """(..., 1024) uint16 wrapping add (homomorphic accumulate)."""
+    return acc + vals
+
+
+def lthash_sub(acc, vals):
+    return acc - vals
+
+
+def lthash_reduce(vals):
+    """(N, 1024) uint16 -> (1024,) sum — the snapla/snapls fan-in as one
+    reduction (psum over shards in the multi-chip pipeline)."""
+    return jnp.sum(vals.astype(jnp.uint32), axis=0).astype(jnp.uint16)

@@ -1,0 +1,95 @@
+"""BLAKE3 + lthash tests: host oracle vs the standard public vectors
+(tests/vectors/blake3_vectors.json, extracted by convert_blake3.py from
+the reference's embedded copy of BLAKE3-team test_vectors.json), and
+the batched jnp kernel pinned to the oracle (ref:
+src/ballet/blake3/fd_blake3_ref.c, src/ballet/lthash/fd_lthash.h)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from firedancer_tpu.ops.blake3 import (  # noqa: E402
+    blake3_batch, lthash_batch, lthash_add, lthash_reduce, lthash_sub,
+)
+from firedancer_tpu.utils.blake3_ref import blake3, lthash  # noqa: E402
+
+VEC = os.path.join(os.path.dirname(__file__), "vectors",
+                   "blake3_vectors.json")
+
+
+def _msg(v):
+    return bytes(v["sz"]) if v["zeros"] else \
+        bytes(i % 251 for i in range(v["sz"]))
+
+
+def test_oracle_standard_vectors():
+    vecs = json.load(open(VEC))
+    assert len(vecs) >= 20
+    for v in vecs:
+        assert blake3(_msg(v)).hex() == v["hash"], v["sz"]
+
+
+def test_oracle_xof_prefix_property():
+    """XOF output extends the 32-byte digest."""
+    m = b"xof-check"
+    assert blake3(m, 128)[:32] == blake3(m, 32)
+    assert len(lthash(m)) == 2048
+
+
+def test_batch_kernel_matches_oracle():
+    rng = np.random.default_rng(5)
+    lens = [0, 1, 63, 64, 65, 300, 1023, 1024, 1025, 1500, 2047, 2048]
+    max_len = 2048
+    msg = np.zeros((len(lens), max_len), np.uint8)
+    raw = []
+    for i, ln in enumerate(lens):
+        m = rng.bytes(ln)
+        raw.append(m)
+        msg[i, :ln] = np.frombuffer(m, np.uint8)
+    out = np.asarray(blake3_batch(jnp.asarray(msg),
+                                  jnp.asarray(lens, np.int32)))
+    for i, m in enumerate(raw):
+        assert bytes(out[i]) == blake3(m), f"len {lens[i]}"
+
+
+def test_batch_kernel_masks_padding():
+    """Bytes beyond msg_len must not affect the digest."""
+    m = b"masked-tail"
+    a = np.zeros((1, 256), np.uint8)
+    a[0, :len(m)] = np.frombuffer(m, np.uint8)
+    b = a.copy()
+    b[0, len(m):] = 0xEE
+    ln = jnp.asarray([len(m)], jnp.int32)
+    assert bytes(np.asarray(blake3_batch(jnp.asarray(a), ln))[0]) == \
+        bytes(np.asarray(blake3_batch(jnp.asarray(b), ln))[0]) == blake3(m)
+
+
+def test_lthash_batch_and_homomorphism():
+    rng = np.random.default_rng(7)
+    msgs = [rng.bytes(40), rng.bytes(1200), b""]
+    max_len = 2048
+    arr = np.zeros((len(msgs), max_len), np.uint8)
+    lens = np.zeros((len(msgs),), np.int32)
+    for i, m in enumerate(msgs):
+        arr[i, :len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+    vals = np.asarray(lthash_batch(jnp.asarray(arr), jnp.asarray(lens)))
+    for i, m in enumerate(msgs):
+        want = np.frombuffer(lthash(m), np.uint16)
+        assert (vals[i] == want).all(), i
+    # homomorphism: (a + b + c) - b == a + c, wrapping u16
+    acc = np.zeros((1024,), np.uint16)
+    acc = np.asarray(lthash_add(acc, vals[0]))
+    acc = np.asarray(lthash_add(acc, vals[1]))
+    acc = np.asarray(lthash_add(acc, vals[2]))
+    acc = np.asarray(lthash_sub(acc, vals[1]))
+    want = (vals[0].astype(np.uint32) + vals[2]) & 0xFFFF
+    assert (acc == want.astype(np.uint16)).all()
+    # order independence + reduce fan-in (the snapla/snapls property)
+    r1 = np.asarray(lthash_reduce(jnp.asarray(vals)))
+    r2 = np.asarray(lthash_reduce(jnp.asarray(vals[::-1].copy())))
+    assert (r1 == r2).all()

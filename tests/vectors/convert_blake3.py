@@ -1,0 +1,33 @@
+"""Extract the standard BLAKE3 test vectors from the reference's table
+(public test data from BLAKE3-team/BLAKE3 test_vectors.json, embedded at
+/root/reference/src/ballet/blake3/fd_blake3_test_vector.c) into
+blake3_vectors.json: [{"sz": N, "hash": hex}] — the message is always
+the standard repeating pattern i % 251 (and the reference's extra
+all-zeros rows are kept with "zeros": true)."""
+import json
+import os
+import re
+
+SRC = "/root/reference/src/ballet/blake3/fd_blake3_test_vector.c"
+OUT = os.path.join(os.path.dirname(__file__), "blake3_vectors.json")
+
+
+def main():
+    text = open(SRC).read()
+    rows = []
+    pat = re.compile(
+        r'\{\s*(zeros|"(?:[^"\\]|\\x[0-9a-fA-F]{2}|\\[0-7]{1,3})*")\s*,'
+        r'\s*(\d+)UL,\s*\{((?:\s*_\([0-9a-f]{2}\),?)+)\s*\}')
+    for m in pat.finditer(text):
+        msg_tok, sz, hx = m.group(1), int(m.group(2)), m.group(3)
+        digest = "".join(re.findall(r'_\(([0-9a-f]{2})\)', hx))
+        rows.append({"sz": sz, "zeros": msg_tok == "zeros",
+                     "hash": digest})
+    assert rows, "no vectors parsed"
+    with open(OUT, "w") as f:
+        json.dump(rows, f, indent=0)
+    print(f"wrote {len(rows)} vectors -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
