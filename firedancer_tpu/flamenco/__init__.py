@@ -1,0 +1,2 @@
+"""flamenco: Solana runtime-layer components (ref: src/flamenco/)."""
+from .leaders import EpochLeaders, WeightedSampler  # noqa: F401

@@ -1,0 +1,166 @@
+"""Leader schedule + chacha + forest/repair tests
+(ref: src/flamenco/leaders/fd_leaders.h, src/ballet/chacha/,
+src/discof/forest/fd_forest.h, src/discof/repair/fd_policy.h)."""
+import numpy as np
+
+from firedancer_tpu.flamenco import EpochLeaders
+from firedancer_tpu.keyguard import ROLE_REPAIR, SIGN_TYPE_ED25519, authorize
+from firedancer_tpu.repair import (
+    DISC_ORPHAN, DISC_WINDOW_INDEX, Forest, RepairPolicy, parse_request,
+)
+from firedancer_tpu.utils.chacha import ChaChaRng, chacha20_block
+
+
+def pk(i):
+    return bytes([i]) * 32
+
+
+# ---------------------------------------------------------------------------
+# chacha
+# ---------------------------------------------------------------------------
+
+def test_chacha20_rfc8439_vector():
+    """RFC 8439 §2.3.2 test vector (block 1)."""
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    block = chacha20_block(key, 1, nonce)
+    want = bytes.fromhex(
+        "10f1e7e4d13b5915500fdd1fa32071c4c7d1f4c733c068030422aa9ac3d46c4e"
+        "d2826446079faa0914c2d705d98b02a2b5129cd1de164eb9cbd083e8a2503c4e")
+    assert block == want
+
+
+def test_chacha_rng_determinism_and_bound():
+    a = ChaChaRng(b"\x07" * 32)
+    b = ChaChaRng(b"\x07" * 32)
+    xs = [a.next_u64() for _ in range(10)]
+    assert xs == [b.next_u64() for _ in range(10)]
+    assert xs != [ChaChaRng(b"\x08" * 32).next_u64() for _ in range(10)]
+    r = ChaChaRng(b"\x01" * 32)
+    draws = [r.roll_u64(7) for _ in range(200)]
+    assert set(draws) <= set(range(7)) and len(set(draws)) == 7
+
+
+# ---------------------------------------------------------------------------
+# leader schedule
+# ---------------------------------------------------------------------------
+
+def test_leaders_deterministic_and_rotation():
+    stakes = {pk(1): 100, pk(2): 200, pk(3): 50}
+    a = EpochLeaders(2, b"\x05" * 32, stakes, slots_per_epoch=40)
+    b = EpochLeaders(2, b"\x05" * 32, stakes, slots_per_epoch=40)
+    slots = range(80, 120)
+    assert [a.leader_for(s) for s in slots] == \
+        [b.leader_for(s) for s in slots]
+    # leader constant within each 4-slot rotation
+    for r in range(10):
+        base = 80 + 4 * r
+        ls = {a.leader_for(base + i) for i in range(4)}
+        assert len(ls) == 1
+    # leader_slots inverts leader_for
+    for key in stakes:
+        for s in a.leader_slots(key):
+            assert a.leader_for(s) == key
+
+
+def test_leaders_stake_proportional():
+    stakes = {pk(1): 900, pk(2): 90, pk(3): 10}
+    el = EpochLeaders(0, b"\x09" * 32, stakes, slots_per_epoch=4000)
+    counts = {k: len(el.leader_slots(k)) for k in stakes}
+    assert counts[pk(1)] > counts[pk(2)] > counts[pk(3)]
+    assert counts[pk(1)] > 0.8 * 4000
+    # zero-stake nodes never lead
+    stakes[pk(4)] = 0
+    el2 = EpochLeaders(0, b"\x09" * 32, stakes, slots_per_epoch=400)
+    assert not el2.leader_slots(pk(4))
+
+
+def test_leaders_seed_changes_schedule():
+    stakes = {pk(i): 100 for i in range(1, 6)}
+    a = EpochLeaders(0, b"\x01" * 32, stakes, slots_per_epoch=400)
+    b = EpochLeaders(0, b"\x02" * 32, stakes, slots_per_epoch=400)
+    assert a.sched != b.sched
+
+
+# ---------------------------------------------------------------------------
+# forest
+# ---------------------------------------------------------------------------
+
+def test_forest_bfs_frontier_and_completion():
+    f = Forest(root_slot=10)
+    # 10 <- 11 <- 12 and a fork 10 <- 13
+    f.shred(11, 0, parent_off=1)
+    f.shred(11, 2, slot_complete=True)        # missing idx 1
+    f.shred(12, 0, parent_off=1, slot_complete=True)
+    f.shred(13, 1, parent_off=3)              # end unknown, missing 0
+    assert f.frontier() == [11, 13]           # 12 complete; BFS order
+    reqs = f.requests()
+    assert (11, 1) in reqs and (13, 0) in reqs
+    assert all(s != 12 for s, _ in reqs)
+    f.shred(11, 1)
+    assert f.blks[11].is_complete
+    assert f.frontier() == [13]
+
+
+def test_forest_orphans_then_link():
+    f = Forest(root_slot=0)
+    f.vote(20)                                # existence via gossip only
+    assert 20 in f.frontier()                 # orphan, repairs last
+    f.shred(20, 1, parent_off=2, slot_complete=True)   # idx 0 missing
+    f.link(18, 17)
+    f.shred(18, 0, parent_off=1, slot_complete=True)
+    # 20's parent 18 now linked through 17: 17 missing entirely
+    f.link(17, 0)
+    front = f.frontier()
+    assert front.index(17) < front.index(20)
+
+
+def test_forest_publish_prunes():
+    f = Forest(root_slot=0)
+    f.shred(1, 0, parent_off=1, slot_complete=True)
+    f.shred(2, 0, parent_off=2, slot_complete=True)   # fork off 0
+    f.shred(3, 0, parent_off=2, slot_complete=False)  # child of 1
+    f.publish(1)
+    assert f.root == 1
+    assert 2 not in f.blks                    # rival fork pruned
+    assert 3 in f.blks
+
+
+# ---------------------------------------------------------------------------
+# repair policy
+# ---------------------------------------------------------------------------
+
+def test_policy_requests_dedup_and_roundrobin():
+    ident = pk(9)
+    f = Forest(root_slot=10)
+    f.shred(11, 0, parent_off=1)
+    f.shred(11, 3, slot_complete=True)        # missing 1, 2
+    pol = RepairPolicy(ident, dedup_window_ns=1_000_000)
+    pol.set_peers([pk(1), pk(2)])
+    reqs = pol.plan(f, now_ns=0)
+    assert len(reqs) == 2
+    peers = [p for p, _ in reqs]
+    assert peers == [pk(1), pk(2)]            # round-robin
+    disc, sender, recipient, ts, nonce, slot, idx = \
+        parse_request(reqs[0][1])
+    assert disc == DISC_WINDOW_INDEX and sender == ident
+    assert recipient == pk(1)
+    assert slot == 11 and idx in (1, 2)
+    # every request passes the keyguard's repair-role authorization
+    for _, payload in reqs:
+        assert authorize(ident, payload, ROLE_REPAIR, SIGN_TYPE_ED25519)
+    # within the window: suppressed; after: resent
+    assert pol.plan(f, now_ns=500_000) == []
+    assert len(pol.plan(f, now_ns=2_000_000)) == 2
+
+
+def test_policy_orphan_requests():
+    ident = pk(9)
+    f = Forest(root_slot=0)
+    f.vote(33)
+    pol = RepairPolicy(ident)
+    pol.set_peers([pk(1)])
+    reqs = pol.plan(f, now_ns=0)
+    assert reqs
+    disc, _, _, _, _, slot, _ = parse_request(reqs[0][1])
+    assert disc == DISC_ORPHAN and slot == 33
