@@ -1,4 +1,5 @@
 from .accdb import AccDb, Account, RwHandle  # noqa: F401
 from .executor import (SystemTxn, execute_block, execute_block_serial,  # noqa: F401
                        STATUS_OK, STATUS_INSUFFICIENT, STATUS_FEE_FAIL)
+from .programs import TxnExecutor, TxnResult  # noqa: F401
 from .txncache import MAX_CACHE_AGE_SLOTS, TxnCache  # noqa: F401

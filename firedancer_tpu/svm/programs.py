@@ -1,0 +1,227 @@
+"""Host-side transaction executor + native system program.
+
+The reference's per-txn execution (load accounts, charge fees, dispatch
+instructions sequentially through native program handlers, commit or
+roll back atomically) lives in fd_executor/fd_system_program
+(ref: src/flamenco/runtime/fd_executor.c, fd_runtime.h:254-266,
+program/fd_system_program.c:59-330). The wave executor (executor.py)
+covers the batched pure-transfer fast path on device; THIS module is
+the general host path the exec tiles run for everything else — the
+split SURVEY §7 hard-part 6 prescribes (sBPF and general dispatch stay
+on host cores).
+
+Semantics mirrored from the reference per instruction:
+  Transfer        from must SIGN and be system-owned with no data;
+                  insufficient lamports aborts the txn
+                  (fd_system_program.c:59-137)
+  CreateAccount   to must SIGN, be empty (0 lamports, no data, system
+                  owner); allocate+assign+fund (:254-330)
+  Assign          account must SIGN, be system-owned (:202-230)
+  Allocate        account must SIGN, be system-owned, data empty;
+                  space <= MAX_PERMITTED_DATA_LENGTH (:143-200)
+
+A failing instruction rolls the whole transaction back; the fee is
+charged to the payer regardless (the reference commits fees before
+execution). Every touched account goes through accdb rw handles, so
+rollback is just dropping them (accdb.close_rw(discard=True)).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from ..protocol.txn import ParsedTxn, parse_txn
+from .accdb import AccDb, Account, SYSTEM_PROGRAM_ID
+
+COMPUTE_BUDGET_PROGRAM_ID = b"ComputeBudget" + bytes(19)
+MAX_PERMITTED_DATA_LENGTH = 10 * 1024 * 1024
+
+# system instruction discriminants (u32 LE bincode)
+SYS_CREATE_ACCOUNT = 0
+SYS_ASSIGN = 1
+SYS_TRANSFER = 2
+SYS_ALLOCATE = 8
+
+# status codes (fd_executor error flavor)
+OK = "ok"
+ERR_FEE = "fee_payer_insufficient"
+ERR_PARSE = "parse_failed"
+ERR_MISSING_SIG = "missing_required_signature"
+ERR_NOT_WRITABLE = "account_not_writable"
+ERR_INSUFFICIENT = "insufficient_funds"
+ERR_ALREADY_IN_USE = "account_already_in_use"
+ERR_INVALID_OWNER = "invalid_account_owner"
+ERR_HAS_DATA = "account_has_data"
+ERR_SPACE = "invalid_space"
+ERR_UNKNOWN_IX = "unknown_instruction"
+ERR_UNKNOWN_PROGRAM = "unknown_program"
+ERR_BAD_IX_DATA = "bad_instruction_data"
+
+
+@dataclass
+class TxnResult:
+    status: str
+    fee: int
+    logs: list
+
+
+class TxnContext:
+    """Per-txn view: copy-on-write accounts over one accdb fork."""
+
+    def __init__(self, db: AccDb, xid, txn: ParsedTxn, payload: bytes):
+        self.db = db
+        self.xid = xid
+        self.txn = txn
+        self.payload = payload
+        self.keys = txn.account_keys(payload)
+        self._work: dict[bytes, Account] = {}
+        self.logs: list[str] = []
+
+    def is_signer(self, idx: int) -> bool:
+        return idx < self.txn.sig_cnt
+
+    def is_writable(self, idx: int) -> bool:
+        return self.txn.is_writable(idx)
+
+    def account(self, idx: int) -> Account:
+        k = self.keys[idx]
+        if k not in self._work:
+            a = self.db.peek(self.xid, k)
+            self._work[k] = Account() if a is None else \
+                Account(a.lamports, a.data, a.owner, a.executable,
+                        a.rent_epoch)
+        return self._work[k]
+
+    def commit(self):
+        for k, a in self._work.items():
+            self.db.funk.rec_write(self.xid, k, a)
+
+
+def _u64(data: bytes, off: int) -> int:
+    return struct.unpack_from("<Q", data, off)[0]
+
+
+def _exec_system(ctx: TxnContext, instr) -> str:
+    data = ctx.payload[instr.data_off:instr.data_off + instr.data_sz]
+    if len(data) < 4:
+        return ERR_BAD_IX_DATA
+    disc = struct.unpack_from("<I", data, 0)[0]
+    ai = instr.acct_idxs
+
+    if disc == SYS_TRANSFER:
+        if len(data) < 12 or len(ai) < 2:
+            return ERR_BAD_IX_DATA
+        amount = _u64(data, 4)
+        f, t = ai[0], ai[1]
+        if not ctx.is_signer(f):
+            return ERR_MISSING_SIG
+        if not ctx.is_writable(f) or not ctx.is_writable(t):
+            return ERR_NOT_WRITABLE
+        src = ctx.account(f)
+        if src.data:
+            return ERR_HAS_DATA          # transfer-from must hold no data
+        if amount > src.lamports:
+            ctx.logs.append(
+                f"Transfer: insufficient lamports {src.lamports}, "
+                f"need {amount}")
+            return ERR_INSUFFICIENT
+        src.lamports -= amount
+        ctx.account(t).lamports += amount
+        return OK
+
+    if disc == SYS_CREATE_ACCOUNT:
+        if len(data) < 4 + 8 + 8 + 32 or len(ai) < 2:
+            return ERR_BAD_IX_DATA
+        lamports = _u64(data, 4)
+        space = _u64(data, 12)
+        owner = data[20:52]
+        f, t = ai[0], ai[1]
+        if not ctx.is_signer(f) or not ctx.is_signer(t):
+            return ERR_MISSING_SIG
+        if not ctx.is_writable(f) or not ctx.is_writable(t):
+            return ERR_NOT_WRITABLE
+        to = ctx.account(t)
+        if to.lamports or to.data or to.owner != SYSTEM_PROGRAM_ID:
+            ctx.logs.append("Create Account: account already in use")
+            return ERR_ALREADY_IN_USE
+        if space > MAX_PERMITTED_DATA_LENGTH:
+            return ERR_SPACE
+        src = ctx.account(f)
+        if lamports > src.lamports:
+            return ERR_INSUFFICIENT
+        to.data = bytes(space)
+        to.owner = owner
+        src.lamports -= lamports
+        to.lamports += lamports
+        return OK
+
+    if disc == SYS_ASSIGN:
+        if len(data) < 36 or len(ai) < 1:
+            return ERR_BAD_IX_DATA
+        a = ai[0]
+        if not ctx.is_signer(a):
+            return ERR_MISSING_SIG
+        acct = ctx.account(a)
+        if acct.owner != SYSTEM_PROGRAM_ID:
+            return ERR_INVALID_OWNER
+        acct.owner = data[4:36]
+        return OK
+
+    if disc == SYS_ALLOCATE:
+        if len(data) < 12 or len(ai) < 1:
+            return ERR_BAD_IX_DATA
+        space = _u64(data, 4)
+        a = ai[0]
+        if not ctx.is_signer(a):
+            return ERR_MISSING_SIG
+        acct = ctx.account(a)
+        if acct.owner != SYSTEM_PROGRAM_ID:
+            return ERR_INVALID_OWNER
+        if acct.data:
+            return ERR_HAS_DATA
+        if space > MAX_PERMITTED_DATA_LENGTH:
+            return ERR_SPACE
+        acct.data = bytes(space)
+        return OK
+
+    return ERR_UNKNOWN_IX
+
+
+class TxnExecutor:
+    """fd_runtime_prepare_and_execute_txn analog for the host path."""
+
+    def __init__(self, db: AccDb, fee_per_signature: int = 5000):
+        self.db = db
+        self.fee_per_signature = fee_per_signature
+
+    def execute(self, xid, payload: bytes) -> TxnResult:
+        try:
+            txn = parse_txn(payload)
+        except Exception:
+            return TxnResult(ERR_PARSE, 0, [])
+        keys = txn.account_keys(payload)
+        fee = self.fee_per_signature * txn.sig_cnt
+
+        # fee payer: signer 0, charged even when execution fails
+        # (the reference commits fees before dispatch)
+        payer = self.db.open_rw(xid, keys[0], do_create=True)
+        if payer.account.lamports < fee:
+            self.db.close_rw(payer, discard=True)
+            return TxnResult(ERR_FEE, 0, [])
+        payer.account.lamports -= fee
+        self.db.close_rw(payer)
+
+        ctx = TxnContext(self.db, xid, txn, payload)
+        for instr in txn.instrs:
+            prog = keys[instr.prog_idx]
+            if prog == SYSTEM_PROGRAM_ID:
+                st = _exec_system(ctx, instr)
+            elif prog == COMPUTE_BUDGET_PROGRAM_ID:
+                st = OK                  # limits handled by pack/cost
+            else:
+                st = ERR_UNKNOWN_PROGRAM
+            if st != OK:
+                # atomic rollback: drop the working set (fee stays)
+                return TxnResult(st, fee, ctx.logs)
+        ctx.commit()
+        return TxnResult(OK, fee, ctx.logs)

@@ -1,0 +1,148 @@
+"""Host txn executor + system program semantics tests
+(ref: src/flamenco/runtime/program/fd_system_program.c:59-330,
+fd_executor atomic-rollback + fee-first discipline)."""
+import struct
+
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account
+from firedancer_tpu.svm.accdb import SYSTEM_PROGRAM_ID
+from firedancer_tpu.svm.programs import (
+    ERR_ALREADY_IN_USE, ERR_FEE, ERR_HAS_DATA, ERR_INSUFFICIENT,
+    ERR_INVALID_OWNER, ERR_MISSING_SIG, ERR_UNKNOWN_PROGRAM, OK,
+    SYS_ALLOCATE, SYS_ASSIGN, SYS_CREATE_ACCOUNT, SYS_TRANSFER,
+    TxnExecutor,
+)
+
+FEE = 5000
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+def make_txn(signers, extra, instrs, n_ro_unsigned=0):
+    """Unsigned-signature txn (executor doesn't re-verify sigs — the
+    verify tile did; same split as the reference)."""
+    msg = build_message(signers, extra, b"\x11" * 32, instrs,
+                        n_ro_unsigned=n_ro_unsigned)
+    return build_txn([bytes(64)] * len(signers), msg)
+
+
+def sys_ix(prog_idx, accts, disc, *fields):
+    data = struct.pack("<I", disc)
+    for f in fields:
+        data += f if isinstance(f, bytes) else struct.pack("<Q", f)
+    return (prog_idx, bytes(accts), data)
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(1), Account(lamports=1_000_000))
+    funk.txn_prepare(None, "blk")
+    return funk, db, TxnExecutor(db)
+
+
+def test_transfer_ok_and_fee(env):
+    funk, db, ex = env
+    # accounts: [payer k1, dest k2, program]
+    txn = make_txn([k(1)], [k(2), SYSTEM_PROGRAM_ID],
+                   [sys_ix(2, [0, 1], SYS_TRANSFER, 300)])
+    r = ex.execute("blk", txn)
+    assert r.status == OK and r.fee == FEE
+    assert db.lamports("blk", k(1)) == 1_000_000 - FEE - 300
+    assert db.lamports("blk", k(2)) == 300
+
+
+def test_failed_instruction_rolls_back_but_charges_fee(env):
+    funk, db, ex = env
+    txn = make_txn([k(1)], [k(2), SYSTEM_PROGRAM_ID],
+                   [sys_ix(2, [0, 1], SYS_TRANSFER, 100),
+                    sys_ix(2, [0, 1], SYS_TRANSFER, 10**12)])
+    r = ex.execute("blk", txn)
+    assert r.status == ERR_INSUFFICIENT
+    # first transfer rolled back; fee charged
+    assert db.lamports("blk", k(1)) == 1_000_000 - FEE
+    assert db.lamports("blk", k(2)) == 0
+    assert any("insufficient lamports" in ln for ln in r.logs)
+
+
+def test_fee_payer_insufficient(env):
+    funk, db, ex = env
+    funk.rec_write("blk", k(3), Account(lamports=10))
+    txn = make_txn([k(3)], [k(2), SYSTEM_PROGRAM_ID],
+                   [sys_ix(2, [0, 1], SYS_TRANSFER, 1)])
+    r = ex.execute("blk", txn)
+    assert r.status == ERR_FEE and r.fee == 0
+    assert db.lamports("blk", k(3)) == 10
+
+
+def test_create_account(env):
+    funk, db, ex = env
+    owner = k(9)
+    txn = make_txn([k(1), k(5)], [SYSTEM_PROGRAM_ID],
+                   [sys_ix(2, [0, 1], SYS_CREATE_ACCOUNT, 1000, 64,
+                           owner)])
+    r = ex.execute("blk", txn)
+    assert r.status == OK
+    acct = db.peek("blk", k(5))
+    assert acct.lamports == 1000 and acct.owner == owner
+    assert acct.data == bytes(64)
+    # creating again: already in use
+    funk.rec_write("blk", k(1),
+                   Account(lamports=1_000_000))     # top up payer
+    r2 = ex.execute("blk", txn)
+    assert r2.status == ERR_ALREADY_IN_USE
+
+
+def test_create_requires_both_signers(env):
+    funk, db, ex = env
+    txn = make_txn([k(1)], [k(5), SYSTEM_PROGRAM_ID],
+                   [sys_ix(2, [0, 1], SYS_CREATE_ACCOUNT, 1000, 0,
+                           k(9))])
+    assert ex.execute("blk", txn).status == ERR_MISSING_SIG
+
+
+def test_assign_and_allocate(env):
+    funk, db, ex = env
+    txn = make_txn([k(1)], [SYSTEM_PROGRAM_ID],
+                   [sys_ix(1, [0], SYS_ALLOCATE, 32),
+                    sys_ix(1, [0], SYS_ASSIGN, k(7))])
+    r = ex.execute("blk", txn)
+    assert r.status == OK
+    acct = db.peek("blk", k(1))
+    assert acct.data == bytes(32) and acct.owner == k(7)
+    # now non-system-owned: further assigns refused
+    txn2 = make_txn([k(1)], [SYSTEM_PROGRAM_ID],
+                    [sys_ix(1, [0], SYS_ASSIGN, k(8))])
+    assert ex.execute("blk", txn2).status == ERR_INVALID_OWNER
+
+
+def test_transfer_from_data_account_refused(env):
+    funk, db, ex = env
+    funk.rec_write("blk", k(4), Account(lamports=500, data=b"state"))
+    funk.rec_write("blk", k(1), Account(lamports=1_000_000))
+    txn = make_txn([k(1), k(4)], [k(2), SYSTEM_PROGRAM_ID],
+                   [sys_ix(3, [1, 2], SYS_TRANSFER, 10)])
+    assert ex.execute("blk", txn).status == ERR_HAS_DATA
+
+
+def test_unknown_program(env):
+    funk, db, ex = env
+    txn = make_txn([k(1)], [k(0x42)], [(1, bytes([0]), b"\x01")])
+    assert ex.execute("blk", txn).status == ERR_UNKNOWN_PROGRAM
+
+
+def test_fork_isolation(env):
+    """Execution in a fork never leaks to the root until publish."""
+    funk, db, ex = env
+    txn = make_txn([k(1)], [k(2), SYSTEM_PROGRAM_ID],
+                   [sys_ix(2, [0, 1], SYS_TRANSFER, 300)])
+    assert ex.execute("blk", txn).status == OK
+    assert db.lamports(None, k(2)) == 0
+    funk.txn_publish("blk")
+    assert db.lamports(None, k(2)) == 300
