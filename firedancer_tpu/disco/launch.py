@@ -27,7 +27,21 @@ from .topo import TileCtx
 
 def tile_main(plan: dict, tile_name: str):
     """Entry point of a tile process (ref: fd_topo_run_tile)."""
-    from .tiles import REGISTRY
+    import sys
+
+    from .tiles import REGISTRY, _setup_jax
+    # honor the platform override for EVERY tile before any adapter
+    # import can build jnp constants: a module-level jnp.asarray
+    # initializes the default (device) backend, and a wedged device
+    # tunnel would hang a tile that never wanted the device at all.
+    # If jax is already resident (sitecustomize imports it at
+    # interpreter startup in this image), only the config update works;
+    # otherwise env suffices and non-device tiles skip the import cost.
+    if "jax" in sys.modules:
+        _setup_jax()
+    elif os.environ.get("FDTPU_JAX_PLATFORM"):
+        os.environ.setdefault("JAX_PLATFORMS",
+                              os.environ["FDTPU_JAX_PLATFORM"])
     ctx = TileCtx(plan, tile_name)
     try:
         kind = plan["tiles"][tile_name]["kind"]

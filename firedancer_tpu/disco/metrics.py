@@ -111,6 +111,7 @@ def render_prometheus(plan: dict, wksp) -> str:
         "# TYPE fdtpu_tile_up gauge",
         "# TYPE fdtpu_heartbeat_age_ticks gauge",
         "# TYPE fdtpu_tile_metric counter",
+        "# TYPE fdtpu_tile_gauge gauge",
     ]
     hist_lines: list[str] = []
     now = topo_mod.now_ticks()
@@ -125,8 +126,11 @@ def render_prometheus(plan: dict, wksp) -> str:
         for i, nm in enumerate(spec.get("metrics_names", [])):
             if i >= len(vals):
                 break
+            # config-ish slots (bound ports) are gauges, not counters
+            series = "fdtpu_tile_gauge" if nm.endswith("port") \
+                else "fdtpu_tile_metric"
             lines.append(
-                f'fdtpu_tile_metric{{{lab},name="{_esc(nm)}"}} {int(vals[i])}')
+                f'{series}{{{lab},name="{_esc(nm)}"}} {int(vals[i])}')
         for kind, h in read_hists(wksp, plan, tn).items():
             base = f"fdtpu_poll_{kind}_seconds"
             cum = 0

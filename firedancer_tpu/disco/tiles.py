@@ -187,7 +187,7 @@ class PackAdapter:
     completion frags.
 
     Microblock wire format (one frag): u16 bank | u16 txn_cnt |
-    u64 microblock_id | (u16 len | payload)*.
+    u64 microblock_id | u64 slot | (u16 len | payload)*.
     Completion frag: u64 microblock_id (per-bank dedicated link).
 
     args: txn_in (link), bank_links (ordered list), done_links (ordered
@@ -215,7 +215,7 @@ class PackAdapter:
             limits=PackLimits(
                 max_txn_per_microblock=int(
                     args.get("max_txn_per_microblock", 31)),
-                max_data_bytes_per_microblock=mtu - 12))
+                max_data_bytes_per_microblock=mtu - 20))
         self.slot_in = args.get("slot_in")
         self.slot_ms = float(args.get("slot_ms", 400.0))
         self._slot_t0 = time.monotonic()
@@ -224,10 +224,12 @@ class PackAdapter:
         self.in_mtu = ctx.plan["links"][self.txn_in]["mtu"]
         self.busy = [None] * n_banks      # outstanding microblock id
         self._next_mb = 0
+        self.cur_slot = 0                 # advanced by PoH slot frags
         self.m = {k: 0 for k in self.METRICS}
 
     def _serialize(self, bank: int, mb_id: int, metas) -> bytes:
-        out = bytearray(struct.pack("<HHQ", bank, len(metas), mb_id))
+        out = bytearray(struct.pack("<HHQQ", bank, len(metas), mb_id,
+                                    self.cur_slot))
         for m in metas:
             out += struct.pack("<H", len(m.payload)) + m.payload
         return bytes(out)
@@ -268,9 +270,12 @@ class PackAdapter:
             k, self.seqs[self.slot_in], buf, sizes, sigs, ovr = \
                 ring.gather(self.seqs[self.slot_in], 4, 16)
             self.m["overruns"] += ovr
-            for _ in range(k):
+            for i in range(k):
                 self.sched.end_block()
                 self.m["blocks"] += 1
+                # slot frag payload = u64 completed slot (poh tile)
+                (done_slot,) = struct.unpack_from("<Q", buf[i], 0)
+                self.cur_slot = done_slot + 1
             total += k
         # 3) fill idle banks
         for bank, ln in enumerate(self.bank_links):
@@ -300,6 +305,7 @@ class PackAdapter:
             self.sched.end_block()
             self._slot_t0 = time.monotonic()
             self.m["blocks"] += 1
+            self.cur_slot += 1
 
     def in_seqs(self):
         return dict(self.seqs)
@@ -328,7 +334,7 @@ class BankAdapter:
     remaining out link."""
 
     METRICS = ["microblocks", "txns", "transfers", "exec_skip",
-               "exec_fail", "overruns"]
+               "exec_fail", "overruns", "rpc_port"]
 
     def __init__(self, ctx, args):
         self.ctx = ctx
@@ -350,6 +356,8 @@ class BankAdapter:
             self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
             self.out_fseqs = _single(ctx.out_fseqs, "out link",
                                      ctx.tile_name)
+        self.m = {k: 0 for k in self.METRICS}
+        self.slot = 0                  # highest slot seen in microblocks
         if self.exec_mode == "svm":
             _setup_jax()
             from ..funk.funk import Funk
@@ -361,9 +369,20 @@ class BankAdapter:
             for acct_hex, bal in args.get("genesis", {}).items():
                 self.funk.rec_write(None, bytes.fromhex(acct_hex),
                                     int(bal))
+            # optional JSON-RPC surface over this bank's state (the
+            # rpc-tile seam; production would read a shared accdb,
+            # ref src/discof/rpc/fd_rpc_tile.c)
+            self.rpc = None
+            if args.get("rpc_port") is not None:
+                from ..rpc import RpcServer
+                self.rpc = RpcServer(
+                    lambda: {"funk": self.funk,
+                             "slot": self.slot,
+                             "txn_count": self.m["transfers"]},
+                    port=int(args["rpc_port"]))
+                self.m["rpc_port"] = self.rpc.port
         self.seq = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
-        self.m = {k: 0 for k in self.METRICS}
 
     def _parse_transfers(self, frame, txn_cnt):
         """Microblock frame -> (SystemTxn list — one per system-program
@@ -377,7 +396,7 @@ class BankAdapter:
         from ..protocol.txn import parse_txn
         from ..svm.executor import SystemTxn
         txns, sigs = [], []
-        off = 12
+        off = 20
         for _ in range(txn_cnt):
             (ln,) = struct.unpack_from("<H", frame, off)
             off += 2
@@ -418,7 +437,9 @@ class BankAdapter:
         self.m["overruns"] += ovr
         for i in range(n):
             frame = bytes(buf[i, :sizes[i]])
-            bank, txn_cnt, mb_id = struct.unpack_from("<HHQ", frame, 0)
+            bank, txn_cnt, mb_id, slot = struct.unpack_from("<HHQQ",
+                                                            frame, 0)
+            self.slot = max(self.slot, slot)
             self.m["txns"] += txn_cnt
             self.m["microblocks"] += 1
             if self.exec_mode == "svm" and txn_cnt:

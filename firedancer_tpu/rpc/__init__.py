@@ -1,0 +1,2 @@
+"""rpc: JSON-RPC service surface (ref: src/discof/rpc/)."""
+from .server import RpcServer  # noqa: F401

@@ -1,0 +1,119 @@
+"""Minimal JSON-RPC server (ref: src/discof/rpc/fd_rpc_tile.c — the
+full client serves Solana JSON-RPC from replay state; the reference's
+HTTP layer is src/waltz/http/fd_http_server.h).
+
+Serves the account/health/progress subset over a daemon-thread HTTP
+server fed by a state provider callable, so any tile owning runtime
+state (today: the bank tile's funk + counters) can expose it:
+
+  getHealth            -> "ok"
+  getSlot              -> provider "slot"
+  getTransactionCount  -> provider "txn_count"
+  getBalance           -> lamports of base58 pubkey (accdb-typed or
+                          legacy int records)
+  getAccountInfo       -> {lamports, owner, executable, rentEpoch,
+                          data: [base64, "base64"]}
+
+Wire shape follows JSON-RPC 2.0 with Solana's {context, value} result
+envelope for account queries.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..svm.accdb import Account
+from ..utils.base58 import b58_decode_32
+
+
+class RpcServer:
+    def __init__(self, provider, port: int = 0,
+                 bind_addr: str = "127.0.0.1"):
+        """provider() -> {"funk": Funk, "slot": int, "txn_count": int}"""
+        self.provider = provider
+        rpc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    resp = rpc._dispatch(req)
+                except Exception as e:  # noqa: BLE001 — server must answer
+                    resp = {"jsonrpc": "2.0", "id": None,
+                            "error": {"code": -32700,
+                                      "message": f"parse error: {e}"}}
+                body = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer((bind_addr, port), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, req: dict) -> dict:
+        rid = req.get("id")
+        method = req.get("method")
+        params = req.get("params") or []
+        st = self.provider()
+        try:
+            if method == "getHealth":
+                result = "ok"
+            elif method == "getSlot":
+                result = int(st.get("slot", 0))
+            elif method == "getTransactionCount":
+                result = int(st.get("txn_count", 0))
+            elif method == "getBalance":
+                result = {"context": {"slot": int(st.get("slot", 0))},
+                          "value": self._balance(st, params[0])}
+            elif method == "getAccountInfo":
+                result = {"context": {"slot": int(st.get("slot", 0))},
+                          "value": self._account(st, params[0])}
+            else:
+                return {"jsonrpc": "2.0", "id": rid,
+                        "error": {"code": -32601,
+                                  "message": f"method not found: {method}"}}
+        except Exception as e:  # noqa: BLE001
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": -32602, "message": str(e)}}
+        return {"jsonrpc": "2.0", "id": rid, "result": result}
+
+    @staticmethod
+    def _rec(st, pubkey_b58: str):
+        return st["funk"].rec_query(None, b58_decode_32(pubkey_b58))
+
+    def _balance(self, st, pubkey_b58: str) -> int:
+        v = self._rec(st, pubkey_b58)
+        if isinstance(v, Account):
+            return v.lamports
+        return int(v) if v is not None else 0
+
+    def _account(self, st, pubkey_b58: str):
+        v = self._rec(st, pubkey_b58)
+        if v is None:
+            return None
+        if not isinstance(v, Account):
+            v = Account(lamports=int(v))
+        return {
+            "lamports": v.lamports,
+            "owner": v.owner.hex(),
+            "executable": v.executable,
+            "rentEpoch": v.rent_epoch,
+            "data": [base64.b64encode(v.data).decode(), "base64"],
+        }

@@ -1,0 +1,56 @@
+"""JSON-RPC server tests (ref: src/discof/rpc/fd_rpc_tile.c subset)."""
+import json
+import urllib.request
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.rpc import RpcServer
+from firedancer_tpu.svm import Account
+from firedancer_tpu.utils.base58 import b58_encode_32
+
+
+def call(port, method, params=None, rid=1):
+    body = json.dumps({"jsonrpc": "2.0", "id": rid, "method": method,
+                       "params": params or []}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_rpc_methods():
+    funk = Funk()
+    k1 = b"\x01" * 32
+    k2 = b"\x02" * 32
+    funk.rec_write(None, k1, Account(lamports=777, data=b"acct-data",
+                                     owner=b"\x09" * 32, rent_epoch=3))
+    funk.rec_write(None, k2, 1234)         # legacy int record
+    srv = RpcServer(lambda: {"funk": funk, "slot": 42, "txn_count": 17})
+    try:
+        assert call(srv.port, "getHealth")["result"] == "ok"
+        assert call(srv.port, "getSlot")["result"] == 42
+        assert call(srv.port, "getTransactionCount")["result"] == 17
+
+        r = call(srv.port, "getBalance", [b58_encode_32(k1)])
+        assert r["result"]["value"] == 777
+        assert r["result"]["context"]["slot"] == 42
+        assert call(srv.port, "getBalance",
+                    [b58_encode_32(k2)])["result"]["value"] == 1234
+        assert call(srv.port, "getBalance",
+                    [b58_encode_32(b"\x07" * 32)])["result"]["value"] == 0
+
+        acct = call(srv.port, "getAccountInfo",
+                    [b58_encode_32(k1)])["result"]["value"]
+        assert acct["lamports"] == 777
+        assert acct["rentEpoch"] == 3
+        import base64
+        assert base64.b64decode(acct["data"][0]) == b"acct-data"
+        assert call(srv.port, "getAccountInfo",
+                    [b58_encode_32(b"\x07" * 32)])["result"]["value"] is None
+
+        err = call(srv.port, "noSuchMethod")
+        assert err["error"]["code"] == -32601
+        err = call(srv.port, "getBalance", ["not-base58!!!"])
+        assert "error" in err
+    finally:
+        srv.close()

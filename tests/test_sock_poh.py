@@ -106,7 +106,7 @@ def leader():
               slot_in="poh_slots", max_txn_per_microblock=8)
         .tile("bank0", "bank", ins=["pack_bank0"],
               outs=["bank0_done", "bank0_poh"], exec="svm",
-              poh_link="bank0_poh", genesis=genesis)
+              poh_link="bank0_poh", genesis=genesis, rpc_port=0)
         .tile("poh", "poh", ins=["bank0_poh"],
               outs=["poh_entries", "poh_slots"], slot_link="poh_slots",
               hashes_per_tick=16, ticks_per_slot=4)
@@ -199,3 +199,32 @@ def test_poh_entry_chain_verifies(leader):
         assert not bad[0] and bad[1:].all()
     finally:
         wksp.close()
+
+
+def test_leader_bank_serves_rpc(leader):
+    """The bank tile's JSON-RPC surface answers over HTTP while the
+    leader loop runs (ref: src/discof/rpc/fd_rpc_tile.c subset)."""
+    import json
+    import urllib.request
+
+    leader.wait_running(timeout_s=540)
+    assert _wait(lambda: leader.metrics("bank0")["transfers"] == N_TXNS)
+    assert _wait(lambda: leader.metrics("bank0")["rpc_port"] > 0)
+    port = leader.metrics("bank0")["rpc_port"]
+
+    def call(method, params=None):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                           "params": params or []}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    assert call("getHealth")["result"] == "ok"
+    assert call("getTransactionCount")["result"] == N_TXNS
+    # a genesis account's balance is queryable over the wire
+    from firedancer_tpu.utils.base58 import b58_encode_32
+    pub = keypair(synth_signer_seed(0))[-1]
+    bal = call("getBalance", [b58_encode_32(pub)])["result"]["value"]
+    assert 0 < bal <= (1 << 44)
