@@ -106,13 +106,19 @@ class Funk:
             chain.append(p)
             p = p.parent
         chain.reverse()
-        # fold updates into root in order
+        # fold into a COPY, then swap the reference: concurrent readers
+        # (e.g. the bank tile's RPC thread) see either the old or the
+        # new published state, never a half-applied fold — publish is
+        # atomic for same-process readers (the reference gets this from
+        # funk's lockfree record map)
+        new_root = dict(self._root)
         for txn in chain:
             for k, v in txn.recs.items():
                 if v is _TOMBSTONE:
-                    self._root.pop(k, None)
+                    new_root.pop(k, None)
                 else:
-                    self._root[k] = v
+                    new_root[k] = v
+        self._root = new_root
         # survivors: the subtree rooted at t; everything else dies
         survivors = {}
 

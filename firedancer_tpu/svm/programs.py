@@ -212,10 +212,13 @@ class TxnExecutor:
         self.db.close_rw(payer)
 
         ctx = TxnContext(self.db, xid, txn, payload)
+        from .vote import VOTE_PROGRAM_ID, exec_vote
         for instr in txn.instrs:
             prog = keys[instr.prog_idx]
             if prog == SYSTEM_PROGRAM_ID:
                 st = _exec_system(ctx, instr)
+            elif prog == VOTE_PROGRAM_ID:
+                st = exec_vote(ctx, instr)
             elif prog == COMPUTE_BUDGET_PROGRAM_ID:
                 st = OK                  # limits handled by pack/cost
             else:

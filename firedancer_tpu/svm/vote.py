@@ -1,0 +1,194 @@
+"""Native vote program (ref: src/flamenco/runtime/program/
+fd_vote_program.c — subset: InitializeAccount, Vote, Withdraw; vote
+state per src/flamenco/types vote state layout, re-shaped).
+
+The on-chain tower state IS choreo's TowerBFT tower (choreo/tower.py):
+a Vote instruction pushes slots through the same expiry/doubling/
+rooting rules the consensus layer uses, credits accrue per rooted slot,
+and the serialized account state round-trips through a compact struct
+layout (not Solana bincode — the layout is this framework's own; the
+SEMANTICS follow the reference).
+
+State layout (little-endian):
+  node_pubkey 32 | authorized_voter 32 | authorized_withdrawer 32 |
+  commission u8 | root_slot u64 (2^64-1 = none) | credits u64 |
+  last_ts u64 | vote_cnt u16 | votes: (slot u64 | conf u32)*
+"""
+from __future__ import annotations
+
+import struct
+
+from ..choreo.tower import Tower, TowerVote
+
+VOTE_PROGRAM_ID = b"Vote" + bytes(28)
+NO_ROOT = (1 << 64) - 1
+
+VOTE_IX_INITIALIZE = 0
+VOTE_IX_VOTE = 1
+VOTE_IX_WITHDRAW = 2
+
+_HDR = "<32s32s32sBQQQH"
+_HDR_SZ = struct.calcsize(_HDR)
+
+
+class VoteState:
+    def __init__(self, node_pubkey: bytes, authorized_voter: bytes,
+                 authorized_withdrawer: bytes, commission: int = 0):
+        self.node_pubkey = node_pubkey
+        self.authorized_voter = authorized_voter
+        self.authorized_withdrawer = authorized_withdrawer
+        self.commission = commission
+        self.tower = Tower()
+        self.root_slot: int | None = None
+        self.credits = 0
+        self.last_ts = 0
+
+    # -- serialization ------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        out = struct.pack(
+            _HDR, self.node_pubkey, self.authorized_voter,
+            self.authorized_withdrawer, self.commission,
+            NO_ROOT if self.root_slot is None else self.root_slot,
+            self.credits, self.last_ts, len(self.tower.votes))
+        for v in self.tower.votes:
+            out += struct.pack("<QI", v.slot, v.conf)
+        return out
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "VoteState":
+        (node, voter, wd, comm, root, credits, ts, cnt) = \
+            struct.unpack_from(_HDR, b, 0)
+        st = cls(node, voter, wd, comm)
+        st.root_slot = None if root == NO_ROOT else root
+        st.credits = credits
+        st.last_ts = ts
+        off = _HDR_SZ
+        for _ in range(cnt):
+            slot, conf = struct.unpack_from("<QI", b, off)
+            st.tower.votes.append(TowerVote(slot, conf))
+            off += 12
+        st.tower.root = st.root_slot
+        return st
+
+    # -- semantics ----------------------------------------------------------
+
+    def apply_vote(self, slots: list[int], timestamp: int = 0) -> int:
+        """Push new vote slots (ascending, > last voted); returns the
+        number of newly-rooted slots (credits accrue per root —
+        ref: vote credits on root advance)."""
+        rooted = 0
+        last = self.tower.votes[-1].slot if self.tower.votes else -1
+        for s in slots:
+            if s <= last:
+                continue            # stale/duplicate slots are skipped
+            r = self.tower.vote(s)
+            if r is not None:
+                self.root_slot = r
+                self.credits += 1
+                rooted += 1
+            last = s
+        if timestamp > self.last_ts:
+            self.last_ts = timestamp
+        return rooted
+
+
+# -- instruction encoding ----------------------------------------------------
+
+def ix_initialize(node_pubkey: bytes, authorized_voter: bytes,
+                  authorized_withdrawer: bytes,
+                  commission: int = 0) -> bytes:
+    return (struct.pack("<I", VOTE_IX_INITIALIZE) + node_pubkey
+            + authorized_voter + authorized_withdrawer
+            + bytes([commission]))
+
+
+def ix_vote(slots: list[int], block_hash: bytes = bytes(32),
+            timestamp: int = 0) -> bytes:
+    out = struct.pack("<IH", VOTE_IX_VOTE, len(slots))
+    for s in slots:
+        out += struct.pack("<Q", s)
+    return out + block_hash + struct.pack("<Q", timestamp)
+
+
+def ix_withdraw(lamports: int) -> bytes:
+    return struct.pack("<IQ", VOTE_IX_WITHDRAW, lamports)
+
+
+# -- executor hook (called from programs.TxnExecutor) ------------------------
+
+def exec_vote(ctx, instr) -> str:
+    from .programs import (
+        ERR_BAD_IX_DATA, ERR_INSUFFICIENT, ERR_INVALID_OWNER,
+        ERR_MISSING_SIG, ERR_NOT_WRITABLE, OK,
+    )
+    data = ctx.payload[instr.data_off:instr.data_off + instr.data_sz]
+    if len(data) < 4:
+        return ERR_BAD_IX_DATA
+    disc = struct.unpack_from("<I", data, 0)[0]
+    ai = instr.acct_idxs
+    if not ai:
+        return ERR_BAD_IX_DATA
+    vote_idx = ai[0]
+    acct = ctx.account(vote_idx)
+
+    if disc == VOTE_IX_INITIALIZE:
+        if len(data) < 4 + 96 + 1:
+            return ERR_BAD_IX_DATA
+        if not ctx.is_writable(vote_idx):
+            return ERR_NOT_WRITABLE
+        if acct.owner != VOTE_PROGRAM_ID or acct.data.strip(b"\x00"):
+            return ERR_INVALID_OWNER      # must be fresh + vote-owned
+        # the NODE identity must sign initialization, or anyone could
+        # hijack a freshly-created vote account by installing their own
+        # authorities (ref: vote program InitializeAccount requires the
+        # node pubkey signature)
+        node = data[4:36]
+        signer_keys = {ctx.keys[i] for i in range(ctx.txn.sig_cnt)}
+        if node not in signer_keys:
+            return ERR_MISSING_SIG
+        st = VoteState(node, data[36:68], data[68:100], data[100])
+        acct.data = st.to_bytes()
+        return OK
+
+    if acct.owner != VOTE_PROGRAM_ID or len(acct.data) < _HDR_SZ:
+        return ERR_INVALID_OWNER
+    st = VoteState.from_bytes(acct.data)
+
+    if disc == VOTE_IX_VOTE:
+        if len(data) < 6:
+            return ERR_BAD_IX_DATA
+        (cnt,) = struct.unpack_from("<H", data, 4)
+        need = 6 + 8 * cnt + 32 + 8
+        if len(data) < need or cnt == 0:
+            return ERR_BAD_IX_DATA
+        slots = [struct.unpack_from("<Q", data, 6 + 8 * i)[0]
+                 for i in range(cnt)]
+        ts = struct.unpack_from("<Q", data, 6 + 8 * cnt + 32)[0]
+        # the AUTHORIZED VOTER must sign (ref: vote program authority
+        # checks), not merely the vote account
+        signer_keys = {ctx.keys[i] for i in range(ctx.txn.sig_cnt)}
+        if st.authorized_voter not in signer_keys:
+            return ERR_MISSING_SIG
+        if not ctx.is_writable(vote_idx):
+            return ERR_NOT_WRITABLE
+        st.apply_vote(slots, ts)
+        acct.data = st.to_bytes()
+        return OK
+
+    if disc == VOTE_IX_WITHDRAW:
+        if len(data) < 12 or len(ai) < 2:
+            return ERR_BAD_IX_DATA
+        lamports = struct.unpack_from("<Q", data, 4)[0]
+        signer_keys = {ctx.keys[i] for i in range(ctx.txn.sig_cnt)}
+        if st.authorized_withdrawer not in signer_keys:
+            return ERR_MISSING_SIG
+        if not ctx.is_writable(vote_idx) or not ctx.is_writable(ai[1]):
+            return ERR_NOT_WRITABLE
+        if lamports > acct.lamports:
+            return ERR_INSUFFICIENT
+        acct.lamports -= lamports
+        ctx.account(ai[1]).lamports += lamports
+        return OK
+
+    return ERR_BAD_IX_DATA

@@ -1,0 +1,134 @@
+"""Vote program tests: initialize/vote/withdraw semantics with the
+choreo tower as the on-chain state machine (ref: src/flamenco/runtime/
+program/fd_vote_program.c subset; tower rules src/choreo/tower)."""
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account, TxnExecutor
+from firedancer_tpu.svm.programs import (
+    ERR_INSUFFICIENT, ERR_INVALID_OWNER, ERR_MISSING_SIG, OK,
+)
+from firedancer_tpu.svm.vote import (
+    VOTE_PROGRAM_ID, VoteState, ix_initialize, ix_vote, ix_withdraw,
+)
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+PAYER, VOTER, NODE, VOTE_ACCT, DEST = k(1), k(2), k(3), k(4), k(5)
+
+
+def txn(signers, extra, instrs):
+    msg = build_message(signers, extra, b"\x22" * 32, instrs)
+    return build_txn([bytes(64)] * len(signers), msg)
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, PAYER, Account(lamports=10_000_000))
+    funk.rec_write(None, VOTE_ACCT,
+                   Account(lamports=5_000, owner=VOTE_PROGRAM_ID))
+    funk.txn_prepare(None, "blk")
+    return funk, db, TxnExecutor(db)
+
+
+def _init(ex):
+    # the node identity must SIGN initialization (hijack prevention)
+    t = txn([PAYER, NODE], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(3, bytes([2]), ix_initialize(NODE, VOTER, VOTER))])
+    return ex.execute("blk", t)
+
+
+def test_initialize_and_vote(env):
+    funk, db, ex = env
+    assert _init(ex).status == OK
+    st = VoteState.from_bytes(db.peek("blk", VOTE_ACCT).data)
+    assert st.node_pubkey == NODE and st.authorized_voter == VOTER
+
+    # vote for slots 1..3 signed by the authorized voter
+    t = txn([PAYER, VOTER], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(3, bytes([2]), ix_vote([1, 2, 3]))])
+    assert ex.execute("blk", t).status == OK
+    st = VoteState.from_bytes(db.peek("blk", VOTE_ACCT).data)
+    assert [v.slot for v in st.tower.votes] == [1, 2, 3]
+    assert [v.conf for v in st.tower.votes] == [3, 2, 1]
+
+    # stale slots skipped; new slot expires per tower rules
+    t2 = txn([PAYER, VOTER], [VOTE_ACCT, VOTE_PROGRAM_ID],
+             [(3, bytes([2]), ix_vote([2, 50]))])
+    assert ex.execute("blk", t2).status == OK
+    st = VoteState.from_bytes(db.peek("blk", VOTE_ACCT).data)
+    # slot 50 expired votes 3 (exp 5) and 2 (exp 6) but not 1 (exp 9)?
+    # exp(1, conf3)=9 < 50: all expired
+    assert [v.slot for v in st.tower.votes] == [50]
+
+
+def test_vote_requires_authorized_voter(env):
+    funk, db, ex = env
+    assert _init(ex).status == OK
+    t = txn([PAYER], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(2, bytes([1]), ix_vote([1]))])
+    assert ex.execute("blk", t).status == ERR_MISSING_SIG
+
+
+def test_initialize_requires_node_signature(env):
+    funk, db, ex = env
+    t = txn([PAYER], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(2, bytes([1]), ix_initialize(NODE, VOTER, VOTER))])
+    assert ex.execute("blk", t).status == ERR_MISSING_SIG
+
+
+def test_vote_rooting_accrues_credits(env):
+    funk, db, ex = env
+    assert _init(ex).status == OK
+    t = txn([PAYER, VOTER], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(3, bytes([2]), ix_vote(list(range(1, 40))))])
+    assert ex.execute("blk", t).status == OK
+    st = VoteState.from_bytes(db.peek("blk", VOTE_ACCT).data)
+    # 39 consecutive votes with 31-deep tower root slots 1..8
+    assert st.root_slot == 8 and st.credits == 8
+    assert len(st.tower.votes) == 31
+
+
+def test_withdraw_authority_and_funds(env):
+    funk, db, ex = env
+    assert _init(ex).status == OK
+    t = txn([PAYER, VOTER], [VOTE_ACCT, DEST, VOTE_PROGRAM_ID],
+            [(4, bytes([2, 3]), ix_withdraw(3_000))])
+    assert ex.execute("blk", t).status == OK
+    assert db.lamports("blk", VOTE_ACCT) == 2_000
+    assert db.lamports("blk", DEST) == 3_000
+    # overdraw refused
+    t2 = txn([PAYER, VOTER], [VOTE_ACCT, DEST, VOTE_PROGRAM_ID],
+             [(4, bytes([2, 3]), ix_withdraw(10_000))])
+    assert ex.execute("blk", t2).status == ERR_INSUFFICIENT
+    # wrong authority refused
+    t3 = txn([PAYER], [VOTE_ACCT, DEST, VOTE_PROGRAM_ID],
+             [(3, bytes([1, 2]), ix_withdraw(1))])
+    assert ex.execute("blk", t3).status == ERR_MISSING_SIG
+
+
+def test_vote_on_non_vote_account_refused(env):
+    funk, db, ex = env
+    t = txn([PAYER, VOTER], [PAYER, VOTE_PROGRAM_ID],
+            [(3, bytes([0]), ix_vote([1]))])
+    # wait: account 0 = PAYER (system-owned)
+    assert ex.execute("blk", t).status == ERR_INVALID_OWNER
+
+
+def test_state_roundtrip():
+    st = VoteState(NODE, VOTER, VOTER, commission=5)
+    st.apply_vote([1, 2, 3, 9])
+    st.credits = 7
+    st.root_slot = 1
+    b = st.to_bytes()
+    rt = VoteState.from_bytes(b)
+    assert rt.to_bytes() == b
+    assert [v.slot for v in rt.tower.votes] == \
+        [v.slot for v in st.tower.votes]
+    assert rt.root_slot == 1 and rt.credits == 7 and rt.commission == 5
