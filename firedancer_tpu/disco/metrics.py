@@ -123,11 +123,13 @@ def render_prometheus(plan: dict, wksp) -> str:
         age = max(0, now - cnc.last_heartbeat)
         lines.append(f"fdtpu_heartbeat_age_ticks{{{lab}}} {age}")
         vals = topo_mod.read_metrics(wksp, plan, tn)
+        gauges = set(spec.get("metrics_gauges", []))
         for i, nm in enumerate(spec.get("metrics_names", [])):
             if i >= len(vals):
                 break
-            # config-ish slots (bound ports) are gauges, not counters
-            series = "fdtpu_tile_gauge" if nm.endswith("port") \
+            # adapters DECLARE their gauge slots (class GAUGES); the
+            # renderer never infers types from names
+            series = "fdtpu_tile_gauge" if nm in gauges \
                 else "fdtpu_tile_metric"
             lines.append(
                 f'{series}{{{lab},name="{_esc(nm)}"}} {int(vals[i])}')

@@ -335,6 +335,7 @@ class BankAdapter:
 
     METRICS = ["microblocks", "txns", "transfers", "exec_skip",
                "exec_fail", "overruns", "rpc_port"]
+    GAUGES = ["rpc_port"]
 
     def __init__(self, ctx, args):
         self.ctx = ctx
@@ -487,6 +488,7 @@ class SockAdapter:
     bind_addr, batch, mtu."""
 
     METRICS = ["rx", "bytes", "oversz", "backpressure", "port"]
+    GAUGES = ["port"]
 
     def __init__(self, ctx, args):
         from ..tiles.sock import SockTile
@@ -676,6 +678,7 @@ class MetricAdapter:
     metric), bind_addr."""
 
     METRICS = ["port", "scrapes"]
+    GAUGES = ["port"]
 
     def __init__(self, ctx, args):
         import threading

@@ -36,6 +36,14 @@ def _metric_names(kind: str) -> list[str]:
     return list(getattr(REGISTRY.get(kind, object), "METRICS", []))
 
 
+def _metric_gauges(kind: str) -> list[str]:
+    """Slot names the adapter declares as GAUGES (point-in-time values
+    like bound ports) rather than counters — explicit declaration, not
+    name heuristics, decides the prometheus series type."""
+    from .tiles import REGISTRY
+    return list(getattr(REGISTRY.get(kind, object), "GAUGES", []))
+
+
 @dataclass
 class LinkSpec:
     name: str
@@ -158,6 +166,7 @@ class Topology:
                     # explicit slot-name ABI: readers match by these names,
                     # never by adapter class declaration order (r2 W7)
                     "metrics_names": _metric_names(t.kind),
+                    "metrics_gauges": _metric_gauges(t.kind),
                 }
         except Exception:
             w.close()

@@ -25,7 +25,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..svm.accdb import Account
-from ..utils.base58 import b58_decode_32
+from ..utils.base58 import b58_decode_32, b58_encode_32
 
 
 class RpcServer:
@@ -112,7 +112,7 @@ class RpcServer:
             v = Account(lamports=int(v))
         return {
             "lamports": v.lamports,
-            "owner": v.owner.hex(),
+            "owner": b58_encode_32(v.owner),
             "executable": v.executable,
             "rentEpoch": v.rent_epoch,
             "data": [base64.b64encode(v.data).decode(), "base64"],

@@ -12,6 +12,7 @@ from .format import (DataShred, CodeShred, parse_shred, SHRED_MAX_SZ,
                      SHRED_MIN_SZ)
 from .merkle import MerkleTree20, shred_merkle_leaf
 from .shred_dest import ClusterNode, ShredDest
+from .store import FecStore, Reassembler, Slice
 from .shredder import Shredder, FecSet, count_fec_sets, count_data_shreds, \
     count_parity_shreds
 
@@ -19,4 +20,5 @@ __all__ = ["DataShred", "CodeShred", "parse_shred", "SHRED_MAX_SZ",
            "SHRED_MIN_SZ", "MerkleTree20", "shred_merkle_leaf",
            "Shredder", "FecSet", "count_fec_sets", "count_data_shreds",
            "count_parity_shreds", "FecResolver", "CompletedFec",
-           "ClusterNode", "ShredDest"]
+           "ClusterNode", "ShredDest", "FecStore", "Reassembler",
+           "Slice"]
