@@ -42,6 +42,11 @@ def tile_main(plan: dict, tile_name: str):
     elif os.environ.get("FDTPU_JAX_PLATFORM"):
         os.environ.setdefault("JAX_PLATFORMS",
                               os.environ["FDTPU_JAX_PLATFORM"])
+    # per-tile thread-tagged logging (ref: fd_topo_run.c
+    # initialize_logging before tile init)
+    from ..utils import log
+    log.init(f"{plan['topology']}:{tile_name}")
+    log.info("tile booting")
     ctx = TileCtx(plan, tile_name)
     try:
         kind = plan["tiles"][tile_name]["kind"]

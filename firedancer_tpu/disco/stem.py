@@ -101,9 +101,11 @@ class Stem:
                 iters += 1
                 if max_iters is not None and iters >= max_iters:
                     break
-        except Exception:
+        except Exception as e:
             cnc.state = CNC_FAIL
             self._flush_metrics()
+            from ..utils import log
+            log.err(f"tile failed: {e!r}")
             raise
         # drain-side bookkeeping before exit
         self._update_in_fseqs()

@@ -637,7 +637,8 @@ class SignAdapter:
     resp: out link} — the role is bound to the ring pair at topology
     build, so policy is attached to the wire."""
 
-    METRICS = ["signed", "refused", "overruns", "backpressure"]
+    METRICS = ["signed", "refused", "overruns", "backpressure",
+               "keyswitches"]
 
     def __init__(self, ctx, args):
         from ..keyguard import SignTile
@@ -654,13 +655,76 @@ class SignAdapter:
             })
         self._links = [c["req"] for c in args["clients"]]
         self.tile = SignTile(bytes.fromhex(args["seed"]), clients)
+        self._ks_off = ctx.spec.get("keyswitch_off")
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def housekeeping(self):
+        # live identity hot-swap (ref: fd_keyswitch + set_identity)
+        if self._ks_off is None:
+            return
+        from ..keyguard import keyswitch as ks
+        seed = ks.poll_switch(self.ctx.wksp, self._ks_off)
+        if seed is not None:
+            self.tile.rekey(seed)
+            # compare-and-ack: if a newer request raced in, leave it
+            # pending — the next housekeeping applies it too
+            ks.ack_switch(self.ctx.wksp, self._ks_off, seed)
+
+    def in_seqs(self):
+        return {ln: s for ln, s in
+                zip(self._links, self.tile.seqs)}
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
+@register("snapld")
+class SnapLdAdapter:
+    """Snapshot loader tile (ref: src/discof/restore/fd_snapct_tile.c
+    download/read orchestration, simplified to local file streaming).
+    args: path, chunk."""
+
+    METRICS = ["bytes", "frags", "done"]
+    GAUGES = ["done"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.snapshot import SnapLoader
+        self.tile = SnapLoader(
+            args["path"],
+            _single(ctx.out_rings, "out link", ctx.tile_name),
+            _single(ctx.out_fseqs, "out link", ctx.tile_name),
+            chunk=int(args.get("chunk", 1024)))
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
+@register("snapin")
+class SnapInAdapter:
+    """Snapshot inserter tile (ref: src/discof/restore/fd_snapin_tile.c
+    — stream -> account DB; decompress+integrity ride the checkpoint
+    frame reader, standing in for the snapdc stage)."""
+
+    METRICS = ["frags", "bytes", "accounts", "restored", "fingerprint",
+               "stream_err"]
+    GAUGES = ["accounts", "fingerprint"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.snapshot import SnapInserter
+        self.ctx = ctx
+        self.in_link = next(iter(ctx.in_rings))
+        self.tile = SnapInserter(ctx.in_rings[self.in_link])
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
 
     def in_seqs(self):
-        return {ln: s for ln, s in
-                zip(self._links, self.tile.seqs)}
+        return {self.in_link: self.tile.seq}
 
     def metrics_items(self):
         return dict(self.tile.metrics)

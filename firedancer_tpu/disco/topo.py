@@ -168,6 +168,12 @@ class Topology:
                     "metrics_names": _metric_names(t.kind),
                     "metrics_gauges": _metric_gauges(t.kind),
                 }
+                if t.kind == "sign":
+                    # live identity hot-swap region (fd_keyswitch)
+                    from ..keyguard.keyswitch import FOOTPRINT as KS_FP
+                    ks_off = w.alloc(KS_FP)
+                    w.view(ks_off, KS_FP)[:] = 0
+                    plan["tiles"][tn]["keyswitch_off"] = ks_off
         except Exception:
             w.close()
             w.unlink()

@@ -1,0 +1,66 @@
+"""Keyswitch: live identity hot-swap through shared memory
+(ref: src/disco/keyguard/fd_keyswitch.h + the set_identity action,
+src/app/shared/commands/set_identity.c).
+
+A 64-byte shm region per sign tile: [state u64 | seed 32B | pad].
+The operator writes the new seed then flips state to SWITCH_PENDING;
+the sign tile observes it at housekeeping, swaps its key material, and
+acknowledges with COMPLETED — no restart, no dropped signing requests
+(requests in flight sign with whichever key was live when polled)."""
+from __future__ import annotations
+
+import numpy as np
+
+STATE_UNLOCKED = 0
+STATE_PENDING = 1
+STATE_COMPLETED = 2
+
+FOOTPRINT = 64
+
+
+def _view(wksp, off):
+    return wksp.view(off, FOOTPRINT)
+
+
+def read_state(wksp, off) -> int:
+    return int(_view(wksp, off)[:8].view(np.uint64)[0])
+
+
+def request_switch(wksp, off, seed: bytes):
+    """Operator side: stage the new 32-byte seed, then flip PENDING
+    (seed bytes land before the state word — the tile reads state
+    first, so ordering holds for same-host shm)."""
+    assert len(seed) == 32
+    v = _view(wksp, off)
+    v[8:40] = np.frombuffer(seed, np.uint8)
+    v[:8].view(np.uint64)[0] = STATE_PENDING
+
+
+def poll_switch(wksp, off) -> bytes | None:
+    """Tile side: new seed if a switch is pending."""
+    v = _view(wksp, off)
+    if int(v[:8].view(np.uint64)[0]) != STATE_PENDING:
+        return None
+    return bytes(v[8:40])
+
+
+def ack_switch(wksp, off, applied_seed: bytes) -> bool:
+    """Tile side: complete the switch ONLY if the region still stages
+    the seed we applied — a second request racing the swap must not be
+    scrubbed and falsely reported COMPLETED (compare-and-ack)."""
+    v = _view(wksp, off)
+    if bytes(v[8:40]) != applied_seed:
+        return False                 # a newer request landed: leave it
+    v[8:40] = 0                      # scrub the staged seed
+    v[:8].view(np.uint64)[0] = STATE_COMPLETED
+    return True
+
+
+def wait_completed(wksp, off, timeout_s: float = 30.0) -> bool:
+    import time
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if read_state(wksp, off) == STATE_COMPLETED:
+            return True
+        time.sleep(0.01)
+    return False

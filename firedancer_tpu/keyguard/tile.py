@@ -34,7 +34,14 @@ class SignTile:
         self.clients = clients
         self.seqs = [0] * len(clients)
         self.metrics = {"signed": 0, "refused": 0, "overruns": 0,
-                        "backpressure": 0}
+                        "backpressure": 0, "keyswitches": 0}
+
+    def rekey(self, seed: bytes):
+        """Hot-swap the identity (fd_keyswitch): requests polled after
+        this sign with the new key."""
+        self.seed = seed
+        _, _, self.pubkey = keypair(seed)
+        self.metrics["keyswitches"] += 1
 
     def _sign(self, sign_type: int, payload: bytes) -> bytes:
         if sign_type == SIGN_TYPE_SHA256_ED25519:

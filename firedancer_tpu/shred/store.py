@@ -72,6 +72,7 @@ class Reassembler:
         # FEC set (turbine retransmit / repair race) must not rebuild
         # empty state and re-emit the same slice to replay
         self._done: set[int] = set()
+        self._root = 0               # slots below never re-emit
         self.metrics = {"fecs": 0, "slices": 0, "done_slots": 0,
                         "late_dup": 0}
 
@@ -91,7 +92,9 @@ class Reassembler:
         """fec: shred.fec_resolver.CompletedFec. Returns newly completed
         slices (possibly several when a gap fills)."""
         self.metrics["fecs"] += 1
-        if fec.slot in self._done:
+        if fec.slot in self._done or fec.slot < self._root:
+            # tombstoned, or below the published root: either way this
+            # slot's slices are history and must never re-emit
             self.metrics["late_dup"] += 1
             return []
         st = self._st(fec.slot)
@@ -119,6 +122,10 @@ class Reassembler:
         return out
 
     def publish(self, root_slot: int):
+        """Prune state below the root. Tombstones below the root can be
+        dropped because the root itself now guards re-emission (the
+        `slot < _root` reject in add_fec)."""
+        self._root = max(self._root, root_slot)
         self._slots = {s: st for s, st in self._slots.items()
                        if s >= root_slot}
         self._done = {s for s in self._done if s >= root_slot}

@@ -1,0 +1,145 @@
+"""Snapshot restore pipeline tiles: loader -> inserter over rings.
+
+The reference's cold-start pipeline streams a snapshot through
+dedicated tiles (snapct/snapld -> snapdc -> snapin, ref: src/discof/
+restore/fd_snapct_tile.c, fd_snapin_tile.c:14-17), the account stream
+riding frag links. Here:
+
+  snapld  reads a checkpoint file and publishes it as a MULTI-FRAG
+          message: SOM on the first frag, EOM on the last (the tango
+          ctl bits, ref: src/tango/fd_tango_base.h ctl SOM/EOM) — the
+          first multi-frag producer in the framework.
+  snapin  reassembles the stream (SOM/EOM validated), then restores a
+          funk from the checkpoint frames (utils/checkpt.py — zlib
+          frames + sha256 integrity trailer stand in for the
+          reference's zstd stage, so no separate snapdc tile), and
+          publishes a state fingerprint through its metrics for
+          end-to-end verification.
+
+Decompression and integrity checks happen INSIDE the checkpoint frame
+reader, so a corrupt stream fails loudly (tile FAIL) rather than
+installing bad state.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+
+CTL_SOM = 1
+CTL_EOM = 2
+
+
+def state_fingerprint(funk) -> int:
+    """u64 fingerprint of the published root: sha256 over the
+    DETERMINISTIC uncompressed checkpoint serialization."""
+    from ..utils.checkpt import funk_checkpt
+    buf = io.BytesIO()
+    funk_checkpt(funk, buf, compress=False)
+    return int.from_bytes(
+        hashlib.sha256(buf.getvalue()).digest()[:8], "little")
+
+
+class SnapLoader:
+    """snapld core: stream one file as a multi-frag message.
+
+    Streaming read (never slurps — snapshots are multi-GB in
+    production), and backpressure RETURNS to the stem instead of
+    spinning so the tile keeps heartbeating and remains haltable."""
+
+    def __init__(self, path: str, out_ring, out_fseqs, chunk: int = 1024):
+        self.fp = open(path, "rb")
+        self.size = __import__("os").fstat(self.fp.fileno()).st_size
+        self.out = out_ring
+        self.fseqs = out_fseqs or []
+        self.chunk = min(chunk, out_ring.mtu)
+        self.off = 0
+        self._pending: bytes | None = None
+        self.metrics = {"bytes": 0, "frags": 0, "done": 0,
+                        "backpressure": 0}
+
+    def poll_once(self) -> int:
+        if self.off >= self.size and self._pending is None:
+            return 0
+        n = 0
+        while n < 16:
+            if self._pending is None:
+                data = self.fp.read(self.chunk)
+                if not data:
+                    break
+                self._pending = data
+            if self.fseqs and self.out.credits(self.fseqs) <= 0:
+                # yield to the stem: heartbeat/halt stay responsive
+                self.metrics["backpressure"] += 1
+                return n
+            data = self._pending
+            end = self.off + len(data)
+            ctl = (CTL_SOM if self.off == 0 else 0) | \
+                  (CTL_EOM if end == self.size else 0)
+            self.out.publish(data, sig=self.metrics["frags"], ctl=ctl)
+            self._pending = None
+            self.metrics["frags"] += 1
+            self.metrics["bytes"] += len(data)
+            self.off = end
+            n += 1
+        if self.off >= self.size:
+            self.metrics["done"] = 1
+            self.fp.close()
+        return n
+
+
+class SnapInserter:
+    """snapin core: multi-frag reassembly -> funk restore."""
+
+    def __init__(self, in_ring, funk_cls=None):
+        from ..funk.funk import Funk
+        self.ring = in_ring
+        self.funk_cls = funk_cls or Funk
+        self.funk = None
+        self.seq = 0
+        self._buf = bytearray()
+        self._in_msg = False
+        self.metrics = {"frags": 0, "bytes": 0, "accounts": 0,
+                        "restored": 0, "fingerprint": 0, "stream_err": 0}
+
+    def poll_once(self) -> int:
+        got = 0
+        while True:
+            rc, frag = self.ring.consume(self.seq)
+            if rc == 1:
+                return got
+            if rc == -1:
+                # overrun mid-snapshot is fatal for the stream: restart
+                self._buf.clear()
+                self._in_msg = False
+                self.metrics["stream_err"] += 1
+                self.seq += 1
+                got += 1
+                continue
+            payload = bytes(self.ring.payload(frag))
+            # re-validate the slot after copying (speculative read)
+            rc2, check = self.ring.consume(self.seq)
+            if rc2 != 0 or check.seq != frag.seq:
+                continue
+            self.seq += 1
+            got += 1
+            self.metrics["frags"] += 1
+            self.metrics["bytes"] += len(payload)
+            if frag.ctl & CTL_SOM:
+                self._buf.clear()
+                self._in_msg = True
+            if not self._in_msg:
+                self.metrics["stream_err"] += 1
+                continue
+            self._buf += payload
+            if frag.ctl & CTL_EOM:
+                self._restore()
+                self._in_msg = False
+
+    def _restore(self):
+        from ..utils.checkpt import funk_restore
+        self.funk = funk_restore(self.funk_cls,
+                                 io.BytesIO(bytes(self._buf)))
+        self._buf.clear()
+        self.metrics["accounts"] = len(self.funk.root_items())
+        self.metrics["fingerprint"] = state_fingerprint(self.funk)
+        self.metrics["restored"] += 1

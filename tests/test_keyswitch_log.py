@@ -1,0 +1,81 @@
+"""Keyswitch (live identity hot-swap) + logging subsystem tests
+(ref: src/disco/keyguard/fd_keyswitch.h, set_identity command;
+src/util/log/fd_log.h dual-sink discipline)."""
+import os
+
+from firedancer_tpu.disco import Topology, TopologyRunner
+from firedancer_tpu.keyguard import KeyguardClient, keyswitch as ks
+from firedancer_tpu.runtime import Ring
+from firedancer_tpu.utils.ed25519_ref import keypair, verify
+
+SEED_A = bytes(range(32))
+SEED_B = bytes(range(32, 64))
+
+
+def test_keyswitch_hot_swap_in_topology():
+    """Sign tile switches identity live: signatures before the switch
+    verify under key A, after under key B, with no restart."""
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    topo = (
+        Topology(f"ks{os.getpid()}", wksp_size=1 << 22)
+        .link("req", depth=16, mtu=1280)
+        .link("rsp", depth=16, mtu=128)
+        # declared producer for the req link; not started — the test
+        # process drives the ring directly as the client
+        .tile("driver", "synth", outs=["req"], count=0)
+        .tile("sign", "sign", ins=[("req", False)], outs=["rsp"],
+              seed=SEED_A.hex(),
+              clients=[{"role": "leader", "req": "req", "resp": "rsp"}])
+        .tile("sink", "sink", ins=[("rsp", False)])
+    )
+    plan = topo.build()
+    runner = TopologyRunner(plan).start(tiles=["sign"])
+    try:
+        runner.wait_running(timeout_s=120)
+        li = plan["links"]
+        req = Ring(runner.wksp, li["req"]["ring_off"], li["req"]["depth"],
+                   li["req"]["arena_off"], li["req"]["mtu"])
+        rsp = Ring(runner.wksp, li["rsp"]["ring_off"], li["rsp"]["depth"],
+                   li["rsp"]["arena_off"], li["rsp"]["mtu"])
+        client = KeyguardClient(req, rsp)
+        _, _, pk_a = keypair(SEED_A)
+        _, _, pk_b = keypair(SEED_B)
+
+        root = os.urandom(32)
+        sig = client.sign(root)
+        assert sig and verify(sig, pk_a, root)
+
+        ks_off = plan["tiles"]["sign"]["keyswitch_off"]
+        ks.request_switch(runner.wksp, ks_off, SEED_B)
+        assert ks.wait_completed(runner.wksp, ks_off, timeout_s=30)
+
+        root2 = os.urandom(32)
+        sig2 = client.sign(root2)
+        assert sig2 and verify(sig2, pk_b, root2)
+        assert not verify(sig2, pk_a, root2)
+        assert runner.metrics("sign")["keyswitches"] == 1
+        # the staged seed is scrubbed after the swap
+        assert ks.read_state(runner.wksp, ks_off) == ks.STATE_COMPLETED
+        assert bytes(runner.wksp.view(ks_off + 8, 32)) == bytes(32)
+    finally:
+        runner.halt()
+        runner.close()
+
+
+def test_log_dual_sink(tmp_path, capsys):
+    from firedancer_tpu.utils import log
+    path = tmp_path / "tile.log"
+    log.init("test:tile", path=str(path), stderr_level=log.WARNING)
+    log.debug("debug line")
+    log.notice("notice line")
+    log.err("error line")
+    out = capsys.readouterr().err
+    # stderr: only >= WARNING
+    assert "error line" in out and "notice line" not in out
+    # permanent sink: everything, thread-tagged
+    body = path.read_text()
+    for frag in ("debug line", "notice line", "error line",
+                 "test:tile", str(os.getpid())):
+        assert frag in body
+    assert "DEBUG" in body and "ERR" in body
+    log.init("test:tile")            # detach the file sink
