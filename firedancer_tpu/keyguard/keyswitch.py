@@ -9,6 +9,8 @@ acknowledges with COMPLETED — no restart, no dropped signing requests
 (requests in flight sign with whichever key was live when polled)."""
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 
 STATE_UNLOCKED = 0
@@ -16,6 +18,10 @@ STATE_PENDING = 1
 STATE_COMPLETED = 2
 
 FOOTPRINT = 64
+
+
+def _checksum(seed: bytes) -> bytes:
+    return hashlib.sha256(b"fdtpu-keyswitch" + seed).digest()[:8]
 
 
 def _view(wksp, off):
@@ -27,21 +33,28 @@ def read_state(wksp, off) -> int:
 
 
 def request_switch(wksp, off, seed: bytes):
-    """Operator side: stage the new 32-byte seed, then flip PENDING
-    (seed bytes land before the state word — the tile reads state
-    first, so ordering holds for same-host shm)."""
+    """Operator side: stage the new 32-byte seed + its checksum, then
+    flip PENDING. The checksum makes a torn read (a second request
+    racing the tile's poll) DETECTABLE: the tile skips a seed whose
+    checksum doesn't match and retries next housekeeping, so it can
+    never rekey onto part-B/part-C garbage bytes."""
     assert len(seed) == 32
     v = _view(wksp, off)
+    v[:8].view(np.uint64)[0] = STATE_UNLOCKED     # close the window
     v[8:40] = np.frombuffer(seed, np.uint8)
+    v[40:48] = np.frombuffer(_checksum(seed), np.uint8)
     v[:8].view(np.uint64)[0] = STATE_PENDING
 
 
 def poll_switch(wksp, off) -> bytes | None:
-    """Tile side: new seed if a switch is pending."""
+    """Tile side: new seed if a switch is pending AND intact."""
     v = _view(wksp, off)
     if int(v[:8].view(np.uint64)[0]) != STATE_PENDING:
         return None
-    return bytes(v[8:40])
+    seed = bytes(v[8:40])
+    if bytes(v[40:48]) != _checksum(seed):
+        return None                  # torn write in progress: retry
+    return seed
 
 
 def ack_switch(wksp, off, applied_seed: bytes) -> bool:
@@ -52,6 +65,7 @@ def ack_switch(wksp, off, applied_seed: bytes) -> bool:
     if bytes(v[8:40]) != applied_seed:
         return False                 # a newer request landed: leave it
     v[8:40] = 0                      # scrub the staged seed
+    v[40:48] = 0
     v[:8].view(np.uint64)[0] = STATE_COMPLETED
     return True
 

@@ -34,6 +34,7 @@ from ..protocol.txn import ParsedTxn, parse_txn
 from .accdb import AccDb, Account, SYSTEM_PROGRAM_ID
 
 COMPUTE_BUDGET_PROGRAM_ID = b"ComputeBudget" + bytes(19)
+BPF_LOADER_ID = b"BPFLoader" + bytes(23)
 MAX_PERMITTED_DATA_LENGTH = 10 * 1024 * 1024
 
 # system instruction discriminants (u32 LE bincode)
@@ -56,6 +57,8 @@ ERR_SPACE = "invalid_space"
 ERR_UNKNOWN_IX = "unknown_instruction"
 ERR_UNKNOWN_PROGRAM = "unknown_program"
 ERR_BAD_IX_DATA = "bad_instruction_data"
+ERR_VM = "program_failed"
+ERR_BALANCE_VIOLATION = "sum_of_lamports_changed"
 
 
 @dataclass
@@ -187,6 +190,60 @@ def _exec_system(ctx: TxnContext, instr) -> str:
     return ERR_UNKNOWN_IX
 
 
+def _exec_bpf(ctx: TxnContext, instr, program: Account) -> str:
+    """Run a deployed sBPF program (executable account owned by the
+    loader) in the VM (ref: fd_executor -> fd_vm_exec; serialization
+    per the input-region discipline of src/flamenco/vm/fd_vm.h input
+    regions, compact layout documented in vm/interp.py).
+
+    Input layout: u16 n_accounts | n × (pubkey 32 | lamports u64 |
+    is_signer u8 | is_writable u8) | u16 data_len | instruction data.
+    After a successful run, lamports of WRITABLE accounts are read back
+    under the conservation rule: the instruction may move lamports
+    between its accounts but never mint or burn them (the runtime's
+    sum-of-lamports invariant)."""
+    import struct as _s
+
+    from ..vm import DEFAULT_SYSCALLS, ERR_NONE as VM_OK, Vm
+    accts = [ctx.account(i) for i in instr.acct_idxs]
+    data = ctx.payload[instr.data_off:instr.data_off + instr.data_sz]
+    blob = _s.pack("<H", len(accts))
+    for ix, a in zip(instr.acct_idxs, accts):
+        blob += (ctx.keys[ix] + _s.pack("<Q", a.lamports)
+                 + bytes([1 if ctx.is_signer(ix) else 0,
+                          1 if ctx.is_writable(ix) else 0]))
+    blob += _s.pack("<H", len(data)) + data
+    vm = Vm(program.data, input_data=blob, syscalls=DEFAULT_SYSCALLS)
+    res = vm.run()
+    ctx.logs.extend(res.log)
+    if res.error != VM_OK or res.r0 != 0:
+        return ERR_VM
+    # lamports write-back with conservation over UNIQUE accounts: an
+    # instruction may list the same account at several indices (the
+    # runtime maps them to ONE account), so both the before-sum and the
+    # applied value dedup by key with last-slot-wins — otherwise a
+    # duplicated index could double-count `before` and mint the
+    # difference
+    off = 2
+    final: dict[bytes, tuple[int, int]] = {}     # key -> (idx, lamports)
+    for ix in instr.acct_idxs:
+        lam = int.from_bytes(vm.mem_read(
+            0x4_0000_0000 + off + 32, 8), "little")
+        final[ctx.keys[ix]] = (ix, lam)
+        off += 42
+    uniq = {ctx.keys[ix]: ctx.account(ix) for ix in instr.acct_idxs}
+    before = sum(a.lamports for a in uniq.values())
+    if sum(lam for _, lam in final.values()) != before:
+        return ERR_BALANCE_VIOLATION
+    for key, (ix, lam) in final.items():
+        a = uniq[key]
+        if lam != a.lamports:
+            if not ctx.is_writable(ix):
+                return ERR_NOT_WRITABLE
+            a.lamports = lam
+    return OK
+
+
 class TxnExecutor:
     """fd_runtime_prepare_and_execute_txn analog for the host path."""
 
@@ -222,7 +279,12 @@ class TxnExecutor:
             elif prog == COMPUTE_BUDGET_PROGRAM_ID:
                 st = OK                  # limits handled by pack/cost
             else:
-                st = ERR_UNKNOWN_PROGRAM
+                pa = self.db.peek(xid, prog)
+                if pa is not None and pa.executable \
+                        and pa.owner == BPF_LOADER_ID:
+                    st = _exec_bpf(ctx, instr, pa)
+                else:
+                    st = ERR_UNKNOWN_PROGRAM
             if st != OK:
                 # atomic rollback: drop the working set (fee stays)
                 return TxnResult(st, fee, ctx.logs)

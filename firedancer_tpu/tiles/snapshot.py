@@ -58,6 +58,16 @@ class SnapLoader:
                         "backpressure": 0}
 
     def poll_once(self) -> int:
+        if self.size == 0:
+            # empty file: still a complete (SOM|EOM) message — snapin's
+            # frame reader then fails LOUDLY on the missing magic
+            # rather than both tiles hanging silently
+            if not self.metrics["done"]:
+                self.out.publish(b"", sig=0, ctl=CTL_SOM | CTL_EOM)
+                self.metrics["frags"] += 1
+                self.metrics["done"] = 1
+                return 1
+            return 0
         if self.off >= self.size and self._pending is None:
             return 0
         n = 0
@@ -65,6 +75,13 @@ class SnapLoader:
             if self._pending is None:
                 data = self.fp.read(self.chunk)
                 if not data:
+                    if self.off < self.size:
+                        # file shrank after open: fail the tile loudly
+                        # (stem flips cnc to FAIL) instead of leaving
+                        # snapin waiting on an EOM that never comes
+                        raise RuntimeError(
+                            f"snapshot truncated: read {self.off} of "
+                            f"{self.size} bytes")
                     break
                 self._pending = data
             if self.fseqs and self.out.credits(self.fseqs) <= 0:

@@ -1,0 +1,79 @@
+"""VM syscalls (ref: src/flamenco/vm/syscall/ — log, memops, hashing;
+dispatch ids are murmur3-32 of the symbol name in the reference's
+loader; the ids here are the same registry concept with the hash
+computed by `syscall_id`)."""
+from __future__ import annotations
+
+import hashlib
+
+from .interp import ERR_ABORT, MASK64, VmFault
+
+CU_SYSCALL_BASE = 100
+CU_MEM_PER_BYTE = 1        # charged per 250 bytes in the reference
+CU_SHA256_BASE = 85
+
+
+def syscall_id(name: bytes) -> int:
+    """Stable 32-bit id for a syscall symbol (sha256-derived; the
+    reference uses murmur3_32 — same role, different hash, documented)."""
+    return int.from_bytes(hashlib.sha256(name).digest()[:4], "little")
+
+
+def sys_abort(vm, r1, r2, r3, r4, r5):
+    raise VmFault(ERR_ABORT, "abort() called")
+
+
+def sys_log(vm, r1, r2, r3, r4, r5):
+    msg = vm.mem_read(r1, min(r2, 10_000))
+    vm.log.append(msg.decode("utf-8", "replace"))
+    return 0
+
+
+def sys_log_64(vm, r1, r2, r3, r4, r5):
+    vm.log.append(" ".join(f"{x & MASK64:#x}" for x in
+                           (r1, r2, r3, r4, r5)))
+    return 0
+
+
+def sys_memcpy(vm, r1, r2, r3, r4, r5):
+    vm.mem_write(r1, vm.mem_read(r2, r3))
+    return 0
+
+
+def sys_memset(vm, r1, r2, r3, r4, r5):
+    vm.mem_write(r1, bytes([r2 & 0xFF]) * r3)
+    return 0
+
+
+def sys_memcmp(vm, r1, r2, r3, r4, r5):
+    a = vm.mem_read(r1, r3)
+    b = vm.mem_read(r2, r3)
+    res = 0
+    for x, y in zip(a, b):
+        if x != y:
+            res = (x - y) & MASK64
+            break
+    vm.write_u(r4, 4, res & 0xFFFFFFFF)
+    return 0
+
+
+def sys_sha256(vm, r1, r2, r3, r4, r5):
+    """r1: vec of (vaddr u64, len u64) slices, r2: count, r3: out."""
+    h = hashlib.sha256()
+    for i in range(r2):
+        va = vm.read_u(r1 + 16 * i, 8)
+        ln = vm.read_u(r1 + 16 * i + 8, 8)
+        h.update(vm.mem_read(va, ln))
+    vm.mem_write(r3, h.digest())
+    return 0
+
+
+DEFAULT_SYSCALLS = {
+    syscall_id(b"abort"): sys_abort,
+    syscall_id(b"sol_log_"): sys_log,
+    syscall_id(b"sol_log_64_"): sys_log_64,
+    syscall_id(b"sol_memcpy_"): sys_memcpy,
+    syscall_id(b"sol_memset_"): sys_memset,
+    syscall_id(b"sol_memcmp_"): sys_memcmp,
+    syscall_id(b"sol_sha256"): sys_sha256,
+}

@@ -1,0 +1,174 @@
+"""Deployed sBPF programs executing inside transactions: the full
+loader->VM->runtime path with the lamports-conservation invariant
+(ref: fd_executor -> fd_vm_exec; sum-of-lamports rule of the runtime)."""
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account, TxnExecutor
+from firedancer_tpu.svm.programs import (
+    ERR_BALANCE_VIOLATION, ERR_VM, OK, BPF_LOADER_ID,
+)
+from firedancer_tpu.vm import asm
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+PAYER, A1, A2, PROG = k(1), k(2), k(3), k(9)
+
+# account record stride in the input blob: 32 pubkey + 8 lamports +
+# signer + writable
+STRIDE = 42
+
+
+def mover_prog(amount):
+    """Moves `amount` lamports from instruction account 0 to 1."""
+    base = 2                 # after u16 n_accounts
+    lam0 = base + 32
+    lam1 = base + STRIDE + 32
+    return asm(f"""
+        mov64 r6, r1
+        ldxdw r2, [r6+{lam0}]
+        ldxdw r3, [r6+{lam1}]
+        sub64 r2, {amount}
+        add64 r3, {amount}
+        stxdw [r6+{lam0}], r2
+        stxdw [r6+{lam1}], r3
+        mov64 r0, 0
+        exit
+    """)
+
+
+def minter_prog(amount):
+    base = 2
+    lam0 = base + 32
+    return asm(f"""
+        mov64 r6, r1
+        ldxdw r2, [r6+{lam0}]
+        add64 r2, {amount}
+        stxdw [r6+{lam0}], r2
+        mov64 r0, 0
+        exit
+    """)
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, PAYER, Account(lamports=1_000_000))
+    funk.rec_write(None, A1, Account(lamports=500))
+    funk.rec_write(None, A2, Account(lamports=50))
+    funk.txn_prepare(None, "blk")
+    return funk, db, TxnExecutor(db)
+
+
+def deploy(funk, code):
+    funk.rec_write("blk", PROG, Account(
+        lamports=1, data=code, owner=BPF_LOADER_ID, executable=True))
+
+
+def txn(instrs):
+    msg = build_message([PAYER], [A1, A2, PROG], b"\x33" * 32, instrs)
+    return build_txn([bytes(64)], msg)
+
+
+def test_bpf_program_moves_lamports(env):
+    funk, db, ex = env
+    deploy(funk, mover_prog(100))
+    r = ex.execute("blk", txn([(3, bytes([1, 2]), b"")]))
+    assert r.status == OK, r
+    assert db.lamports("blk", A1) == 400
+    assert db.lamports("blk", A2) == 150
+
+
+def test_bpf_program_cannot_mint(env):
+    """The conservation invariant: a program inflating its accounts'
+    total lamports fails the transaction."""
+    funk, db, ex = env
+    deploy(funk, minter_prog(777))
+    r = ex.execute("blk", txn([(3, bytes([1, 2]), b"")]))
+    assert r.status == ERR_BALANCE_VIOLATION
+    assert db.lamports("blk", A1) == 500          # rolled back
+
+
+def test_bpf_nonzero_return_fails_txn(env):
+    funk, db, ex = env
+    deploy(funk, asm("mov64 r0, 1; exit"))
+    r = ex.execute("blk", txn([(3, bytes([1, 2]), b"")]))
+    assert r.status == ERR_VM
+
+
+def test_bpf_fault_fails_txn(env):
+    funk, db, ex = env
+    deploy(funk, asm("mov64 r1, 0; ldxdw r0, [r1+0]; exit"))
+    r = ex.execute("blk", txn([(3, bytes([1, 2]), b"")]))
+    assert r.status == ERR_VM
+
+
+def test_duplicate_account_indices_cannot_mint(env):
+    """An instruction listing the same account at two indices must not
+    double-count it in the conservation sum (review-found mint bug):
+    slots [A, A, B] with A=500: program writes slot0=0, slot1=500,
+    B-slot += 500 — naive before-sum (1000) would pass; unique-account
+    accounting must reject it."""
+    funk, db, ex = env
+    base = 2
+    lam = [base + i * STRIDE + 32 for i in range(3)]
+    code = asm(f"""
+        mov64 r6, r1
+        mov64 r2, 0
+        stxdw [r6+{lam[0]}], r2
+        mov64 r2, 500
+        stxdw [r6+{lam[1]}], r2
+        ldxdw r3, [r6+{lam[2]}]
+        add64 r3, 500
+        stxdw [r6+{lam[2]}], r3
+        mov64 r0, 0
+        exit
+    """)
+    deploy(funk, code)
+    msg = build_message([PAYER], [A1, A2, PROG], b"\x33" * 32,
+                        [(3, bytes([1, 1, 2]), b"")])
+    r = ex.execute("blk", build_txn([bytes(64)], msg))
+    assert r.status == ERR_BALANCE_VIOLATION
+    assert db.lamports("blk", A1) == 500
+    assert db.lamports("blk", A2) == 50
+
+
+def test_duplicate_account_indices_consistent_move(env):
+    """Duplicates ARE legal when conservation holds over unique
+    accounts: [A, A, B] moving 100 A->B with consistent slots."""
+    funk, db, ex = env
+    base = 2
+    lam = [base + i * STRIDE + 32 for i in range(3)]
+    code = asm(f"""
+        mov64 r6, r1
+        ldxdw r2, [r6+{lam[0]}]
+        sub64 r2, 100
+        stxdw [r6+{lam[0]}], r2
+        stxdw [r6+{lam[1]}], r2
+        ldxdw r3, [r6+{lam[2]}]
+        add64 r3, 100
+        stxdw [r6+{lam[2]}], r3
+        mov64 r0, 0
+        exit
+    """)
+    deploy(funk, code)
+    msg = build_message([PAYER], [A1, A2, PROG], b"\x33" * 32,
+                        [(3, bytes([1, 1, 2]), b"")])
+    r = ex.execute("blk", build_txn([bytes(64)], msg))
+    assert r.status == OK, r
+    assert db.lamports("blk", A1) == 400
+    assert db.lamports("blk", A2) == 150
+
+
+def test_non_executable_account_is_not_a_program(env):
+    funk, db, ex = env
+    funk.rec_write("blk", PROG, Account(
+        lamports=1, data=asm("mov64 r0, 0; exit"),
+        owner=BPF_LOADER_ID, executable=False))
+    r = ex.execute("blk", txn([(3, bytes([1, 2]), b"")]))
+    assert r.status == "unknown_program"

@@ -1,0 +1,206 @@
+"""sBPF VM tests: ISA semantics, memory-map faults, calls/stack,
+compute budget, syscalls (ref: src/flamenco/vm/fd_vm_interp_core.c,
+test tiers per src/flamenco/vm/test_vm_interp.c)."""
+import hashlib
+
+import pytest
+
+from firedancer_tpu.vm import (
+    DEFAULT_SYSCALLS, ERR_ABORT, ERR_BUDGET, ERR_DEPTH, ERR_DIV0,
+    ERR_NONE, ERR_OOB, ERR_SYSCALL, INPUT_START, Vm, asm, syscall_id,
+)
+
+
+def run(src, **kw):
+    vm = Vm(asm(src), syscalls=DEFAULT_SYSCALLS, **kw)
+    return vm.run()
+
+
+def test_alu64_basics():
+    r = run("""
+        mov64 r1, 7
+        add64 r1, 5
+        mul64 r1, 3          // 36
+        mov64 r2, 5
+        div64 r1, r2         // 7
+        lsh64 r1, 4          // 112
+        or64 r1, 1
+        xor64 r1, 2          // 115
+        mov64 r0, r1
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 115
+
+
+def test_alu32_truncates():
+    r = run("""
+        lddw r1, 0x1FFFFFFFF
+        add32 r1, 1          // truncates to 32 bits: 0
+        mov64 r0, r1
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 0
+
+
+def test_neg_arsh_signed():
+    r = run("""
+        mov64 r1, 16
+        neg64 r1             // -16
+        arsh64 r1, 2         // -4
+        mov64 r0, r1
+        exit
+    """)
+    assert r.error == ERR_NONE
+    assert r.r0 == (-4) & ((1 << 64) - 1)
+
+
+def test_byteswap():
+    r = run("""
+        lddw r1, 0x1122334455667788
+        be r1, 64
+        mov64 r0, r1
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 0x8877665544332211
+
+
+def test_div_by_zero_faults():
+    r = run("mov64 r1, 1; mov64 r2, 0; div64 r1, r2; exit")
+    assert r.error == ERR_DIV0
+    r = run("mov64 r1, 1; mov64 r2, 0; mod64 r1, r2; exit")
+    assert r.error == ERR_DIV0
+
+
+def test_jumps_signed_unsigned():
+    # -1 unsigned-gt 1, but signed-lt 1
+    r = run("""
+        mov64 r1, 0
+        sub64 r1, 1          // r1 = -1
+        mov64 r2, 1
+        mov64 r0, 0
+        jgt r1, r2, +1       // taken (unsigned)
+        exit
+        add64 r0, 1
+        jslt r1, r2, +1      // taken (signed)
+        exit
+        add64 r0, 2
+        mov64 r0, r0
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 3
+
+
+def test_stack_load_store_and_guard():
+    r = run("""
+        mov64 r1, 0x1234
+        stxdw [r10-8], r1
+        ldxdw r0, [r10-8]
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 0x1234
+    # writing above the frame pointer crosses into the guard gap
+    r = run("mov64 r1, 1; stxdw [r10+16], r1; exit")
+    assert r.error == ERR_OOB
+
+
+def test_input_region_rw():
+    vm = Vm(asm("""
+        ldxw r0, [r1+0]
+        add64 r0, 1
+        stxw [r1+4], r0
+        exit
+    """), input_data=(41).to_bytes(4, "little") + bytes(4))
+    r = vm.run()
+    assert r.error == ERR_NONE and r.r0 == 42
+    assert vm.mem_read(INPUT_START + 4, 4) == (42).to_bytes(4, "little")
+
+
+def test_rodata_not_writable():
+    r = run("mov64 r1, 1; lddw r2, 0x100000000; stxdw [r2+0], r1; exit")
+    assert r.error == ERR_OOB
+
+
+def test_internal_call_and_shadow_regs():
+    """call_rel saves r6..r9 + frame pointer; callee clobbers r6 and
+    uses its own stack frame; caller's r6 survives."""
+    r = run("""
+        mov64 r6, 7
+        mov64 r1, 5
+        call_rel +3
+        add64 r0, r6         // r6 restored: +7
+        exit
+        mov64 r6, 99         // callee clobbers
+        stxdw [r10-8], r1
+        ldxdw r0, [r10-8]    // callee frame works
+        add64 r0, 10         // r0 = 15
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 22
+
+
+def test_recursion_depth_limit():
+    r = run("call_rel -1; exit")          # infinite self-call
+    assert r.error == ERR_DEPTH
+
+
+def test_compute_budget():
+    r = run("ja -1", compute_budget=1000)  # infinite loop
+    assert r.error == ERR_BUDGET
+    assert r.compute_used == 1001
+
+
+def test_syscalls_log_memops_sha():
+    msg = b"hello vm"
+    sid_log = syscall_id(b"sol_log_")
+    sid_sha = syscall_id(b"sol_sha256")
+    vm = Vm(asm(f"""
+        // log the first 8 input bytes
+        mov64 r1, r1
+        mov64 r2, 8
+        call {sid_log}
+        // sha256 of one slice (vaddr=INPUT, len=8); slice vec on stack
+        lddw r1, {INPUT_START}
+        stxdw [r10-32], r1
+        mov64 r1, 8
+        stxdw [r10-24], r1
+        mov64 r1, r10
+        add64 r1, -32
+        mov64 r2, 1
+        lddw r3, {INPUT_START + 16}
+        call {sid_sha}
+        mov64 r0, 0
+        exit
+    """), input_data=msg + bytes(56), syscalls=DEFAULT_SYSCALLS)
+    r = vm.run()
+    assert r.error == ERR_NONE
+    assert r.log == ["hello vm"]
+    assert vm.mem_read(INPUT_START + 16, 32) == \
+        hashlib.sha256(msg).digest()
+
+
+def test_abort_and_unknown_syscall():
+    r = run(f"call {syscall_id(b'abort')}; exit")
+    assert r.error == ERR_ABORT
+    r = run("call 0xdeadbeef; exit")
+    assert r.error == ERR_SYSCALL
+
+
+def test_callx():
+    r = run("""
+        lddw r3, 0x100000028   // instruction 5 (lddw spans slots 0-1)
+        callx r3
+        add64 r0, 1
+        exit
+        mov64 r0, 41
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 42
+
+
+@pytest.mark.parametrize("prog,err", [
+    ("ldxdw r0, [r1+4096]", ERR_OOB),            # past input end
+    ("mov64 r1, 0; ldxdw r0, [r1+0]", ERR_OOB),  # null deref
+])
+def test_memory_faults(prog, err):
+    vm = Vm(asm(prog + "; exit"), input_data=bytes(8))
+    assert vm.run().error == err
