@@ -1,0 +1,129 @@
+"""Backtest: deterministic ledger replay against a state fingerprint
+(ref: src/discof/backtest/fd_backtest_tile.c — replay recorded ledger
+segments through the runtime and assert bank hashes; CI tier 8 of
+SURVEY §4).
+
+Ledger file = checkpoint frame stream (utils/checkpt.py):
+  frame 0   genesis funk checkpoint (nested, bytes)
+  frame i   one block: u64 slot | u32 txn_cnt | (u32 len | payload)*
+  last      expected final state fingerprint (8 bytes) — written by
+            `record`, asserted by `replay`
+
+Replay executes every block through the host TxnExecutor in a funk
+fork published per block (the bank discipline), recomputes the
+fingerprint, and reports sec/slot — the reference's benchmark.yml
+regression metric.
+
+CLI:  python -m firedancer_tpu.app.backtest replay <ledger>
+"""
+from __future__ import annotations
+
+import io
+import struct
+import sys
+import time
+
+from ..funk.funk import Funk
+from ..svm import AccDb, TxnExecutor
+from ..svm.programs import OK
+from ..tiles.snapshot import state_fingerprint
+from ..utils.checkpt import (
+    CheckptReader, CheckptWriter, funk_checkpt, funk_restore,
+)
+
+
+def pack_block(slot: int, payloads: list[bytes]) -> bytes:
+    out = struct.pack("<QI", slot, len(payloads))
+    for p in payloads:
+        out += struct.pack("<I", len(p)) + p
+    return bytes(out)
+
+
+def unpack_block(b: bytes):
+    slot, cnt = struct.unpack_from("<QI", b, 0)
+    off = 12
+    payloads = []
+    for _ in range(cnt):
+        (ln,) = struct.unpack_from("<I", b, off)
+        off += 4
+        payloads.append(b[off:off + ln])
+        off += ln
+    return slot, payloads
+
+
+def record(genesis: Funk, blocks: list[tuple[int, list[bytes]]],
+           fp) -> int:
+    """Execute blocks from genesis, writing the ledger + final
+    fingerprint. Returns the fingerprint."""
+    gbuf = io.BytesIO()
+    funk_checkpt(genesis, gbuf)
+    w = CheckptWriter(fp)
+    w.frame(gbuf.getvalue())
+    funk = funk_restore(Funk, io.BytesIO(gbuf.getvalue()))
+    ex = TxnExecutor(AccDb(funk))
+    for slot, payloads in blocks:
+        w.frame(pack_block(slot, payloads))
+        _exec_block(funk, ex, slot, payloads)
+    fingerprint = state_fingerprint(funk)
+    w.frame(fingerprint.to_bytes(8, "little"))
+    w.fini()
+    return fingerprint
+
+
+def _exec_block(funk: Funk, ex: TxnExecutor, slot: int,
+                payloads: list[bytes]) -> int:
+    xid = ("block", slot)
+    funk.txn_prepare(None, xid)
+    ok = 0
+    for p in payloads:
+        ok += ex.execute(xid, p).status == OK
+    funk.txn_publish(xid)
+    return ok
+
+
+def replay(fp, verbose: bool = False) -> dict:
+    """Replay a ledger; raises on fingerprint divergence."""
+    r = CheckptReader(fp)
+    frames = r.frames()
+    genesis_blob = next(frames)
+    funk = funk_restore(Funk, io.BytesIO(genesis_blob))
+    ex = TxnExecutor(AccDb(funk))
+    blocks = txns = executed = 0
+    t0 = time.perf_counter()
+    last = None
+    for frame in frames:
+        if last is not None:
+            slot, payloads = unpack_block(last)
+            executed += _exec_block(funk, ex, slot, payloads)
+            blocks += 1
+            txns += len(payloads)
+        last = frame
+    dt = time.perf_counter() - t0
+    want = int.from_bytes(last, "little") if last and len(last) == 8 \
+        else None
+    got = state_fingerprint(funk)
+    if want is None or got != want:
+        raise AssertionError(
+            f"state diverged: fingerprint {got:#x} != expected "
+            f"{want:#x}" if want is not None else "ledger missing "
+            "fingerprint trailer")
+    out = {"blocks": blocks, "txns": txns, "executed_ok": executed,
+           "sec_per_slot": round(dt / max(blocks, 1), 6),
+           "fingerprint": got}
+    if verbose:
+        print(out)
+    return out
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2 or argv[0] != "replay":
+        print(__doc__)
+        return 1
+    with open(argv[1], "rb") as f:
+        replay(f, verbose=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

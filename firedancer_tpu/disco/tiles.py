@@ -665,16 +665,51 @@ class SignAdapter:
         if self._ks_off is None:
             return
         from ..keyguard import keyswitch as ks
-        seed = ks.poll_switch(self.ctx.wksp, self._ks_off)
-        if seed is not None:
+        pending = ks.poll_switch(self.ctx.wksp, self._ks_off)
+        if pending is not None:
+            seed, gen = pending
             self.tile.rekey(seed)
-            # compare-and-ack: if a newer request raced in, leave it
-            # pending — the next housekeeping applies it too
-            ks.ack_switch(self.ctx.wksp, self._ks_off, seed)
+            # compare-and-ack on the generation: a racing newer request
+            # stays pending and applies next housekeeping
+            ks.ack_switch(self.ctx.wksp, self._ks_off, gen)
 
     def in_seqs(self):
         return {ln: s for ln, s in
                 zip(self._links, self.tile.seqs)}
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
+@register("gossip")
+class GossipAdapter:
+    """Gossip tile (ref: src/discof/gossip/ + src/flamenco/gossip/):
+    CRDS over UDP with signed values. args: seed (hex), port,
+    bind_addr, entrypoints (["host:port", ...]), publish (list of
+    {kind, index, data_hex} values to originate at boot)."""
+
+    METRICS = ["rx", "tx", "values", "contacts", "bad_msg", "port"]
+    GAUGES = ["values", "contacts", "port"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.gossip import GossipTile
+        self.tile = GossipTile(
+            bytes.fromhex(args["seed"]),
+            port=int(args.get("port", 0)),
+            bind_addr=args.get("bind_addr", "127.0.0.1"),
+            entrypoints=args.get("entrypoints", ()))
+        for v in args.get("publish", []):
+            self.tile.publish(int(v["kind"]), int(v.get("index", 0)),
+                              bytes.fromhex(v["data_hex"]))
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def housekeeping(self):
+        self.tile.housekeeping()
+
+    def on_halt(self):
+        self.tile.close()
 
     def metrics_items(self):
         return dict(self.tile.metrics)

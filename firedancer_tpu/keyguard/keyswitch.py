@@ -20,8 +20,9 @@ STATE_COMPLETED = 2
 FOOTPRINT = 64
 
 
-def _checksum(seed: bytes) -> bytes:
-    return hashlib.sha256(b"fdtpu-keyswitch" + seed).digest()[:8]
+def _checksum(seed: bytes, gen: int) -> bytes:
+    return hashlib.sha256(b"fdtpu-keyswitch" + seed
+                          + gen.to_bytes(8, "little")).digest()[:8]
 
 
 def _view(wksp, off):
@@ -32,37 +33,44 @@ def read_state(wksp, off) -> int:
     return int(_view(wksp, off)[:8].view(np.uint64)[0])
 
 
-def request_switch(wksp, off, seed: bytes):
-    """Operator side: stage the new 32-byte seed + its checksum, then
-    flip PENDING. The checksum makes a torn read (a second request
-    racing the tile's poll) DETECTABLE: the tile skips a seed whose
-    checksum doesn't match and retries next housekeeping, so it can
-    never rekey onto part-B/part-C garbage bytes."""
+def request_switch(wksp, off, seed: bytes) -> int:
+    """Operator side: bump the request GENERATION, stage seed +
+    checksum(seed, gen), then flip PENDING. The checksum makes a torn
+    read (a racing second request) DETECTABLE — the tile skips and
+    retries; the generation makes every request distinct, so
+    re-requesting even the SAME seed can never interleave with an ack
+    into a wedged PENDING-with-scrubbed-seed state. Returns the
+    generation to pass to wait_completed."""
     assert len(seed) == 32
     v = _view(wksp, off)
+    gen = int(v[48:56].view(np.uint64)[0]) + 1
     v[:8].view(np.uint64)[0] = STATE_UNLOCKED     # close the window
     v[8:40] = np.frombuffer(seed, np.uint8)
-    v[40:48] = np.frombuffer(_checksum(seed), np.uint8)
+    v[40:48] = np.frombuffer(_checksum(seed, gen), np.uint8)
+    v[48:56].view(np.uint64)[0] = gen
     v[:8].view(np.uint64)[0] = STATE_PENDING
+    return gen
 
 
-def poll_switch(wksp, off) -> bytes | None:
-    """Tile side: new seed if a switch is pending AND intact."""
+def poll_switch(wksp, off) -> tuple[bytes, int] | None:
+    """Tile side: (seed, gen) if a switch is pending AND intact."""
     v = _view(wksp, off)
     if int(v[:8].view(np.uint64)[0]) != STATE_PENDING:
         return None
     seed = bytes(v[8:40])
-    if bytes(v[40:48]) != _checksum(seed):
+    gen = int(v[48:56].view(np.uint64)[0])
+    if bytes(v[40:48]) != _checksum(seed, gen):
         return None                  # torn write in progress: retry
-    return seed
+    return seed, gen
 
 
-def ack_switch(wksp, off, applied_seed: bytes) -> bool:
-    """Tile side: complete the switch ONLY if the region still stages
-    the seed we applied — a second request racing the swap must not be
-    scrubbed and falsely reported COMPLETED (compare-and-ack)."""
+def ack_switch(wksp, off, applied_gen: int) -> bool:
+    """Tile side: complete the switch ONLY if the staged generation is
+    still the one we applied — a racing newer request (same seed or
+    not) is left pending for the next housekeeping (compare-and-ack on
+    the generation, immune to same-seed interleavings)."""
     v = _view(wksp, off)
-    if bytes(v[8:40]) != applied_seed:
+    if int(v[48:56].view(np.uint64)[0]) != applied_gen:
         return False                 # a newer request landed: leave it
     v[8:40] = 0                      # scrub the staged seed
     v[40:48] = 0
@@ -70,11 +78,18 @@ def ack_switch(wksp, off, applied_seed: bytes) -> bool:
     return True
 
 
-def wait_completed(wksp, off, timeout_s: float = 30.0) -> bool:
+def wait_completed(wksp, off, gen: int | None = None,
+                   timeout_s: float = 30.0) -> bool:
+    """Operator side: wait for OUR generation (or any, if None) to
+    complete. A newer generation completing also counts — the key has
+    moved past ours."""
     import time
+    v = _view(wksp, off)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
-        if read_state(wksp, off) == STATE_COMPLETED:
+        st = read_state(wksp, off)
+        cur = int(v[48:56].view(np.uint64)[0])
+        if st == STATE_COMPLETED and (gen is None or cur >= gen):
             return True
         time.sleep(0.01)
     return False

@@ -199,20 +199,21 @@ def _exec_bpf(ctx: TxnContext, instr, program: Account) -> str:
     Input layout: u16 n_accounts | n × (pubkey 32 | lamports u64 |
     is_signer u8 | is_writable u8) | u16 data_len | instruction data.
     After a successful run, lamports of WRITABLE accounts are read back
-    under the conservation rule: the instruction may move lamports
-    between its accounts but never mint or burn them (the runtime's
-    sum-of-lamports invariant)."""
-    import struct as _s
-
+    under two runtime rules: sum-of-lamports conservation (never mint
+    or burn), and the OWNERSHIP rule — only the executing program may
+    DEBIT an account, and only if that account is owned by it
+    (credits are unrestricted), mirroring the reference runtime's
+    account-modification checks."""
     from ..vm import DEFAULT_SYSCALLS, ERR_NONE as VM_OK, Vm
     accts = [ctx.account(i) for i in instr.acct_idxs]
+    program_id = ctx.keys[instr.prog_idx]
     data = ctx.payload[instr.data_off:instr.data_off + instr.data_sz]
-    blob = _s.pack("<H", len(accts))
+    blob = struct.pack("<H", len(accts))
     for ix, a in zip(instr.acct_idxs, accts):
-        blob += (ctx.keys[ix] + _s.pack("<Q", a.lamports)
+        blob += (ctx.keys[ix] + struct.pack("<Q", a.lamports)
                  + bytes([1 if ctx.is_signer(ix) else 0,
                           1 if ctx.is_writable(ix) else 0]))
-    blob += _s.pack("<H", len(data)) + data
+    blob += struct.pack("<H", len(data)) + data
     vm = Vm(program.data, input_data=blob, syscalls=DEFAULT_SYSCALLS)
     res = vm.run()
     ctx.logs.extend(res.log)
@@ -240,6 +241,11 @@ def _exec_bpf(ctx: TxnContext, instr, program: Account) -> str:
         if lam != a.lamports:
             if not ctx.is_writable(ix):
                 return ERR_NOT_WRITABLE
+            if lam < a.lamports and a.owner != program_id:
+                # a program may only DEBIT accounts it owns — txn-level
+                # writability alone must not let an arbitrary deployed
+                # program drain a victim's account
+                return ERR_INVALID_OWNER
             a.lamports = lam
     return OK
 

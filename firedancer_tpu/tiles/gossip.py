@@ -1,0 +1,179 @@
+"""Gossip tile: the CRDS protocol over UDP sockets.
+
+Binds gossip/protocol.py (push / pull / prune logic) to the wire the
+way the reference's gossip tile drives fd_gossip over the net tile
+(ref: src/discof/gossip/ + src/flamenco/gossip/fd_gossip.h protocol
+pieces: entrypoint bootstrap via ContactInfo, push to the active set,
+bloom pulls for anti-entropy, prunes on duplicate routes).
+
+Wire format (one datagram per message):
+  u8 type | sender pubkey 32 | body
+  type 0 PUSH:      u16 n | n × CrdsValue wire
+  type 1 PULL_REQ:  bloom wire
+  type 2 PULL_RESP: u16 n | n × CrdsValue wire
+  type 3 PRUNE:     u16 n | n × origin pubkey 32
+
+CRDS values are ed25519-signed over CrdsValue.signable() and verified
+on receipt (the gossvf stage of the reference; host-rate signing via
+the oracle signer — gossip is not the hot path)."""
+from __future__ import annotations
+
+import socket
+import struct
+
+from ..gossip import CrdsValue, GossipNode
+from ..gossip.crds import KIND_CONTACT_INFO
+from ..utils.ed25519_ref import keypair, sign, verify
+
+MSG_PUSH, MSG_PULL_REQ, MSG_PULL_RESP, MSG_PRUNE = 0, 1, 2, 3
+MTU = 1232
+
+
+def _pack_values(msg_type: int, sender: bytes, values) -> bytes:
+    out = bytes([msg_type]) + sender + struct.pack("<H", len(values))
+    for v in values:
+        out += v.to_wire()
+    return out
+
+
+class GossipTile:
+    def __init__(self, seed: bytes, port: int = 0,
+                 bind_addr: str = "127.0.0.1", entrypoints=(),
+                 stake_of=None, now_ms: int = 0):
+        self.seed = seed
+        _, _, self.pubkey = keypair(seed)
+        self.node = GossipNode(
+            self.pubkey, stake_of=stake_of,
+            sign_fn=lambda msg: sign(self.seed, msg),
+            verify_fn=lambda sig, origin, msg: verify(sig, origin, msg),
+            now_ms=now_ms)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((bind_addr, port))
+        self.sock.setblocking(False)
+        self.addr = self.sock.getsockname()
+        self.entrypoints = [tuple(e) if not isinstance(e, str)
+                            else (e.rsplit(":", 1)[0],
+                                  int(e.rsplit(":", 1)[1]))
+                            for e in entrypoints]
+        self._push_queue: list[CrdsValue] = []
+        self._tick = 0
+        self.metrics = {"rx": 0, "tx": 0, "values": 0, "contacts": 0,
+                        "bad_msg": 0, "port": self.addr[1]}
+        self.node.publish_contact_info(self.addr)
+
+    # -- addressing ---------------------------------------------------------
+
+    def _addr_of(self, pubkey: bytes):
+        ci = self.node.crds.get(pubkey, KIND_CONTACT_INFO)
+        if ci is None:
+            return None
+        try:
+            host, port = ci.data.decode().rsplit(":", 1)
+            return (host, int(port))
+        except ValueError:
+            return None
+
+    def _send(self, addr, payload: bytes):
+        try:
+            self.sock.sendto(payload[:65000], addr)
+            self.metrics["tx"] += 1
+        except OSError:
+            pass
+
+    # -- rx ----------------------------------------------------------------
+
+    def poll_once(self) -> int:
+        n = 0
+        while n < 64:
+            try:
+                data, addr = self.sock.recvfrom(65536)
+            except BlockingIOError:
+                break
+            n += 1
+            self.metrics["rx"] += 1
+            try:
+                self._handle(data, addr)
+            except Exception:  # noqa: BLE001 — hostile datagrams drop
+                self.metrics["bad_msg"] += 1
+        self.metrics["values"] = len(self.node.crds.values)
+        self.metrics["contacts"] = len(self.node.crds.contact_infos())
+        return n
+
+    def _handle(self, data: bytes, addr):
+        mtype = data[0]
+        sender = data[1:33]
+        body = data[33:]
+        if mtype in (MSG_PUSH, MSG_PULL_RESP):
+            (cnt,) = struct.unpack_from("<H", body, 0)
+            off = 2
+            values = []
+            for _ in range(cnt):
+                v, off = CrdsValue.from_wire(body, off)
+                values.append(v)
+            if mtype == MSG_PUSH:
+                fresh = self.node.handle_push(values, relayer=sender)
+                self._push_queue.extend(fresh)     # relay onward
+            else:
+                self.node.handle_pull_response(values)
+        elif mtype == MSG_PULL_REQ:
+            resp = self.node.handle_pull_request(body, limit=16)
+            if resp:
+                self._send(addr, _pack_values(MSG_PULL_RESP, self.pubkey,
+                                              resp))
+        elif mtype == MSG_PRUNE:
+            (cnt,) = struct.unpack_from("<H", body, 0)
+            origins = [body[2 + 32 * i:2 + 32 * (i + 1)]
+                       for i in range(cnt)]
+            self.node.handle_prune(sender, origins)
+        else:
+            self.metrics["bad_msg"] += 1
+
+    # -- periodic (stem housekeeping) ---------------------------------------
+
+    def publish(self, kind: int, index: int, data: bytes):
+        self._push_queue.append(self.node.make_value(kind, index, data))
+
+    def housekeeping(self, now_ms: int | None = None):
+        self._tick += 1
+        self.node.tick(now_ms if now_ms is not None
+                       else self.node.now_ms + 100)
+        # refresh own contact info periodically (wallclock advances)
+        if self._tick % 50 == 1:
+            self.publish(KIND_CONTACT_INFO, 0,
+                         f"{self.addr[0]}:{self.addr[1]}".encode())
+        # push queued fresh values to the active set (or entrypoints
+        # while we know no peers — the bootstrap hop)
+        if self._push_queue:
+            batch, self._push_queue = self._push_queue[:8], \
+                self._push_queue[8:]
+            targets: set = set()
+            for v in batch:
+                for pk in self.node.push_targets_for(v):
+                    targets.add(self._addr_of(pk))
+            if not targets:
+                targets = set(self.entrypoints)
+            payload = _pack_values(MSG_PUSH, self.pubkey, batch)
+            for addr in targets:
+                if addr and addr != self.addr:
+                    self._send(addr, payload)
+        # anti-entropy pull every few ticks
+        if self._tick % 5 == 0:
+            peers = [self._addr_of(c.origin)
+                     for c in self.node.crds.contact_infos()
+                     if c.origin != self.pubkey]
+            peers = [p for p in peers if p] or list(self.entrypoints)
+            if peers:
+                addr = peers[self._tick // 5 % len(peers)]
+                self._send(addr, bytes([MSG_PULL_REQ]) + self.pubkey
+                           + self.node.make_pull_request(
+                               seed=self._tick))
+        # prunes for noisy relayers
+        for relayer, origins in self.node.prunes_due().items():
+            addr = self._addr_of(relayer)
+            if addr:
+                self._send(addr, bytes([MSG_PRUNE]) + self.pubkey
+                           + struct.pack("<H", len(origins))
+                           + b"".join(origins))
+
+    def close(self):
+        self.sock.close()

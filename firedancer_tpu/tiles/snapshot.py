@@ -73,7 +73,11 @@ class SnapLoader:
         n = 0
         while n < 16:
             if self._pending is None:
-                data = self.fp.read(self.chunk)
+                # never read past the size captured at open: a file
+                # that GREW since then must not push EOM off the end
+                # (appended bytes are a new snapshot, not this stream)
+                want = min(self.chunk, self.size - self.off)
+                data = self.fp.read(want)
                 if not data:
                     if self.off < self.size:
                         # file shrank after open: fail the tile loudly

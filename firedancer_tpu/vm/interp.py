@@ -135,20 +135,27 @@ class Vm:
 
     # -- execution ----------------------------------------------------------
 
+    def charge(self, units: int):
+        """Charge extra compute units (syscall costs) against the
+        budget; faults when exhausted. Valid only during run()."""
+        self._cu += units
+        if self._cu > self.compute_budget:
+            raise VmFault(ERR_BUDGET)
+
     def run(self, r1: int = INPUT_START, entry_pc: int = 0) -> VmResult:
         reg = [0] * 11
         reg[1] = r1
         reg[10] = STACK_START + FRAME_SZ        # frame 0 top
         pc = entry_pc
-        cu = 0
+        self._cu = 0
         shadow = []                             # (r6..r9, r10, ret pc)
         err = ERR_NONE
         try:
             while True:
                 if not 0 <= pc < self.n_instr:
                     raise VmFault(ERR_PC, f"pc {pc}")
-                cu += 1
-                if cu > self.compute_budget:
+                self._cu += 1
+                if self._cu > self.compute_budget:
                     raise VmFault(ERR_BUDGET)
                 i = pc * 8
                 op = self.text[i]
@@ -263,16 +270,29 @@ class Vm:
                         continue
                     a = reg[dst]
                     b = reg[src] if use_reg else imm & MASK64
-                    sa = a - (1 << 64) if a >> 63 else a
-                    sb = b - (1 << 64) if b >> 63 else b
-                    take = {
-                        0x10: a == b, 0x20: a > b, 0x30: a >= b,
-                        0xA0: a < b, 0xB0: a <= b,
-                        0x40: bool(a & b), 0x50: a != b,
-                        0x60: sa > sb, 0x70: sa >= sb,
-                        0xC0: sa < sb, 0xD0: sa <= sb,
-                    }.get(code)
-                    if take is None:
+                    # one comparison per branch (interpreter hot loop);
+                    # signed conversions only for the signed family
+                    if code == 0x10:
+                        take = a == b
+                    elif code == 0x20:
+                        take = a > b
+                    elif code == 0x30:
+                        take = a >= b
+                    elif code == 0xA0:
+                        take = a < b
+                    elif code == 0xB0:
+                        take = a <= b
+                    elif code == 0x40:
+                        take = bool(a & b)
+                    elif code == 0x50:
+                        take = a != b
+                    elif code in (0x60, 0x70, 0xC0, 0xD0):
+                        sa = a - (1 << 64) if a >> 63 else a
+                        sb = b - (1 << 64) if b >> 63 else b
+                        take = (sa > sb if code == 0x60 else
+                                sa >= sb if code == 0x70 else
+                                sa < sb if code == 0xC0 else sa <= sb)
+                    else:
                         raise VmFault(ERR_BAD_OP, f"op {op:#x}")
                     if take:
                         pc += offs
@@ -306,5 +326,5 @@ class Vm:
                     raise VmFault(ERR_BAD_OP, f"op {op:#x}")
         except VmFault as f:
             err = f.kind
-        self.compute_used = cu
-        return VmResult(err, reg[0], cu, self.log)
+        self.compute_used = self._cu
+        return VmResult(err, reg[0], self._cu, self.log)

@@ -9,8 +9,9 @@ import hashlib
 from .interp import ERR_ABORT, MASK64, VmFault
 
 CU_SYSCALL_BASE = 100
-CU_MEM_PER_BYTE = 1        # charged per 250 bytes in the reference
+CU_MEM_PER_250B = 1        # memop cost per 250 bytes (reference rate)
 CU_SHA256_BASE = 85
+CU_SHA256_PER_64B = 1
 
 
 def syscall_id(name: bytes) -> int:
@@ -24,28 +25,33 @@ def sys_abort(vm, r1, r2, r3, r4, r5):
 
 
 def sys_log(vm, r1, r2, r3, r4, r5):
+    vm.charge(CU_SYSCALL_BASE + r2 // 250)
     msg = vm.mem_read(r1, min(r2, 10_000))
     vm.log.append(msg.decode("utf-8", "replace"))
     return 0
 
 
 def sys_log_64(vm, r1, r2, r3, r4, r5):
+    vm.charge(CU_SYSCALL_BASE)
     vm.log.append(" ".join(f"{x & MASK64:#x}" for x in
                            (r1, r2, r3, r4, r5)))
     return 0
 
 
 def sys_memcpy(vm, r1, r2, r3, r4, r5):
+    vm.charge(CU_SYSCALL_BASE + r3 // 250)
     vm.mem_write(r1, vm.mem_read(r2, r3))
     return 0
 
 
 def sys_memset(vm, r1, r2, r3, r4, r5):
+    vm.charge(CU_SYSCALL_BASE + r3 // 250)
     vm.mem_write(r1, bytes([r2 & 0xFF]) * r3)
     return 0
 
 
 def sys_memcmp(vm, r1, r2, r3, r4, r5):
+    vm.charge(CU_SYSCALL_BASE + r3 // 250)
     a = vm.mem_read(r1, r3)
     b = vm.mem_read(r2, r3)
     res = 0
@@ -59,10 +65,13 @@ def sys_memcmp(vm, r1, r2, r3, r4, r5):
 
 def sys_sha256(vm, r1, r2, r3, r4, r5):
     """r1: vec of (vaddr u64, len u64) slices, r2: count, r3: out."""
+    vm.charge(CU_SHA256_BASE)
     h = hashlib.sha256()
     for i in range(r2):
         va = vm.read_u(r1 + 16 * i, 8)
         ln = vm.read_u(r1 + 16 * i + 8, 8)
+        # charge BEFORE hashing: budget bounds work, not vice versa
+        vm.charge(ln // 64 * CU_SHA256_PER_64B)
         h.update(vm.mem_read(va, ln))
     vm.mem_write(r3, h.digest())
     return 0

@@ -1,0 +1,71 @@
+"""Backtest harness tests: record a synthetic ledger, replay it
+bit-identically, and detect divergence (ref: src/discof/backtest/
+fd_backtest_tile.c replay-and-assert-bank-hash discipline)."""
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.app.backtest import record, replay
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import Account
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+def transfer_txn(src_i, dst_i, amount, blockhash=b"\x55" * 32):
+    data = struct.pack("<IQ", 2, amount)
+    msg = build_message([k(src_i)], [k(dst_i), bytes(32)], blockhash,
+                        [(2, bytes([0, 1]), data)])
+    return build_txn([bytes(64)], msg)
+
+
+def _ledger(rng):
+    genesis = Funk()
+    for i in range(1, 6):
+        genesis.rec_write(None, k(i), Account(lamports=10_000_000))
+    blocks = []
+    for slot in range(1, 9):
+        payloads = [
+            transfer_txn(int(rng.integers(1, 6)), int(rng.integers(1, 9)),
+                         int(rng.integers(1, 5000)))
+            for _ in range(int(rng.integers(1, 6)))]
+        blocks.append((slot, payloads))
+    return genesis, blocks
+
+
+def test_record_replay_roundtrip():
+    rng = np.random.default_rng(3)
+    genesis, blocks = _ledger(rng)
+    buf = io.BytesIO()
+    fp = record(genesis, blocks, buf)
+    buf.seek(0)
+    out = replay(buf)
+    assert out["fingerprint"] == fp
+    assert out["blocks"] == 8
+    assert out["txns"] == sum(len(p) for _, p in blocks)
+    assert out["executed_ok"] >= 1
+    assert out["sec_per_slot"] > 0
+    # determinism: a second replay gives the same fingerprint
+    buf.seek(0)
+    assert replay(buf)["fingerprint"] == fp
+
+
+def test_replay_detects_divergence():
+    """Flipping one byte of one transaction payload must change the
+    final state and fail the fingerprint assertion."""
+    rng = np.random.default_rng(4)
+    genesis, blocks = _ledger(rng)
+    buf = io.BytesIO()
+    record(genesis, blocks, buf)
+    raw = bytearray(buf.getvalue())
+    # find a lamports byte of the first block frame and bump it: frames
+    # are zlib-or-raw, so tampering mid-stream corrupts integrity OR
+    # diverges state — both must fail loudly
+    raw[len(raw) // 2] ^= 1
+    with pytest.raises(Exception):
+        replay(io.BytesIO(bytes(raw)))

@@ -59,8 +59,10 @@ def env():
     funk = Funk()
     db = AccDb(funk)
     funk.rec_write(None, PAYER, Account(lamports=1_000_000))
-    funk.rec_write(None, A1, Account(lamports=500))
-    funk.rec_write(None, A2, Account(lamports=50))
+    # A1/A2 are PROGRAM-owned: the ownership rule only lets a program
+    # debit accounts it owns (test_ownership_rule covers the refusal)
+    funk.rec_write(None, A1, Account(lamports=500, owner=PROG))
+    funk.rec_write(None, A2, Account(lamports=50, owner=PROG))
     funk.txn_prepare(None, "blk")
     return funk, db, TxnExecutor(db)
 
@@ -163,6 +165,22 @@ def test_duplicate_account_indices_consistent_move(env):
     assert r.status == OK, r
     assert db.lamports("blk", A1) == 400
     assert db.lamports("blk", A2) == 150
+
+
+def test_ownership_rule_blocks_victim_drain(env):
+    """Review-found theft scenario: a program must NOT be able to debit
+    a writable account it does not own — txn-level writability (which
+    the ATTACKER authors) is not authorization."""
+    funk, db, ex = env
+    victim = k(7)
+    funk.rec_write("blk", victim, Account(lamports=900))  # system-owned
+    deploy(funk, mover_prog(100))
+    msg = build_message([PAYER], [victim, A2, PROG], b"\x33" * 32,
+                        [(3, bytes([1, 2]), b"")])
+    r = ex.execute("blk", build_txn([bytes(64)], msg))
+    from firedancer_tpu.svm.programs import ERR_INVALID_OWNER
+    assert r.status == ERR_INVALID_OWNER
+    assert db.lamports("blk", victim) == 900          # untouched
 
 
 def test_non_executable_account_is_not_a_program(env):
