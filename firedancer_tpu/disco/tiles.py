@@ -681,6 +681,159 @@ class SignAdapter:
         return dict(self.tile.metrics)
 
 
+@register("tower")
+class TowerAdapter:
+    """Consensus tile (ref: src/discof/tower/fd_tower_tile.c): consumes
+    block/vote frames, runs ghost + tower checks at housekeeping, emits
+    own votes. args: total_stake, in link = replay fan-in, out link =
+    votes."""
+
+    METRICS = ["blocks", "votes_in", "votes_out", "lockout_skips",
+               "switch_skips", "roots", "root_slot", "bad_frames",
+               "overruns"]
+    GAUGES = ["root_slot"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.tower import TowerCore
+        self.ctx = ctx
+        self.core = TowerCore(int(args["total_stake"]))
+        self.in_link = next(iter(ctx.in_rings))
+        self.ring = ctx.in_rings[self.in_link]
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.out_fseqs = _single(ctx.out_fseqs, "out link",
+                                 ctx.tile_name)
+        self.seq = 0
+        self._ovr = 0
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, 32, self.mtu)
+        self._ovr += ovr
+        for i in range(n):
+            self.core.handle(bytes(buf[i, :sizes[i]]))
+        return n
+
+    def housekeeping(self):
+        decision = self.core.decide()
+        if decision is not None:
+            slot, block_id = decision
+            while self.out_fseqs and \
+                    self.out.credits(self.out_fseqs) <= 0:
+                time.sleep(20e-6)
+            self.out.publish(struct.pack("<Q", slot) + block_id,
+                             sig=slot)
+
+    def in_seqs(self):
+        return {self.in_link: self.seq}
+
+    def metrics_items(self):
+        return {**self.core.metrics, "overruns": self._ovr}
+
+
+@register("send")
+class SendAdapter:
+    """Vote egress tile (ref: src/discof/send/): consumes vote frames,
+    builds+signs the vote txn via the keyguard rings, sends over UDP.
+    args: identity_hex (node pubkey; the SEED stays in the sign tile),
+    vote_account_hex, dest ("host:port"), req/resp = keyguard links."""
+
+    METRICS = ["votes", "sent", "sign_fail", "overruns"]
+
+    def __init__(self, ctx, args):
+        import socket
+
+        from ..keyguard import KeyguardClient
+        from ..tiles.tower import SendCore
+        self.ctx = ctx
+        vote_in = [ln for ln in ctx.in_rings if ln != args["resp"]]
+        assert len(vote_in) == 1, vote_in
+        self.in_link = vote_in[0]
+        self.ring = ctx.in_rings[self.in_link]
+        host, port = args["dest"].rsplit(":", 1)
+        kg = KeyguardClient(ctx.out_rings[args["req"]],
+                            ctx.in_rings[args["resp"]],
+                            req_fseqs=ctx.out_fseqs[args["req"]])
+        self.core = SendCore(
+            bytes.fromhex(args["identity_hex"]),
+            bytes.fromhex(args["vote_account_hex"]), kg,
+            (host, int(port)),
+            socket.socket(socket.AF_INET, socket.SOCK_DGRAM))
+        self.seq = 0
+        self.m_extra = {"overruns": 0}
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, 8, self.mtu)
+        self.m_extra["overruns"] += ovr
+        for i in range(n):
+            frame = bytes(buf[i, :sizes[i]])
+            (slot,) = struct.unpack_from("<Q", frame, 0)
+            self.core.send_vote(slot, frame[8:40])
+        return n
+
+    def in_seqs(self):
+        # the keyguard resp link is consumed inside KeyguardClient
+        return {self.in_link: self.seq,
+                **{ln: self.core.kg.resp_seq
+                   for ln in self.ctx.in_rings if ln != self.in_link}}
+
+    def metrics_items(self):
+        return {**self.core.metrics, **self.m_extra}
+
+
+@register("archiver")
+class ArchiverAdapter:
+    """Frag-stream recorder (ref: src/disco/archiver/ writer tile).
+    args: path. Consumes its in link (unreliable by convention — the
+    recorder must never backpressure production, matching the
+    reference's observer stance)."""
+
+    METRICS = ["frags", "bytes", "overruns"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.archiver import ArchiveWriter
+        self.ctx = ctx
+        self.in_link = next(iter(ctx.in_rings))
+        self.tile = ArchiveWriter(ctx.in_rings[self.in_link],
+                                  args["path"])
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def in_seqs(self):
+        return {self.in_link: self.tile.seq}
+
+    def on_halt(self):
+        self.tile.close()
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
+@register("playback")
+class PlaybackAdapter:
+    """Frag-stream replayer (ref: src/disco/archiver/ playback tile).
+    args: path."""
+
+    METRICS = ["frags", "bytes", "done", "backpressure"]
+    GAUGES = ["done"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.archiver import ArchivePlayback
+        self.tile = ArchivePlayback(
+            args["path"],
+            _single(ctx.out_rings, "out link", ctx.tile_name),
+            _single(ctx.out_fseqs, "out link", ctx.tile_name))
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
 @register("gossip")
 class GossipAdapter:
     """Gossip tile (ref: src/discof/gossip/ + src/flamenco/gossip/):

@@ -1,0 +1,65 @@
+"""Archiver record/replay tests: capture a live verify-pipeline stream,
+then re-drive the SAME downstream tiles from the file and get identical
+results — the deterministic-replay CI tier (ref: src/disco/archiver/
+fd_archiver.h:1-20; SURVEY §4 tier 10)."""
+import os
+
+from firedancer_tpu.disco import Topology, TopologyRunner
+
+N = 24
+
+
+def test_record_then_replay_identical(tmp_path):
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    path = tmp_path / "stream.arch"
+
+    # phase 1: record the synth stream while verify consumes it live
+    topo = (
+        Topology(f"ar{os.getpid()}", wksp_size=1 << 23)
+        .link("ingest", depth=64, mtu=1280)
+        .link("verify_out", depth=64, mtu=1280)
+        .tcache("tc", depth=4096)
+        .tile("synth", "synth", outs=["ingest"], count=N, unique=N,
+              seed=13)
+        .tile("verify", "verify", ins=["ingest"], outs=["verify_out"],
+              batch=16, tcache="tc")
+        .tile("rec", "archiver", ins=[("ingest", False)],
+              path=str(path))
+        .tile("sink", "sink", ins=["verify_out"])
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=540)
+        runner.wait_idle("sink", "rx", N, timeout_s=540)
+        runner.wait_idle("rec", "frags", N, timeout_s=60)
+        live_tx = runner.metrics("verify")["tx"]
+        assert runner.metrics("rec")["overruns"] == 0
+    finally:
+        runner.halt()
+        runner.close()
+    assert path.exists() and path.stat().st_size > 0
+
+    # phase 2: re-drive verify purely from the recording
+    topo2 = (
+        Topology(f"ar2{os.getpid()}", wksp_size=1 << 23)
+        .link("ingest", depth=64, mtu=1280)
+        .link("verify_out", depth=64, mtu=1280)
+        .tcache("tc", depth=4096)
+        .tile("play", "playback", outs=["ingest"], path=str(path))
+        .tile("verify", "verify", ins=["ingest"], outs=["verify_out"],
+              batch=16, tcache="tc")
+        .tile("sink", "sink", ins=["verify_out"])
+    )
+    runner2 = TopologyRunner(topo2.build()).start()
+    try:
+        runner2.wait_running(timeout_s=540)
+        runner2.wait_idle("play", "done", 1, timeout_s=120)
+        runner2.wait_idle("sink", "rx", live_tx, timeout_s=120)
+        assert runner2.metrics("play")["frags"] == N
+        v = runner2.metrics("verify")
+        assert v["rx"] == N
+        assert v["tx"] == live_tx          # byte-identical re-drive
+        assert v["verify_fail"] == 0
+    finally:
+        runner2.halt()
+        runner2.close()
