@@ -359,6 +359,17 @@ class BankAdapter:
                                      ctx.tile_name)
         self.m = {k: 0 for k in self.METRICS}
         self.slot = 0                  # highest slot seen in microblocks
+        self.fwd_payloads = bool(args.get("forward_payloads", False))
+        if self.fwd_payloads and self.poh_out is not None:
+            # fail at BOOT, not mid-flight: the poh frame re-wraps the
+            # microblock txn section (micro hdr 20 -> poh hdr 42), so
+            # the poh link must absorb the worst-case in-frame
+            need = ctx.plan["links"][self.in_link]["mtu"] - 20 + 42
+            have = ctx.plan["links"][self.poh_link]["mtu"]
+            if have < need:
+                raise ValueError(
+                    f"bank {ctx.tile_name}: forward_payloads needs "
+                    f"poh link mtu >= {need}, got {have}")
         if self.exec_mode == "svm":
             _setup_jax()
             from ..funk.funk import Funk
@@ -465,8 +476,14 @@ class BankAdapter:
                     while self.poh_fseqs and \
                             self.poh_out.credits(self.poh_fseqs) <= 0:
                         time.sleep(20e-6)
+                    # forward_payloads: carry the microblock's txn
+                    # section so poh entries feed the shred tile with
+                    # real block content (the reference's bank->poh
+                    # microblock hand-off keeps the txns attached)
+                    blob = frame[20:] if self.fwd_payloads else b""
                     self.poh_out.publish(
-                        struct.pack("<QH", mb_id, txn_cnt) + mixin,
+                        struct.pack("<QH", mb_id, txn_cnt) + mixin
+                        + blob,
                         sig=mb_id)
             while self.out_fseqs and \
                     self.out.credits(self.out_fseqs) <= 0:
@@ -521,7 +538,11 @@ class PohAdapter:
     consumers/tests.
 
     Entry frag wire: u64 slot | u32 tick | u32 num_hashes |
-    u8 has_mixin | prev 32 | hash 32 | mixin 32.
+    u8 has_mixin | prev 32 | hash 32 | mixin 32 | u8 flags
+    (bit0 = slot_complete, set on the slot's final tick entry) |
+    u16 txn_cnt | txn section (u16 len | payload)* — the txn section
+    is whatever the bank forwarded (forward_payloads), so the shred
+    tile downstream shreds real block content.
     Slot frag wire (slot_link): u64 completed_slot.
 
     args: hashes_per_tick, ticks_per_slot, seed (hex, 32B),
@@ -557,6 +578,15 @@ class PohAdapter:
         self.seqs = {ln: 0 for ln in ctx.in_rings}
         self.mtu = max((ctx.plan["links"][ln]["mtu"]
                         for ln in ctx.in_rings), default=64)
+        # entry frames re-wrap the bank frame's txn section (bank hdr
+        # 42 -> entry hdr 116); catch an undersized entry link at boot
+        ent_ln = next(ln for ln, r in ctx.out_rings.items()
+                      if r is self.entry_out)
+        ent_mtu = ctx.plan["links"][ent_ln]["mtu"]
+        if ctx.in_rings and ent_mtu < self.mtu - 42 + 116:
+            raise ValueError(
+                f"poh {ctx.tile_name}: entry link mtu {ent_mtu} < "
+                f"worst-case entry frame {self.mtu - 42 + 116}")
         self.slot = 0
         self.tick_in_slot = 0
         self.hashes_in_tick = 0
@@ -564,10 +594,13 @@ class PohAdapter:
         self.m = {k: 0 for k in self.METRICS}
 
     def _publish_entry(self, num_hashes: int, prev: bytes,
-                       mixin: bytes | None):
+                       mixin: bytes | None, txn_blob: bytes = b"",
+                       txn_cnt: int = 0, slot_done: bool = False):
         frame = struct.pack("<QII B", self.slot, self.tick_in_slot,
                             num_hashes, 1 if mixin else 0)
         frame += prev + self.state + (mixin or bytes(32))
+        frame += bytes([1 if slot_done else 0]) \
+            + struct.pack("<H", txn_cnt) + txn_blob
         while self.entry_fseqs and \
                 self.entry_out.credits(self.entry_fseqs) <= 0:
             self.m["backpressure"] += 1
@@ -589,10 +622,13 @@ class PohAdapter:
                 if self.hashes_in_tick + 1 >= self.hashes_per_tick:
                     self._tick()
                 mixin = bytes(buf[i, 10:42])
+                (cnt,) = struct.unpack_from("<H", buf[i], 8)
+                blob = bytes(buf[i, 42:sizes[i]])
                 prev = self.state
                 self.state = self._mixin(prev, mixin)
                 self.hashes_in_tick += 1
-                self._publish_entry(1, prev, mixin)
+                self._publish_entry(1, prev, mixin, txn_blob=blob,
+                                    txn_cnt=cnt if blob else 0)
                 self.m["mixins"] += 1
             total += n
         return total
@@ -601,7 +637,9 @@ class PohAdapter:
         remaining = self.hashes_per_tick - self.hashes_in_tick
         prev = self.state
         self.state = self._append(prev, remaining)
-        self._publish_entry(remaining, prev, None)
+        self._publish_entry(
+            remaining, prev, None,
+            slot_done=self.tick_in_slot + 1 >= self.ticks_per_slot)
         self.hashes_in_tick = 0
         self.tick_in_slot += 1
         self.m["ticks"] += 1
@@ -627,6 +665,106 @@ class PohAdapter:
 
     def metrics_items(self):
         return dict(self.m)
+
+
+@register("shred")
+class ShredAdapter:
+    """Turbine shred tile (ref: src/disco/shred/fd_shred_tile.c:6-60 —
+    one tile serves both directions).
+
+    mode="leader": in link = poh entries; shreds entry batches into
+    signed merkle FEC sets (keyguard LEADER role via req/resp links)
+    and transmits each shred to its stake-weighted turbine first hop
+    over UDP. args: cluster = [{pubkey_hex, stake, addr "host:port"}],
+    identity_hex, req/resp (keyguard links), optional out link
+    "shreds" mirror + "batches" witness link, flush_bytes, fanout,
+    shred_version.
+
+    mode="recover": in link = raw shred wires (net/sock tile);
+    FEC-resolves, stores, reassembles ordered slices on the out link.
+    args: leader_pubkey_hex."""
+
+    METRICS = ["entries", "batches", "fec_sets", "data_shreds",
+               "parity_shreds", "sent", "no_dest", "sign_fail",
+               "slots", "shreds", "fecs", "slices", "slots_done",
+               "parse_fail", "overruns"]
+
+    def __init__(self, ctx, args):
+        import socket
+
+        from ..shred.shred_dest import ClusterNode
+        from ..tiles import shred as shredmod
+        self.ctx = ctx
+        self.mode = args.get("mode", "leader")
+        self._ovr = 0
+        if self.mode == "leader":
+            from ..keyguard import KeyguardClient
+            ins = [ln for ln in ctx.in_rings if ln != args["resp"]]
+            assert len(ins) == 1, ins
+            self.in_link = ins[0]
+            kg = KeyguardClient(ctx.out_rings[args["req"]],
+                                ctx.in_rings[args["resp"]],
+                                req_fseqs=ctx.out_fseqs[args["req"]])
+
+            def sign_fn(root):
+                sig = kg.sign(root)
+                if sig is None:
+                    self.core.metrics["sign_fail"] += 1
+                    raise RuntimeError("keyguard refused shred root")
+                return sig
+
+            self._kg = kg
+            cluster = [ClusterNode(bytes.fromhex(n["pubkey_hex"]),
+                                   int(n["stake"]),
+                                   (n["addr"].rsplit(":", 1)[0],
+                                    int(n["addr"].rsplit(":", 1)[1])))
+                       for n in args.get("cluster", [])]
+            aux = [ln for ln in ctx.out_rings if ln != args["req"]]
+            shreds_ln = args.get("shreds_link")
+            batch_ln = args.get("batches_link")
+            assert set(aux) == {ln for ln in (shreds_ln, batch_ln)
+                                if ln}, (aux, shreds_ln, batch_ln)
+            self.core = shredmod.ShredLeaderCore(
+                sign_fn, bytes.fromhex(args["identity_hex"]), cluster,
+                socket.socket(socket.AF_INET, socket.SOCK_DGRAM),
+                out_ring=ctx.out_rings.get(shreds_ln),
+                out_fseqs=ctx.out_fseqs.get(shreds_ln),
+                batch_out=ctx.out_rings.get(batch_ln),
+                batch_fseqs=ctx.out_fseqs.get(batch_ln),
+                shred_version=int(args.get("shred_version", 0)),
+                fanout=int(args.get("fanout", 200)),
+                flush_bytes=int(args.get("flush_bytes", 31840)))
+            self._handle = self.core.on_entry
+        else:
+            self.in_link = next(iter(ctx.in_rings))
+            self.core = shredmod.ShredRecoverCore(
+                bytes.fromhex(args["leader_pubkey_hex"]),
+                _single(ctx.out_rings, "out link", ctx.tile_name),
+                _single(ctx.out_fseqs, "out link", ctx.tile_name))
+            self._handle = self.core.on_shred
+        self.ring = ctx.in_rings[self.in_link]
+        self.seq = 0
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, 16, self.mtu)
+        self._ovr += ovr
+        for i in range(n):
+            self._handle(bytes(buf[i, :sizes[i]]))
+        return n
+
+    def in_seqs(self):
+        seqs = {self.in_link: self.seq}
+        if self.mode == "leader":
+            for ln in self.ctx.in_rings:
+                if ln != self.in_link:
+                    seqs[ln] = self._kg.resp_seq
+        return seqs
+
+    def metrics_items(self):
+        return {k: self.core.metrics.get(k, 0) for k in self.METRICS
+                if k != "overruns"} | {"overruns": self._ovr}
 
 
 @register("sign")

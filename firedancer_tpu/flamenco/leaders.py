@@ -12,6 +12,13 @@ RNG stream layout follows the reference's structure; byte-for-byte
 Agave equivalence is NOT claimed here (that requires replicating
 rand_chacha's exact WeightedIndex consumption) — determinism and
 stake-proportionality are what the tests pin.
+
+INTEROP BLOCKER (tracked): on a real cluster this node would compute a
+different leader for every slot than Agave peers. Before any
+real-cluster milestone this must replicate rand_chacha's exact draw
+sequence (ChaCha20 block order + WeightedIndex's f64 cumulative-weight
+inversion). Self-contained clusters (all nodes this framework) are
+unaffected — every node derives the identical table.
 """
 from __future__ import annotations
 

@@ -121,6 +121,12 @@ def _exec_system(ctx: TxnContext, instr) -> str:
         if not ctx.is_writable(f) or not ctx.is_writable(t):
             return ERR_NOT_WRITABLE
         src = ctx.account(f)
+        if src.owner != SYSTEM_PROGRAM_ID:
+            # the system program may only debit accounts it owns — a
+            # signer must not drain an account previously Assigned to
+            # another program (ref fd_system_program_transfer_verified,
+            # Agave ExternalAccountLamportSpend)
+            return ERR_INVALID_OWNER
         if src.data:
             return ERR_HAS_DATA          # transfer-from must hold no data
         if amount > src.lamports:
@@ -164,6 +170,8 @@ def _exec_system(ctx: TxnContext, instr) -> str:
         a = ai[0]
         if not ctx.is_signer(a):
             return ERR_MISSING_SIG
+        if not ctx.is_writable(a):
+            return ERR_NOT_WRITABLE
         acct = ctx.account(a)
         if acct.owner != SYSTEM_PROGRAM_ID:
             return ERR_INVALID_OWNER
@@ -177,6 +185,8 @@ def _exec_system(ctx: TxnContext, instr) -> str:
         a = ai[0]
         if not ctx.is_signer(a):
             return ERR_MISSING_SIG
+        if not ctx.is_writable(a):
+            return ERR_NOT_WRITABLE
         acct = ctx.account(a)
         if acct.owner != SYSTEM_PROGRAM_ID:
             return ERR_INVALID_OWNER

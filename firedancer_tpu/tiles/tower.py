@@ -13,10 +13,12 @@ Input frames (one link, the replay/gossip fan-in):
 Output frames (votes link):
   u64 slot | 32 block_id   (own vote decision)
 
-Threshold check note: per-voter towers aren't tracked here (the vote
-aggregate carries latest votes only), so the depth-8 threshold check is
-vacuous-true — the lockout and switch checks run for real against
-ghost. Documented divergence until vote-account state feeds in.
+Per-voter towers are reconstructed from the observed vote stream (each
+VOTE frame pushes the voted slot through the same TowerBFT expiry
+rules), so the depth-8 threshold check runs for real alongside lockout
+and switch — the reference reads the equivalent state out of the vote
+accounts the replay stage landed (ref: fd_tower_tile.c vote account
+sync).
 """
 from __future__ import annotations
 
@@ -47,8 +49,12 @@ class TowerCore:
         self.vote_blocks: dict[int, bytes] = {}
         self.slot_of: dict[bytes, int] = {}
         self.last_vote_block: bytes | None = None
+        # voter pubkey -> (stake, replayed Tower); rebuilt from the
+        # vote stream so threshold_check sees every voter's lockouts
+        self.voter_towers: dict[bytes, list] = {}
         self.metrics = {"blocks": 0, "votes_in": 0, "votes_out": 0,
                         "lockout_skips": 0, "switch_skips": 0,
+                        "threshold_skips": 0,
                         "roots": 0, "root_slot": 0, "bad_frames": 0}
 
     # -- frame ingest -------------------------------------------------------
@@ -90,6 +96,16 @@ class TowerCore:
             if self.ghost is not None:
                 self.ghost.replay_vote(voter, stake, block_id)
                 self.metrics["votes_in"] += 1
+                slot = self.slot_of.get(block_id)
+                if slot is not None:
+                    ent = self.voter_towers.get(voter)
+                    if ent is None:
+                        ent = [stake, Tower()]
+                        self.voter_towers[voter] = ent
+                    ent[0] = stake           # stake may be restated
+                    vt: Tower = ent[1]
+                    if not vt.votes or slot > vt.votes[-1].slot:
+                        vt.vote(slot)
         else:
             self.metrics["bad_frames"] += 1
 
@@ -112,6 +128,11 @@ class TowerCore:
                                         self.vote_blocks):
             self.metrics["lockout_skips"] += 1
             return None
+        if not self.tower.threshold_check(
+                slot, [(s, t) for s, t in self.voter_towers.values()],
+                self.total_stake):
+            self.metrics["threshold_skips"] += 1
+            return None
         if self.last_vote_block is not None \
                 and self.last_vote_block in self.ghost.nodes \
                 and not self.tower.switch_check(best,
@@ -133,6 +154,13 @@ class TowerCore:
                                 if s >= rooted}
             self.slot_of = {b: s for b, s in self.slot_of.items()
                             if s >= rooted}
+            # voters whose latest vote predates the root have departed
+            # (or were spoofed pubkeys from the unauthenticated vote
+            # stream) — age them out so the dict and the threshold
+            # numerator stay bounded
+            self.voter_towers = {
+                v: ent for v, ent in self.voter_towers.items()
+                if ent[1].votes and ent[1].votes[-1].slot >= rooted}
             self.metrics["roots"] += 1
             self.metrics["root_slot"] = rooted
         return slot, best

@@ -89,7 +89,16 @@ class CheckptReader:
             if len(enc) != enc_sz:
                 raise CheckptError("truncated frame")
             if style == STYLE_ZLIB:
-                data = zlib.decompress(enc)
+                # bounded inflate: cap output at raw_sz so a hostile
+                # header can't drive a multi-GiB allocation before the
+                # equality check (zlib.decompress alone is unbounded)
+                d = zlib.decompressobj()
+                try:
+                    data = d.decompress(enc, raw_sz + 1)
+                except zlib.error as e:
+                    raise CheckptError(f"frame decompress failed: {e}")
+                if d.unconsumed_tail or d.unused_data or not d.eof:
+                    raise CheckptError("frame decompress overrun")
             else:
                 data = enc
             if len(data) != raw_sz:

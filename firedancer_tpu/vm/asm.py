@@ -94,13 +94,15 @@ def asm(src: str) -> bytes:
             else:
                 out += _ins(0x62 | sz, dst=_reg(mm.group(1)),
                             off=int(mm.group(2) or 0), imm=_num(t[2]))
-        elif m in _JMP:
-            code = _JMP[m]
+        elif m in _JMP or (m.endswith("32") and m[:-2] in _JMP):
+            # jeq32/jsgt32/... compare the low 32 bits (class 0x06)
+            cls = 0x05 if m in _JMP else 0x06
+            code = _JMP[m if m in _JMP else m[:-2]]
             if t[2].startswith("r"):
-                out += _ins(0x05 | code | 0x08, dst=_reg(t[1]),
+                out += _ins(cls | code | 0x08, dst=_reg(t[1]),
                             src=_reg(t[2]), off=_num(t[3]))
             else:
-                out += _ins(0x05 | code, dst=_reg(t[1]),
+                out += _ins(cls | code, dst=_reg(t[1]),
                             imm=_num(t[2]), off=_num(t[3]))
         else:
             raise AssertionError(f"unknown mnemonic {line!r}")

@@ -228,7 +228,7 @@ class Vm:
                         raise VmFault(ERR_BAD_OP, f"op {op:#x}")
                     reg[dst] = (a & MASK64) if is64 else (a & MASK32)
 
-                elif cls == CLS_JMP:
+                elif cls in (CLS_JMP, CLS_JMP32):
                     code = op & 0xF0
                     use_reg = bool(op & 0x08)
                     if op == 0x05:        # ja
@@ -246,8 +246,17 @@ class Vm:
                         fn = self.syscalls.get(imm & MASK32)
                         if fn is None:
                             raise VmFault(ERR_SYSCALL, f"{imm:#x}")
-                        reg[0] = fn(self, reg[1], reg[2], reg[3],
-                                    reg[4], reg[5]) & MASK64
+                        try:
+                            reg[0] = fn(self, reg[1], reg[2], reg[3],
+                                        reg[4], reg[5]) & MASK64
+                        except VmFault:
+                            raise
+                        except Exception as e:
+                            # a buggy syscall must surface as a typed
+                            # fault, never escape run() as a raw
+                            # exception
+                            raise VmFault(ERR_ABORT,
+                                          f"syscall raised: {e!r}")
                         continue
                     if op == 0x8D:        # callx
                         if len(shadow) >= MAX_CALL_DEPTH - 1:
@@ -268,8 +277,12 @@ class Vm:
                         (reg[6], reg[7], reg[8], reg[9], reg[10],
                          pc) = shadow.pop()
                         continue
-                    a = reg[dst]
-                    b = reg[src] if use_reg else imm & MASK64
+                    # jmp32 (class 0x06) compares on the low 32 bits,
+                    # jmp (0x05) on the full 64 — same code points
+                    width = 64 if cls == CLS_JMP else 32
+                    wmask = MASK64 if cls == CLS_JMP else MASK32
+                    a = reg[dst] & wmask
+                    b = (reg[src] if use_reg else imm) & wmask
                     # one comparison per branch (interpreter hot loop);
                     # signed conversions only for the signed family
                     if code == 0x10:
@@ -287,8 +300,8 @@ class Vm:
                     elif code == 0x50:
                         take = a != b
                     elif code in (0x60, 0x70, 0xC0, 0xD0):
-                        sa = a - (1 << 64) if a >> 63 else a
-                        sb = b - (1 << 64) if b >> 63 else b
+                        sa = a - (1 << width) if a >> (width - 1) else a
+                        sb = b - (1 << width) if b >> (width - 1) else b
                         take = (sa > sb if code == 0x60 else
                                 sa >= sb if code == 0x70 else
                                 sa < sb if code == 0xC0 else sa <= sb)

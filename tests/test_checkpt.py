@@ -91,3 +91,27 @@ def test_checkpt_resume_execution():
         execute_block(f, None, "b2", [SystemTxn(k2, k1, 500, 0)])
         f.txn_publish("b2")
     assert cold.root_items() == funk.root_items()
+
+
+def test_zlib_bomb_is_bounded():
+    # ADVICE r3: a hostile frame header must not drive a huge
+    # decompression before the size check — inflate is capped at raw_sz
+    import struct
+    import zlib
+
+    from firedancer_tpu.utils.checkpt import MAGIC, STYLE_ZLIB
+    bomb = zlib.compress(b"\x00" * 50_000_000, 9)     # ~48 KiB encoded
+    frame = struct.pack("<BQQ", STYLE_ZLIB, 10, len(bomb)) + bomb
+    with pytest.raises(CheckptError):
+        list(CheckptReader(io.BytesIO(MAGIC + frame)).frames())
+
+
+def test_zlib_trailing_garbage_rejected():
+    import struct
+    import zlib
+
+    from firedancer_tpu.utils.checkpt import MAGIC, STYLE_ZLIB
+    body = zlib.compress(b"hello") + b"JUNK"
+    frame = struct.pack("<BQQ", STYLE_ZLIB, 5, len(body)) + body
+    with pytest.raises(CheckptError):
+        list(CheckptReader(io.BytesIO(MAGIC + frame)).frames())

@@ -146,3 +146,35 @@ def test_fork_isolation(env):
     assert db.lamports(None, k(2)) == 0
     funk.txn_publish("blk")
     assert db.lamports(None, k(2)) == 300
+
+
+def test_transfer_from_foreign_owned_account_refused(env):
+    # ADVICE r3: a signer must not drain a data-empty account that was
+    # Assigned to another program (ref Agave ExternalAccountLamportSpend)
+    funk, db, ex = env
+    funk.rec_write("blk", k(4),
+                   Account(lamports=500, owner=b"NotSystem" + bytes(23)))
+    txn = make_txn([k(1), k(4)], [k(2), SYSTEM_PROGRAM_ID],
+                   [sys_ix(3, [1, 2], SYS_TRANSFER, 100)])
+    r = ex.execute("blk", txn)
+    assert r.status == ERR_INVALID_OWNER
+    assert db.lamports("blk", k(4)) == 500
+
+
+def test_assign_requires_writable(env):
+    funk, db, ex = env
+    from firedancer_tpu.svm.programs import ERR_NOT_WRITABLE
+    funk.rec_write("blk", k(5), Account(lamports=10))
+    # signer 1 (k5) demoted to read-only via n_ro_signed=1
+    txn = make_txn([k(1), k(5)], [SYSTEM_PROGRAM_ID],
+                   [sys_ix(2, [1], SYS_ASSIGN, b"\x07" * 32)])
+    msg_ro = build_message([k(1), k(5)], [SYSTEM_PROGRAM_ID],
+                           b"\x11" * 32,
+                           [(2, bytes([1]),
+                             struct.pack("<I", SYS_ASSIGN) + b"\x07" * 32)],
+                           n_ro_signed=1)
+    r = ex.execute("blk", build_txn([bytes(64)] * 2, msg_ro))
+    assert r.status == ERR_NOT_WRITABLE
+    # writable form succeeds
+    r2 = ex.execute("blk", txn)
+    assert r2.status == OK

@@ -125,3 +125,37 @@ def test_tower_send_sign_pipeline():
         runner.halt()
         runner.close()
         rx.close()
+
+
+def test_tower_threshold_check_blocks_unconfirmed_deep_vote():
+    # ADVICE r3: per-voter towers feed the depth-8 threshold check.
+    # One lone voter (10% stake) confirms our fork; after 8 of our own
+    # votes the depth-8 vote lacks 2/3 support and voting must pause.
+    c = TowerCore(total_stake=100)
+    prev = bid(0)
+    voted = 0
+    for s in range(1, 20):
+        c.handle(pack_block(s, s - 1, bid(s), prev))
+        c.handle(pack_vote(b"w1" * 16, 10, bid(s)))
+        if c.decide() is not None:
+            voted += 1
+        prev = bid(s)
+    assert c.metrics["threshold_skips"] > 0
+    # votes pause whenever the tower is 8 deep (expiry can re-open it,
+    # so the count is < every-slot but not zero)
+    assert voted < 19
+
+
+def test_tower_threshold_check_passes_with_supermajority():
+    c = TowerCore(total_stake=100)
+    prev = bid(0)
+    voted = 0
+    for s in range(1, 20):
+        c.handle(pack_block(s, s - 1, bid(s), prev))
+        for v in range(7):               # 70% stake confirms each slot
+            c.handle(pack_vote(bytes([v + 1]) * 32, 10, bid(s)))
+        if c.decide() is not None:
+            voted += 1
+        prev = bid(s)
+    assert voted == 19
+    assert c.metrics["threshold_skips"] == 0

@@ -204,3 +204,47 @@ def test_callx():
 def test_memory_faults(prog, err):
     vm = Vm(asm(prog + "; exit"), input_data=bytes(8))
     assert vm.run().error == err
+
+
+def test_jmp32_compares_low_bits():
+    # jeq32 sees only the low 32 bits; jeq sees all 64
+    r = run("""
+        lddw r1, 0x100000007
+        mov64 r0, 0
+        jeq32 r1, 7, +1
+        exit
+        mov64 r0, 1          // taken: low word == 7
+        jeq r1, 7, +2
+        mov64 r2, 1          // not taken for 64-bit compare
+        exit
+        mov64 r0, 99
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 1
+
+
+def test_jmp32_signed():
+    # -1 (32-bit) is signed-less-than 0 under jslt32, but its zero-
+    # extended 64-bit form 0xFFFFFFFF is NOT signed-less-than 0
+    r = run("""
+        lddw r1, 0xFFFFFFFF
+        mov64 r0, 0
+        jslt r1, 0, +3
+        jslt32 r1, 0, +1
+        exit
+        mov64 r0, 1
+        exit
+        mov64 r0, 99
+        exit
+    """)
+    assert r.error == ERR_NONE and r.r0 == 1
+
+
+def test_syscall_raising_becomes_typed_fault():
+    # ADVICE r3: a buggy syscall must not escape run() as a raw
+    # exception — it converts to ERR_ABORT
+    def boom(vm, *a):
+        raise RuntimeError("bug in syscall")
+    vm = Vm(asm("call 0x99\nexit"), syscalls={0x99: boom})
+    r = vm.run()
+    assert r.error == ERR_ABORT
