@@ -34,6 +34,23 @@ def _single(d: dict, what: str, tile: str):
     return next(iter(d.values()))
 
 
+def _gather_all(ctx, seqs: dict, mtus: dict, batch: int, handle,
+                m: dict) -> int:
+    """Shared multi-in-link poll loop: gather each ring, count
+    overruns into m['overruns'], dispatch every frame to handle."""
+    total = 0
+    for ln, ring in ctx.in_rings.items():
+        if ln not in seqs:
+            continue
+        n, seqs[ln], buf, sizes, sigs, ovr = ring.gather(
+            seqs[ln], batch, mtus[ln])
+        m["overruns"] += ovr
+        for i in range(n):
+            handle(bytes(buf[i, :sizes[i]]))
+        total += n
+    return total
+
+
 def _setup_jax():
     """Per-process jax config for device-using tiles: honor the test
     harness's platform override and share the persistent compile cache."""
@@ -686,8 +703,8 @@ class ShredAdapter:
 
     METRICS = ["entries", "batches", "fec_sets", "data_shreds",
                "parity_shreds", "sent", "no_dest", "sign_fail",
-               "slots", "shreds", "fecs", "slices", "slots_done",
-               "parse_fail", "overruns"]
+               "slots", "dropped", "shreds", "fecs", "slices",
+               "slots_done", "parse_fail", "overruns"]
 
     def __init__(self, ctx, args):
         import socket
@@ -733,32 +750,35 @@ class ShredAdapter:
                 batch_fseqs=ctx.out_fseqs.get(batch_ln),
                 shred_version=int(args.get("shred_version", 0)),
                 fanout=int(args.get("fanout", 200)),
-                flush_bytes=int(args.get("flush_bytes", 31840)))
+                flush_bytes=int(args.get("flush_bytes", 31840)),
+                drop_slot_every=int(args.get("drop_slot_every", 0)))
             self._handle = self.core.on_entry
+            self.in_links = [self.in_link]
         else:
-            self.in_link = next(iter(ctx.in_rings))
+            # recover mode fans in every in link (turbine ingest +
+            # repair responses feed the same resolver)
+            self.in_links = list(ctx.in_rings)
             self.core = shredmod.ShredRecoverCore(
                 bytes.fromhex(args["leader_pubkey_hex"]),
                 _single(ctx.out_rings, "out link", ctx.tile_name),
                 _single(ctx.out_fseqs, "out link", ctx.tile_name))
             self._handle = self.core.on_shred
-        self.ring = ctx.in_rings[self.in_link]
-        self.seq = 0
-        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+        self.seqs = {ln: 0 for ln in self.in_links}
+        self.mtus = {ln: ctx.plan["links"][ln]["mtu"]
+                     for ln in self.in_links}
 
     def poll_once(self) -> int:
-        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
-            self.seq, 16, self.mtu)
-        self._ovr += ovr
-        for i in range(n):
-            self._handle(bytes(buf[i, :sizes[i]]))
+        m = {"overruns": 0}
+        n = _gather_all(self.ctx, self.seqs, self.mtus, 16,
+                        self._handle, m)
+        self._ovr += m["overruns"]
         return n
 
     def in_seqs(self):
-        seqs = {self.in_link: self.seq}
+        seqs = dict(self.seqs)
         if self.mode == "leader":
             for ln in self.ctx.in_rings:
-                if ln != self.in_link:
+                if ln not in seqs:
                     seqs[ln] = self._kg.resp_seq
         return seqs
 
@@ -827,29 +847,30 @@ class TowerAdapter:
     votes."""
 
     METRICS = ["blocks", "votes_in", "votes_out", "lockout_skips",
-               "switch_skips", "roots", "root_slot", "bad_frames",
-               "overruns"]
+               "switch_skips", "threshold_skips", "roots", "root_slot",
+               "bad_frames", "overruns"]
     GAUGES = ["root_slot"]
 
     def __init__(self, ctx, args):
         from ..tiles.tower import TowerCore
         self.ctx = ctx
         self.core = TowerCore(int(args["total_stake"]))
-        self.in_link = next(iter(ctx.in_rings))
-        self.ring = ctx.in_rings[self.in_link]
+        # fan-in: replay blocks + gossip/driver votes arrive on
+        # separate links (the reference's tower tile polls several
+        # producers the same way)
+        self.seqs = {ln: 0 for ln in ctx.in_rings}
         self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
         self.out_fseqs = _single(ctx.out_fseqs, "out link",
                                  ctx.tile_name)
-        self.seq = 0
         self._ovr = 0
-        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+        self.mtus = {ln: ctx.plan["links"][ln]["mtu"]
+                     for ln in ctx.in_rings}
 
     def poll_once(self) -> int:
-        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
-            self.seq, 32, self.mtu)
-        self._ovr += ovr
-        for i in range(n):
-            self.core.handle(bytes(buf[i, :sizes[i]]))
+        m = {"overruns": 0}
+        n = _gather_all(self.ctx, self.seqs, self.mtus, 32,
+                        self.core.handle, m)
+        self._ovr += m["overruns"]
         return n
 
     def housekeeping(self):
@@ -861,6 +882,149 @@ class TowerAdapter:
                 time.sleep(20e-6)
             self.out.publish(struct.pack("<Q", slot) + block_id,
                              sig=slot)
+
+    def in_seqs(self):
+        return dict(self.seqs)
+
+    def metrics_items(self):
+        return {**self.core.metrics, "overruns": self._ovr}
+
+
+@register("repair")
+class RepairAdapter:
+    """Repair tile (ref: src/discof/repair/fd_repair_tile.c:1-15):
+    watches the data-shred stream for gaps (forest), sends signed
+    repair requests (keyguard REPAIR role) to peers over UDP, serves
+    peers' requests from its own shred cache, and forwards repair
+    responses onto the out link toward the FEC resolver.
+
+    args: identity_hex, port (0 = ephemeral, published as metric),
+    bind_addr, peers = [{pubkey_hex, addr "host:port"}], root_slot,
+    req/resp = keyguard links; shred in link = the remaining in link;
+    out link toward the shred tile (optional for pure servers)."""
+
+    METRICS = ["shreds_seen", "reqs_sent", "sign_fail", "reqs_served",
+               "reqs_refused", "resps_in", "cache_slots", "incomplete",
+               "overruns", "port"]
+    GAUGES = ["cache_slots", "incomplete", "port"]
+
+    def __init__(self, ctx, args):
+        import socket
+
+        from ..keyguard import KeyguardClient
+        from ..tiles.repair import RepairCore
+        self.ctx = ctx
+        resp_ln = args.get("resp")
+        ins = [ln for ln in ctx.in_rings if ln != resp_ln]
+        assert len(ins) == 1, ins
+        self.in_link = ins[0]
+        self.ring = ctx.in_rings[self.in_link]
+        if resp_ln:
+            kg = KeyguardClient(ctx.out_rings[args["req"]],
+                                ctx.in_rings[resp_ln],
+                                req_fseqs=ctx.out_fseqs[args["req"]])
+            self._kg = kg
+            sign_fn = kg.sign
+        else:
+            self._kg = None
+            sign_fn = lambda payload: None        # serve-only tile
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind((args.get("bind_addr", "127.0.0.1"),
+                   int(args.get("port", 0))))
+        sock.setblocking(False)
+        self.port = sock.getsockname()[1]
+        peers = []
+        for p in args.get("peers", []):
+            host, port = p["addr"].rsplit(":", 1)
+            peers.append((bytes.fromhex(p["pubkey_hex"]),
+                          (host, int(port))))
+        outs = {ln: r for ln, r in ctx.out_rings.items()
+                if ln != args.get("req")}
+        if outs:
+            out_ring = _single(outs, "shred out link", ctx.tile_name)
+            out_ln = next(iter(outs))
+            out_fseqs = ctx.out_fseqs[out_ln]
+        else:
+            out_ring = out_fseqs = None      # serve-only tile
+        self.core = RepairCore(
+            bytes.fromhex(args["identity_hex"]), sign_fn, sock,
+            peers=peers,
+            root_slot=(int(args["root_slot"])
+                       if "root_slot" in args else None),
+            out_ring=out_ring, out_fseqs=out_fseqs)
+        self.seq = 0
+        self._ovr = 0
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, 32, self.mtu)
+        self._ovr += ovr
+        for i in range(n):
+            self.core.on_shred(bytes(buf[i, :sizes[i]]))
+        return n + self.core.poll_socket()
+
+    def housekeeping(self):
+        if self._kg is not None:
+            self.core.plan_and_send()
+
+    def in_seqs(self):
+        seqs = {self.in_link: self.seq}
+        if self._kg is not None:
+            for ln in self.ctx.in_rings:
+                if ln != self.in_link:
+                    seqs[ln] = self._kg.resp_seq
+        return seqs
+
+    def metrics_items(self):
+        return {**self.core.metrics, "overruns": self._ovr,
+                "port": self.port}
+
+
+@register("replay")
+class ReplayAdapter:
+    """Replay tile (ref: src/discof/replay/fd_replay_tile.c:77-95):
+    consumes reassembled slices, verifies PoH with the batched device
+    kernel, stages txns through the conflict DAG, executes via the SVM
+    host path, and notifies tower per completed block.
+
+    args: genesis ({pubkey_hex: lamports}), hashes_per_tick,
+    verify_poh (default true)."""
+
+    METRICS = ["slices", "slots_replayed", "entries", "txns", "exec_ok",
+               "exec_fail", "poh_fail", "buffered", "waves",
+               "parse_fail", "overruns"]
+    GAUGES = ["buffered"]
+
+    def __init__(self, ctx, args):
+        _setup_jax()
+        from ..tiles.replay import ReplayCore
+        self.ctx = ctx
+        if len(ctx.in_rings) != 1:
+            raise ValueError(
+                f"replay tile {ctx.tile_name}: exactly one in link, "
+                f"got {list(ctx.in_rings)}")
+        self.in_link = next(iter(ctx.in_rings))
+        self.ring = ctx.in_rings[self.in_link]
+        genesis = {bytes.fromhex(k): int(v)
+                   for k, v in args.get("genesis", {}).items()}
+        self.core = ReplayCore(
+            out_ring=_single(ctx.out_rings, "out link", ctx.tile_name),
+            out_fseqs=_single(ctx.out_fseqs, "out link", ctx.tile_name),
+            genesis=genesis,
+            hashes_per_tick=int(args.get("hashes_per_tick", 16)),
+            verify_poh=bool(args.get("verify_poh", True)))
+        self.seq = 0
+        self._ovr = 0
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, 8, self.mtu)
+        self._ovr += ovr
+        for i in range(n):
+            self.core.on_slice(bytes(buf[i, :sizes[i]]))
+        return n
 
     def in_seqs(self):
         return {self.in_link: self.seq}
@@ -1132,16 +1296,12 @@ class SinkAdapter:
         self.m = {k: 0 for k in self.METRICS}
 
     def poll_once(self) -> int:
-        total = 0
-        for ln, ring in self.ctx.in_rings.items():
-            n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
-                self.seqs[ln], self.batch, self.mtu)
-            self.m["overruns"] += ovr
-            if n:
-                total += n
-                self.m["rx"] += n
-                self.m["bytes"] += int(np.sum(sizes[:n]))
-        return total
+        def count(frame):
+            self.m["rx"] += 1
+            self.m["bytes"] += len(frame)
+        return _gather_all(self.ctx, self.seqs,
+                           {ln: self.mtu for ln in self.seqs},
+                           self.batch, count, self.m)
 
     def in_seqs(self):
         return dict(self.seqs)

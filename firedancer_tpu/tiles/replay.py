@@ -1,0 +1,179 @@
+"""Replay tile core: reassembled slices -> verified, executed blocks
+-> tower notifications.
+
+The reference's replay tile (ref: src/discof/replay/fd_replay_tile.c:77-95)
+consumes ordered slices from reasm, schedules their transactions
+through rdisp's conflict DAG, drives exec, and publishes block
+completion to tower. This core does the same over this framework's
+entry-batch wire (tiles/shred.py): parse entries, re-verify the PoH
+chain with the batched device kernel (ops/poh.py — the P6 mapping;
+entries of a slice verify as ONE padded batch), stage the txns into
+the ConflictDag (replay/rdisp.py), execute wave-by-wave through the
+host TxnExecutor (svm/programs.py — wave order preserves the serial
+fiction; the pure-transfer device path stays in svm/executor.py), and
+emit tower block frames keyed by the slot's final PoH hash.
+
+Out-of-order slots (repair back-fill) buffer until their parent
+replays: slices are per-slot complete, but execution must follow the
+chain, so a repaired hole releases its buffered descendants in order.
+"""
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+
+from ..funk.funk import Funk
+from ..svm.accdb import AccDb, Account
+from ..svm.programs import OK, TxnExecutor
+from ..replay.rdisp import ConflictDag
+from ..protocol.txn import parse_txn
+from .shred import parse_entry_batch, parse_slice
+from .tower import pack_block
+
+
+class ReplayCore:
+    def __init__(self, out_ring=None, out_fseqs=None,
+                 genesis: dict[bytes, int] | None = None,
+                 hashes_per_tick: int = 16, verify_poh: bool = True):
+        self.funk = Funk()
+        self.db = AccDb(self.funk)
+        for key, bal in (genesis or {}).items():
+            self.funk.rec_write(None, key,
+                                Account(lamports=int(bal)))
+        self.executor = TxnExecutor(self.db)
+        self.out_ring = out_ring
+        self.out_fseqs = out_fseqs
+        self.hashes_per_tick = hashes_per_tick
+        self.verify_poh = verify_poh
+        self.next_slot: int | None = None     # next slot to execute
+        self.pending: dict[int, bytes] = {}   # completed, not yet run
+        self.hash_of: dict[int, bytes] = {}   # slot -> final PoH hash
+        self.anchored = False                 # saw a full prior slot
+        self.metrics = {"slices": 0, "slots_replayed": 0, "entries": 0,
+                        "txns": 0, "exec_ok": 0, "exec_fail": 0,
+                        "poh_fail": 0, "buffered": 0, "waves": 0,
+                        "parse_fail": 0}
+
+    # -- slice ingest -------------------------------------------------------
+
+    def on_slice(self, frame: bytes) -> int:
+        slot, first, done, payload = parse_slice(frame)
+        self.metrics["slices"] += 1
+        if not done:
+            # multi-slice slots: accumulate (first_fec_idx orders them)
+            self.pending[slot] = self.pending.get(slot, b"") + payload
+            return 0
+        self.pending[slot] = self.pending.get(slot, b"") + payload
+        if self.next_slot is None:
+            self.next_slot = slot
+        ran = 0
+        # release the contiguous chain from next_slot
+        while self.next_slot in self.pending:
+            self._replay_slot(self.next_slot,
+                              self.pending.pop(self.next_slot))
+            self.next_slot += 1
+            ran += 1
+        # slots older than the anchor (late repairs racing the anchor)
+        # will never execute — drop them so pending stays bounded
+        self.pending = {s: b for s, b in self.pending.items()
+                        if s >= self.next_slot}
+        self.metrics["buffered"] = len(self.pending)
+        return ran
+
+    # -- per-slot replay ----------------------------------------------------
+
+    def _replay_slot(self, slot: int, batch: bytes):
+        entries = parse_entry_batch(batch)
+        self.metrics["entries"] += len(entries)
+        prev = self.hash_of.get(slot - 1)
+        if prev is not None and entries and self.verify_poh:
+            if not self._verify_entries(prev, entries):
+                self.metrics["poh_fail"] += 1
+        txns = [t for _, _, ts in entries for t in ts]
+        self._execute(slot, txns)
+        tip = entries[-1][1] if entries else (prev or bytes(32))
+        self.hash_of[slot] = tip
+        parent_id = self.hash_of.get(slot - 1) or \
+            hashlib.sha256(b"fdtpu-parent" + (slot - 1).to_bytes(
+                8, "little", signed=True)).digest()
+        self.hash_of.setdefault(slot - 1, parent_id)
+        if self.out_ring is not None:
+            import time
+            while self.out_fseqs and \
+                    self.out_ring.credits(self.out_fseqs) <= 0:
+                time.sleep(20e-6)
+            # slot 0 has no parent; tower drops the degenerate frame
+            # (its tree anchors at the first real parent link anyway)
+            self.out_ring.publish(
+                pack_block(slot, max(0, slot - 1), tip, parent_id),
+                sig=slot)
+        self.metrics["slots_replayed"] += 1
+        # prune old hashes (tower roots upstream; keep a window)
+        if len(self.hash_of) > 1024:
+            cut = slot - 512
+            self.hash_of = {s: h for s, h in self.hash_of.items()
+                            if s >= cut}
+
+    def _verify_entries(self, prev: bytes, entries) -> bool:
+        """Batched device verification of a slice's PoH chain
+        (ops/poh.poh_verify_entries): chain continuity is host-checked
+        by construction (prev_i = hash_{i-1}), the hash work runs as
+        one padded batch on the accelerator."""
+        from ..ops.poh import poh_verify_entries
+        prevs, nums, mixes, has, exps = [], [], [], [], []
+        state = prev
+        for num_hashes, h, ts in entries:
+            mixin = hashlib.sha256(
+                b"".join(t[1:65] for t in ts)).digest()
+            prevs.append(np.frombuffer(state, np.uint8))
+            nums.append(min(num_hashes, self.hashes_per_tick))
+            mixes.append(np.frombuffer(mixin, np.uint8))
+            has.append(bool(ts))
+            exps.append(np.frombuffer(h, np.uint8))
+            state = h
+        ok = np.asarray(poh_verify_entries(
+            np.stack(prevs), np.asarray(nums, np.int32),
+            np.stack(mixes), np.asarray(has), np.stack(exps),
+            max_hashes=self.hashes_per_tick))
+        return bool(ok.all())
+
+    def _execute(self, slot: int, txns: list[bytes]):
+        """Stage the slot's txns into the conflict DAG and execute in
+        wave order (any wave-internal order preserves the serial
+        fiction; rdisp.waves() is the device-dispatch shape)."""
+        if not txns:
+            return
+        dag = ConflictDag()
+        parsed = []
+        for t in txns:
+            try:
+                p = parse_txn(t)
+            except Exception:
+                self.metrics["parse_fail"] += 1
+                parsed.append(None)
+                dag.add_txn((), ())
+                continue
+            keys = p.account_keys(t)
+            writes = [keys[i] for i in range(p.acct_cnt)
+                      if p.is_writable(i)]
+            reads = [keys[i] for i in range(p.acct_cnt)
+                     if not p.is_writable(i)]
+            parsed.append(p)
+            dag.add_txn(writes, reads)
+        xid = ("replay", slot)
+        self.funk.txn_prepare(None, xid)
+        waves = dag.waves()
+        self.metrics["waves"] += len(waves)
+        for wave in waves:
+            for i in wave:
+                if parsed[i] is None:
+                    continue
+                r = self.executor.execute(xid, txns[i])
+                self.metrics["txns"] += 1
+                if r.status == OK:
+                    self.metrics["exec_ok"] += 1
+                else:
+                    self.metrics["exec_fail"] += 1
+        self.funk.txn_publish(xid)

@@ -78,11 +78,15 @@ class ShredLeaderCore:
                  out_ring=None, out_fseqs=None,
                  shred_version: int = 0, fanout: int = 200,
                  flush_bytes: int = 31840, batch_out=None,
-                 batch_fseqs=None):
+                 batch_fseqs=None, drop_slot_every: int = 0):
         """cluster: [ClusterNode]; sock: bound UDP socket for egress.
         batch_out: optional ring that mirrors every flushed entry batch
         (u64 slot | u8 block_complete | bytes) — the byte-identity
-        witness the two-topology test compares against."""
+        witness the two-topology test compares against.
+        drop_slot_every: fault-injection seam (test-only): every Nth
+        slot's shreds are withheld from turbine (still mirrored on
+        out_ring), simulating total network loss of a block so the
+        repair path must recover it."""
         self.shredder = Shredder(sign_fn, shred_version=shred_version)
         self.identity = identity
         self.dest = ShredDest(cluster, identity, fanout=fanout)
@@ -92,13 +96,14 @@ class ShredLeaderCore:
         self.batch_out = batch_out
         self.batch_fseqs = batch_fseqs
         self.flush_bytes = flush_bytes
+        self.drop_slot_every = drop_slot_every
         self.cur_slot = None
         self.cur_tick = 0
         self.buf = bytearray()
         self.metrics = {"entries": 0, "batches": 0, "fec_sets": 0,
                         "data_shreds": 0, "parity_shreds": 0,
                         "sent": 0, "no_dest": 0, "sign_fail": 0,
-                        "slots": 0}
+                        "slots": 0, "dropped": 0}
 
     def on_entry(self, frame: bytes) -> int:
         """One poh entry frame; returns shreds transmitted."""
@@ -163,7 +168,11 @@ class ShredLeaderCore:
         node = self.dest.first_hop(slot, idx, 1 if is_data else 0,
                                    self.identity)
         n = 0
-        if node is not None and node.addr[1]:
+        dropped = self.drop_slot_every \
+            and slot % self.drop_slot_every == self.drop_slot_every - 1
+        if dropped:
+            self.metrics["dropped"] += 1
+        elif node is not None and node.addr[1]:
             self.sock.sendto(wire, node.addr)
             self.metrics["sent"] += 1
             n = 1
