@@ -1,0 +1,62 @@
+"""Epoch stakes: aggregate delegations -> consensus weights.
+
+The reference computes per-epoch stake weights from the stake
+delegations the runtime landed, keyed to vote accounts, then to node
+identities for the leader schedule and turbine tree
+(ref: src/flamenco/runtime/sysvar/fd_sysvar_stake_history.c usage in
+fd_stakes.c — refresh_vote_accounts / stake delegations iteration;
+leader schedule input src/flamenco/leaders/fd_leaders.c:112).
+
+This module walks the funk fork (overlay scan: nearest-ancestor record
+wins, same visibility rule as funk.rec_query), filters stake-program
+accounts, applies the epoch activation window (svm/stake.py
+StakeState.active_at), and returns:
+
+  vote_stakes(...)  vote-account pubkey -> active stake
+  node_stakes(...)  node identity      -> active stake (via the vote
+                    account's node_pubkey)
+
+Feed node_stakes into EpochLeaders (leader schedule), ShredDest
+(turbine weights), and the tower's total_stake — one stake source for
+all three, the way the reference plumbs epoch stakes everywhere.
+"""
+from __future__ import annotations
+
+from ..svm.accdb import Account
+from ..svm.stake import STAKE_PROGRAM_ID, StakeState
+from ..svm.vote import VOTE_PROGRAM_ID, VoteState, _HDR_SZ
+
+
+def vote_stakes(funk, xid, epoch: int) -> dict[bytes, int]:
+    out: dict[bytes, int] = {}
+    for key, acct in funk.items_at(xid).items():
+        if not isinstance(acct, Account) \
+                or acct.owner != STAKE_PROGRAM_ID:
+            continue
+        try:
+            st = StakeState.from_bytes(acct.data)
+        except Exception:
+            continue
+        amt = st.active_at(epoch)
+        if amt > 0:
+            out[st.voter] = out.get(st.voter, 0) + amt
+    return out
+
+
+def node_stakes(funk, xid, epoch: int) -> dict[bytes, int]:
+    """Active stake per node identity: stake -> vote account ->
+    node_pubkey (zero for vote accounts that don't resolve)."""
+    per_vote = vote_stakes(funk, xid, epoch)
+    out: dict[bytes, int] = {}
+    for vote_key, amt in per_vote.items():
+        va = funk.rec_query(xid, vote_key)
+        if not isinstance(va, Account) or va.owner != VOTE_PROGRAM_ID \
+                or len(va.data) < _HDR_SZ:
+            continue
+        node = VoteState.from_bytes(va.data).node_pubkey
+        out[node] = out.get(node, 0) + amt
+    return out
+
+
+def total_stake(funk, xid, epoch: int) -> int:
+    return sum(vote_stakes(funk, xid, epoch).values())

@@ -182,3 +182,22 @@ class Funk:
 
     def root_items(self):
         return dict(self._root)
+
+    def items_at(self, xid) -> dict:
+        """All records visible at xid: the same fork-overlay visibility
+        rule as rec_query, folded over the whole keyspace (nearest
+        ancestor wins, tombstones hide). The stake-aggregation /
+        snapshot scan entrypoint."""
+        out = dict(self._root)
+        chain = []
+        t = self._txns.get(xid) if xid is not None else None
+        while t is not None:
+            chain.append(t)
+            t = t.parent
+        for t in reversed(chain):        # oldest ancestor first
+            for k, v in t.recs.items():
+                if v is _TOMBSTONE:
+                    out.pop(k, None)
+                else:
+                    out[k] = v
+        return out

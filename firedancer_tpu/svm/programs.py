@@ -1,4 +1,4 @@
-"""Host-side transaction executor + native system program.
+"""Host-side transaction executor + native system program + CPI.
 
 The reference's per-txn execution (load accounts, charge fees, dispatch
 instructions sequentially through native program handlers, commit or
@@ -10,15 +10,23 @@ the general host path the exec tiles run for everything else — the
 split SURVEY §7 hard-part 6 prescribes (sBPF and general dispatch stay
 on host cores).
 
+Instructions execute through an InstrCtx: a local-index account view
+carrying THIS invocation's privileges. The top-level view derives
+signer/writable from the transaction message; a CPI view derives them
+from the caller-validated account metas (ref: the instruction context
+stack of fd_executor.c / fd_exec_instr_ctx.h — privileges never
+escalate down the stack except through verified PDA seed signing,
+fd_vm_syscall_cpi.c).
+
 Semantics mirrored from the reference per instruction:
-  Transfer        from must SIGN and be system-owned with no data;
+  Transfer        from must SIGN, be writable, system-owned, no data;
                   insufficient lamports aborts the txn
                   (fd_system_program.c:59-137)
   CreateAccount   to must SIGN, be empty (0 lamports, no data, system
                   owner); allocate+assign+fund (:254-330)
-  Assign          account must SIGN, be system-owned (:202-230)
-  Allocate        account must SIGN, be system-owned, data empty;
-                  space <= MAX_PERMITTED_DATA_LENGTH (:143-200)
+  Assign          account must SIGN, be writable, system-owned (:202-230)
+  Allocate        account must SIGN, be writable, system-owned, data
+                  empty; space <= MAX_PERMITTED_DATA_LENGTH (:143-200)
 
 A failing instruction rolls the whole transaction back; the fee is
 charged to the payer regardless (the reference commits fees before
@@ -27,6 +35,7 @@ rollback is just dropping them (accdb.close_rw(discard=True)).
 """
 from __future__ import annotations
 
+import hashlib
 import struct
 from dataclasses import dataclass
 
@@ -36,6 +45,9 @@ from .accdb import AccDb, Account, SYSTEM_PROGRAM_ID
 COMPUTE_BUDGET_PROGRAM_ID = b"ComputeBudget" + bytes(19)
 BPF_LOADER_ID = b"BPFLoader" + bytes(23)
 MAX_PERMITTED_DATA_LENGTH = 10 * 1024 * 1024
+MAX_CPI_DEPTH = 4                  # instruction stack height limit
+MAX_SEEDS = 16                     # PDA seed count limit (Solana)
+MAX_SEED_LEN = 32
 
 # system instruction discriminants (u32 LE bincode)
 SYS_CREATE_ACCOUNT = 0
@@ -59,6 +71,7 @@ ERR_UNKNOWN_PROGRAM = "unknown_program"
 ERR_BAD_IX_DATA = "bad_instruction_data"
 ERR_VM = "program_failed"
 ERR_BALANCE_VIOLATION = "sum_of_lamports_changed"
+ERR_CPI = "cpi_violation"
 
 
 @dataclass
@@ -71,14 +84,17 @@ class TxnResult:
 class TxnContext:
     """Per-txn view: copy-on-write accounts over one accdb fork."""
 
-    def __init__(self, db: AccDb, xid, txn: ParsedTxn, payload: bytes):
+    def __init__(self, db: AccDb, xid, txn: ParsedTxn, payload: bytes,
+                 epoch: int = 0):
         self.db = db
         self.xid = xid
         self.txn = txn
         self.payload = payload
+        self.epoch = epoch            # Clock-sysvar stand-in
         self.keys = txn.account_keys(payload)
         self._work: dict[bytes, Account] = {}
         self.logs: list[str] = []
+        self.last_exec_cu = 0        # CU used by the last BPF frame
 
     def is_signer(self, idx: int) -> bool:
         return idx < self.txn.sig_cnt
@@ -100,27 +116,71 @@ class TxnContext:
             self.db.funk.rec_write(self.xid, k, a)
 
 
+class InstrCtx:
+    """One instruction invocation: local account indices + privileges.
+
+    privileges=None -> top-level (txn-message signer/writable bits);
+    privileges=[(signer, writable)] -> a CPI frame with the flags the
+    caller requested AND the runtime validated."""
+
+    def __init__(self, ctx: TxnContext, program_id: bytes,
+                 acct_idxs, data: bytes, privileges=None):
+        self.ctx = ctx
+        self.program_id = program_id
+        self.acct_idxs = list(acct_idxs)
+        self.data = data
+        self.priv = privileges
+
+    @property
+    def n(self) -> int:
+        return len(self.acct_idxs)
+
+    def key(self, i: int) -> bytes:
+        return self.ctx.keys[self.acct_idxs[i]]
+
+    def account(self, i: int) -> Account:
+        return self.ctx.account(self.acct_idxs[i])
+
+    def is_signer(self, i: int) -> bool:
+        if self.priv is not None:
+            return self.priv[i][0]
+        return self.ctx.is_signer(self.acct_idxs[i])
+
+    def is_writable(self, i: int) -> bool:
+        if self.priv is not None:
+            return self.priv[i][1]
+        return self.ctx.is_writable(self.acct_idxs[i])
+
+    def signer_keys(self) -> set:
+        if self.priv is not None:
+            return {self.key(i) for i in range(self.n)
+                    if self.priv[i][0]}
+        return {self.ctx.keys[i] for i in range(self.ctx.txn.sig_cnt)}
+
+    @property
+    def logs(self):
+        return self.ctx.logs
+
+
 def _u64(data: bytes, off: int) -> int:
     return struct.unpack_from("<Q", data, off)[0]
 
 
-def _exec_system(ctx: TxnContext, instr) -> str:
-    data = ctx.payload[instr.data_off:instr.data_off + instr.data_sz]
+def _exec_system(ic: InstrCtx) -> str:
+    data = ic.data
     if len(data) < 4:
         return ERR_BAD_IX_DATA
     disc = struct.unpack_from("<I", data, 0)[0]
-    ai = instr.acct_idxs
 
     if disc == SYS_TRANSFER:
-        if len(data) < 12 or len(ai) < 2:
+        if len(data) < 12 or ic.n < 2:
             return ERR_BAD_IX_DATA
         amount = _u64(data, 4)
-        f, t = ai[0], ai[1]
-        if not ctx.is_signer(f):
+        if not ic.is_signer(0):
             return ERR_MISSING_SIG
-        if not ctx.is_writable(f) or not ctx.is_writable(t):
+        if not ic.is_writable(0) or not ic.is_writable(1):
             return ERR_NOT_WRITABLE
-        src = ctx.account(f)
+        src = ic.account(0)
         if src.owner != SYSTEM_PROGRAM_ID:
             # the system program may only debit accounts it owns — a
             # signer must not drain an account previously Assigned to
@@ -130,32 +190,31 @@ def _exec_system(ctx: TxnContext, instr) -> str:
         if src.data:
             return ERR_HAS_DATA          # transfer-from must hold no data
         if amount > src.lamports:
-            ctx.logs.append(
+            ic.logs.append(
                 f"Transfer: insufficient lamports {src.lamports}, "
                 f"need {amount}")
             return ERR_INSUFFICIENT
         src.lamports -= amount
-        ctx.account(t).lamports += amount
+        ic.account(1).lamports += amount
         return OK
 
     if disc == SYS_CREATE_ACCOUNT:
-        if len(data) < 4 + 8 + 8 + 32 or len(ai) < 2:
+        if len(data) < 4 + 8 + 8 + 32 or ic.n < 2:
             return ERR_BAD_IX_DATA
         lamports = _u64(data, 4)
         space = _u64(data, 12)
         owner = data[20:52]
-        f, t = ai[0], ai[1]
-        if not ctx.is_signer(f) or not ctx.is_signer(t):
+        if not ic.is_signer(0) or not ic.is_signer(1):
             return ERR_MISSING_SIG
-        if not ctx.is_writable(f) or not ctx.is_writable(t):
+        if not ic.is_writable(0) or not ic.is_writable(1):
             return ERR_NOT_WRITABLE
-        to = ctx.account(t)
+        to = ic.account(1)
         if to.lamports or to.data or to.owner != SYSTEM_PROGRAM_ID:
-            ctx.logs.append("Create Account: account already in use")
+            ic.logs.append("Create Account: account already in use")
             return ERR_ALREADY_IN_USE
         if space > MAX_PERMITTED_DATA_LENGTH:
             return ERR_SPACE
-        src = ctx.account(f)
+        src = ic.account(0)
         if lamports > src.lamports:
             return ERR_INSUFFICIENT
         to.data = bytes(space)
@@ -165,29 +224,27 @@ def _exec_system(ctx: TxnContext, instr) -> str:
         return OK
 
     if disc == SYS_ASSIGN:
-        if len(data) < 36 or len(ai) < 1:
+        if len(data) < 36 or ic.n < 1:
             return ERR_BAD_IX_DATA
-        a = ai[0]
-        if not ctx.is_signer(a):
+        if not ic.is_signer(0):
             return ERR_MISSING_SIG
-        if not ctx.is_writable(a):
+        if not ic.is_writable(0):
             return ERR_NOT_WRITABLE
-        acct = ctx.account(a)
+        acct = ic.account(0)
         if acct.owner != SYSTEM_PROGRAM_ID:
             return ERR_INVALID_OWNER
         acct.owner = data[4:36]
         return OK
 
     if disc == SYS_ALLOCATE:
-        if len(data) < 12 or len(ai) < 1:
+        if len(data) < 12 or ic.n < 1:
             return ERR_BAD_IX_DATA
         space = _u64(data, 4)
-        a = ai[0]
-        if not ctx.is_signer(a):
+        if not ic.is_signer(0):
             return ERR_MISSING_SIG
-        if not ctx.is_writable(a):
+        if not ic.is_writable(0):
             return ERR_NOT_WRITABLE
-        acct = ctx.account(a)
+        acct = ic.account(0)
         if acct.owner != SYSTEM_PROGRAM_ID:
             return ERR_INVALID_OWNER
         if acct.data:
@@ -200,14 +257,279 @@ def _exec_system(ctx: TxnContext, instr) -> str:
     return ERR_UNKNOWN_IX
 
 
-def _exec_bpf(ctx: TxnContext, instr, program: Account) -> str:
-    """Run a deployed sBPF program (executable account owned by the
-    loader) in the VM (ref: fd_executor -> fd_vm_exec; serialization
-    per the input-region discipline of src/flamenco/vm/fd_vm.h input
-    regions, compact layout documented in vm/interp.py).
+# ---------------------------------------------------------------------------
+# program-derived addresses (ref: fd_vm_syscall_pda.c / Agave
+# Pubkey::create_program_address)
+# ---------------------------------------------------------------------------
 
-    Input layout: u16 n_accounts | n × (pubkey 32 | lamports u64 |
-    is_signer u8 | is_writable u8) | u16 data_len | instruction data.
+PDA_MARKER = b"ProgramDerivedAddress"
+
+
+def create_program_address(seeds: list[bytes],
+                           program_id: bytes) -> bytes | None:
+    """sha256(seeds .. program_id .. marker); None if the result lies
+    ON the ed25519 curve (a PDA must have no private key)."""
+    from ..utils.ed25519_ref import pt_decompress
+    if len(seeds) > MAX_SEEDS or any(len(s) > MAX_SEED_LEN
+                                     for s in seeds):
+        return None
+    h = hashlib.sha256()
+    for s in seeds:
+        h.update(s)
+    h.update(program_id)
+    h.update(PDA_MARKER)
+    addr = h.digest()
+    if pt_decompress(addr) is not None:
+        return None                   # on-curve: invalid PDA
+    return addr
+
+
+def find_program_address(seeds: list[bytes],
+                         program_id: bytes) -> tuple[bytes, int]:
+    for bump in range(255, -1, -1):
+        addr = create_program_address(seeds + [bytes([bump])],
+                                      program_id)
+        if addr is not None:
+            return addr, bump
+    raise ValueError("no viable bump seed")
+
+
+# ---------------------------------------------------------------------------
+# sBPF execution + CPI
+# ---------------------------------------------------------------------------
+
+def _build_input(ic: InstrCtx) -> tuple[bytes, list[int]]:
+    """Compact input layout (raw-text fixture programs): u16 n_accounts
+    | n x (pubkey 32 | lamports u64 | is_signer u8 | is_writable u8) |
+    u16 data_len | instruction data (the input-region discipline of
+    src/flamenco/vm/fd_vm.h, compact layout documented in
+    vm/interp.py). Returns (blob, per-account lamports offsets)."""
+    blob = struct.pack("<H", ic.n)
+    offs = []
+    for i in range(ic.n):
+        offs.append(len(blob) + 32)
+        blob += (ic.key(i)
+                 + struct.pack("<Q", ic.account(i).lamports)
+                 + bytes([1 if ic.is_signer(i) else 0,
+                          1 if ic.is_writable(i) else 0]))
+    blob += struct.pack("<H", len(ic.data)) + ic.data
+    return blob, offs
+
+
+MAX_PERMITTED_DATA_INCREASE = 10 * 1024
+
+
+def _build_input_solana(ic: InstrCtx) -> tuple[bytes, list[int]]:
+    """The real Solana aligned input serialization, for ELF programs
+    built with the SDK entrypoint (ref: the reference's account
+    serialization into the VM input region, src/flamenco/runtime/
+    fd_runtime serialize + Agave serialize_parameters_aligned):
+
+      u64 n | per account: u8 dup(0xff) | u8 signer | u8 writable |
+      u8 executable | 4B pad | pubkey 32 | owner 32 | u64 lamports |
+      u64 data_len | data | 10KiB spare | pad to 8 | u64 rent_epoch
+      | u64 instr data len | instr data | program_id 32
+
+    Duplicate account entries serialize as u8 index + 7B pad."""
+    blob = bytearray(struct.pack("<Q", ic.n))
+    offs: list[int] = []
+    seen: dict[bytes, int] = {}
+    for i in range(ic.n):
+        key = ic.key(i)
+        if key in seen:
+            offs.append(offs[seen[key]])
+            blob += bytes([seen[key]]) + bytes(7)
+            continue
+        seen[key] = i
+        a = ic.account(i)
+        blob += bytes([0xFF, 1 if ic.is_signer(i) else 0,
+                       1 if ic.is_writable(i) else 0,
+                       1 if a.executable else 0]) + bytes(4)
+        blob += key + a.owner
+        offs.append(len(blob))
+        blob += struct.pack("<QQ", a.lamports, len(a.data))
+        blob += a.data
+        blob += bytes(MAX_PERMITTED_DATA_INCREASE)
+        pad = (-len(blob)) % 8
+        blob += bytes(pad)
+        blob += struct.pack("<Q", a.rent_epoch)
+    blob += struct.pack("<Q", len(ic.data)) + ic.data
+    blob += ic.program_id
+    return bytes(blob), offs
+
+
+def _refresh_input_lamports(vm, ic: InstrCtx):
+    """Rewrite the VM input region's lamport slots from the current
+    account state (after a CPI mutated them). NOTE a documented
+    divergence from the reference: direct lamport stores made by the
+    caller BEFORE a CPI are overwritten by this refresh — combine
+    direct writes with CPI by re-applying them after the call."""
+    for i, off in enumerate(vm._lam_offsets):
+        vm.mem_write(0x4_0000_0000 + off,
+                     struct.pack("<Q", ic.account(i).lamports))
+
+
+def _parse_cpi_instruction(vm, vaddr):
+    """Our compact CPI ABI (documented; the reference marshals the
+    Rust/C AccountInfo layouts, fd_vm_syscall_cpi.c — same contract,
+    different wire): program_id 32 | u16 n | n x (pubkey 32 |
+    u8 signer | u8 writable) | u16 dlen | data."""
+    program_id = vm.mem_read(vaddr, 32)
+    n, = struct.unpack("<H", vm.mem_read(vaddr + 32, 2))
+    if n > 64:
+        raise ValueError("too many CPI accounts")
+    metas = []
+    off = vaddr + 34
+    for _ in range(n):
+        pk = vm.mem_read(off, 32)
+        flags = vm.mem_read(off + 32, 2)
+        metas.append((pk, bool(flags[0]), bool(flags[1])))
+        off += 34
+    dlen, = struct.unpack("<H", vm.mem_read(off, 2))
+    data = vm.mem_read(off + 2, dlen)
+    return program_id, metas, data
+
+
+def _parse_signer_seeds(vm, vaddr):
+    """u8 n_signers | per signer: u8 n_seeds | n x (u8 len | bytes)."""
+    if not vaddr:
+        return []
+    n_signers = vm.mem_read(vaddr, 1)[0]
+    if n_signers > MAX_SEEDS:
+        raise ValueError("too many CPI signers")
+    out = []
+    off = vaddr + 1
+    for _ in range(n_signers):
+        n_seeds = vm.mem_read(off, 1)[0]
+        off += 1
+        if n_seeds > MAX_SEEDS:
+            raise ValueError("too many seeds")
+        seeds = []
+        for _ in range(n_seeds):
+            ln = vm.mem_read(off, 1)[0]
+            seeds.append(vm.mem_read(off + 1, ln))
+            off += 1 + ln
+        out.append(seeds)
+    return out
+
+
+def _make_cpi_syscalls(ctx: TxnContext, ic: InstrCtx, depth: int):
+    """Bind invoke_signed + PDA syscalls to this instruction frame
+    (ref: src/flamenco/vm/syscall/fd_vm_syscall_cpi.c:1-40,
+    fd_vm_syscall_pda.c)."""
+    from ..vm.interp import ERR_ABORT, VmFault
+    from ..vm.syscalls import CU_SYSCALL_BASE
+
+    def sys_invoke_signed(vm, r1, r2, r3, r4, r5):
+        vm.charge(CU_SYSCALL_BASE * 10)
+        if depth + 1 >= MAX_CPI_DEPTH:
+            raise VmFault(ERR_ABORT, "max CPI depth")
+        # the invocation stack shares ONE budget: the child runs on the
+        # caller's remaining CU and its usage is charged back (the
+        # reference's shared compute meter)
+        remaining = vm.compute_budget - vm._cu
+        try:
+            program_id, metas, data = _parse_cpi_instruction(vm, r1)
+            signer_seeds = _parse_signer_seeds(vm, r2)
+        except Exception as e:
+            raise VmFault(ERR_ABORT, f"bad CPI instruction: {e}")
+        pda_signers = set()
+        for seeds in signer_seeds:
+            addr = create_program_address(list(seeds), ic.program_id)
+            if addr is None:
+                raise VmFault(ERR_ABORT, "invalid PDA seeds")
+            pda_signers.add(addr)
+        # accounts must already be in the txn, and privileges must not
+        # escalate beyond the caller's view (PDA seeds excepted)
+        outer = {ic.key(i): i for i in range(ic.n)}
+        idxs, privs = [], []
+        for pk, want_sign, want_write in metas:
+            oi = outer.get(pk)
+            if oi is None:
+                raise VmFault(ERR_ABORT,
+                              "CPI account not in caller accounts")
+            if want_sign and not ic.is_signer(oi) \
+                    and pk not in pda_signers:
+                raise VmFault(ERR_ABORT, "CPI signer escalation")
+            if want_write and not ic.is_writable(oi):
+                raise VmFault(ERR_ABORT, "CPI writable escalation")
+            idxs.append(ic.acct_idxs[oi])
+            privs.append((want_sign, want_write))
+        child = InstrCtx(ctx, bytes(program_id), idxs, bytes(data),
+                         privileges=privs)
+        ctx.last_exec_cu = 0
+        st = dispatch_instr(ctx, child, depth + 1, budget=remaining)
+        if st != OK:
+            raise VmFault(ERR_ABORT, f"CPI failed: {st}")
+        vm.charge(ctx.last_exec_cu)
+        _refresh_input_lamports(vm, ic)
+        return 0
+
+    def sys_create_pda(vm, r1, r2, r3, r4, r5):
+        vm.charge(CU_SYSCALL_BASE * 15)
+        if r2 > MAX_SEEDS:
+            return 1                  # MaxSeedLengthExceeded, not trunc
+        seeds = [vm.mem_read(vm.read_u(r1 + 16 * i, 8),
+                             vm.read_u(r1 + 16 * i + 8, 8))
+                 for i in range(r2)]
+        program_id = vm.mem_read(r3, 32)
+        addr = create_program_address(seeds, program_id)
+        if addr is None:
+            return 1
+        vm.mem_write(r4, addr)
+        return 0
+
+    def sys_find_pda(vm, r1, r2, r3, r4, r5):
+        vm.charge(CU_SYSCALL_BASE * 15)
+        if r2 > MAX_SEEDS:
+            return 1
+        seeds = [vm.mem_read(vm.read_u(r1 + 16 * i, 8),
+                             vm.read_u(r1 + 16 * i + 8, 8))
+                 for i in range(r2)]
+        program_id = vm.mem_read(r3, 32)
+        try:
+            addr, bump = find_program_address(seeds, program_id)
+        except ValueError:
+            return 1
+        vm.mem_write(r4, addr)
+        vm.mem_write(r5, bytes([bump]))
+        return 0
+
+    from ..vm.syscalls import syscall_id
+    return {
+        syscall_id(b"sol_invoke_signed_c"): sys_invoke_signed,
+        syscall_id(b"sol_invoke_signed_rust"): sys_invoke_signed,
+        syscall_id(b"sol_create_program_address"): sys_create_pda,
+        syscall_id(b"sol_try_find_program_address"): sys_find_pda,
+    }
+
+
+_PROG_CACHE: dict[bytes, "object"] = {}     # sha256(elf) -> SbpfProgram
+_PROG_CACHE_MAX = 64
+
+
+def _load_elf_cached(data: bytes):
+    """Loaded-program cache (the reference's progcache role): keyed by
+    content hash so redeployments miss cleanly; bounded FIFO."""
+    from ..vm import elf
+    key = hashlib.sha256(data).digest()
+    prog = _PROG_CACHE.get(key)
+    if prog is None:
+        prog = elf.load(data)
+        while len(_PROG_CACHE) >= _PROG_CACHE_MAX:
+            _PROG_CACHE.pop(next(iter(_PROG_CACHE)))
+        _PROG_CACHE[key] = prog
+    return prog
+
+
+def _exec_bpf(ctx: TxnContext, ic: InstrCtx, program: Account,
+              depth: int = 0, budget: int | None = None) -> str:
+    """Run a deployed sBPF program (executable account owned by the
+    loader) in the VM. ELF-packaged programs (magic 0x7f 'ELF') go
+    through the loader (vm/elf.py — parse, relocate, call registry,
+    ref src/ballet/sbpf/fd_sbpf_loader.h:1-12); raw text sections
+    execute directly (the pre-ELF deployment path, kept for fixtures).
+
     After a successful run, lamports of WRITABLE accounts are read back
     under two runtime rules: sum-of-lamports conservation (never mint
     or burn), and the OWNERSHIP rule — only the executing program may
@@ -215,18 +537,30 @@ def _exec_bpf(ctx: TxnContext, instr, program: Account) -> str:
     (credits are unrestricted), mirroring the reference runtime's
     account-modification checks."""
     from ..vm import DEFAULT_SYSCALLS, ERR_NONE as VM_OK, Vm
-    accts = [ctx.account(i) for i in instr.acct_idxs]
-    program_id = ctx.keys[instr.prog_idx]
-    data = ctx.payload[instr.data_off:instr.data_off + instr.data_sz]
-    blob = struct.pack("<H", len(accts))
-    for ix, a in zip(instr.acct_idxs, accts):
-        blob += (ctx.keys[ix] + struct.pack("<Q", a.lamports)
-                 + bytes([1 if ctx.is_signer(ix) else 0,
-                          1 if ctx.is_writable(ix) else 0]))
-    blob += struct.pack("<H", len(data)) + data
-    vm = Vm(program.data, input_data=blob, syscalls=DEFAULT_SYSCALLS)
-    res = vm.run()
+    syscalls = dict(DEFAULT_SYSCALLS)
+    syscalls.update(_make_cpi_syscalls(ctx, ic, depth))
+    kw = {} if budget is None else {"compute_budget": budget}
+    if program.data[:4] == b"\x7fELF":
+        from ..vm import elf
+        try:
+            prog = _load_elf_cached(program.data)
+        except elf.ElfError as e:
+            ctx.logs.append(f"ELF load failed: {e}")
+            return ERR_VM
+        # SDK-built programs deserialize the REAL Solana input ABI
+        blob, lam_offs = _build_input_solana(ic)
+        vm = Vm(prog.text, input_data=blob, syscalls=syscalls,
+                image=prog.image, text_off=prog.text_off,
+                calls=prog.calls, **kw)
+        vm._lam_offsets = lam_offs
+        res = vm.run(entry_pc=prog.entry_pc)
+    else:
+        blob, lam_offs = _build_input(ic)
+        vm = Vm(program.data, input_data=blob, syscalls=syscalls, **kw)
+        vm._lam_offsets = lam_offs
+        res = vm.run()
     ctx.logs.extend(res.log)
+    ctx.last_exec_cu = res.compute_used
     if res.error != VM_OK or res.r0 != 0:
         return ERR_VM
     # lamports write-back with conservation over UNIQUE accounts: an
@@ -235,23 +569,21 @@ def _exec_bpf(ctx: TxnContext, instr, program: Account) -> str:
     # applied value dedup by key with last-slot-wins — otherwise a
     # duplicated index could double-count `before` and mint the
     # difference
-    off = 2
-    final: dict[bytes, tuple[int, int]] = {}     # key -> (idx, lamports)
-    for ix in instr.acct_idxs:
+    final: dict[bytes, tuple[int, int]] = {}     # key -> (local_i, lam)
+    for i, off in enumerate(vm._lam_offsets):
         lam = int.from_bytes(vm.mem_read(
-            0x4_0000_0000 + off + 32, 8), "little")
-        final[ctx.keys[ix]] = (ix, lam)
-        off += 42
-    uniq = {ctx.keys[ix]: ctx.account(ix) for ix in instr.acct_idxs}
+            0x4_0000_0000 + off, 8), "little")
+        final[ic.key(i)] = (i, lam)
+    uniq = {ic.key(i): ic.account(i) for i in range(ic.n)}
     before = sum(a.lamports for a in uniq.values())
     if sum(lam for _, lam in final.values()) != before:
         return ERR_BALANCE_VIOLATION
-    for key, (ix, lam) in final.items():
+    for key, (i, lam) in final.items():
         a = uniq[key]
         if lam != a.lamports:
-            if not ctx.is_writable(ix):
+            if not ic.is_writable(i):
                 return ERR_NOT_WRITABLE
-            if lam < a.lamports and a.owner != program_id:
+            if lam < a.lamports and a.owner != ic.program_id:
                 # a program may only DEBIT accounts it owns — txn-level
                 # writability alone must not let an arbitrary deployed
                 # program drain a victim's account
@@ -260,12 +592,34 @@ def _exec_bpf(ctx: TxnContext, instr, program: Account) -> str:
     return OK
 
 
+def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
+                   budget: int | None = None) -> str:
+    """Route one instruction frame to its program (the fd_executor
+    native-program dispatch switch + BPF fallback)."""
+    from .stake import STAKE_PROGRAM_ID, exec_stake
+    from .vote import VOTE_PROGRAM_ID, exec_vote
+    pid = ic.program_id
+    if pid == SYSTEM_PROGRAM_ID:
+        return _exec_system(ic)
+    if pid == VOTE_PROGRAM_ID:
+        return exec_vote(ic)
+    if pid == STAKE_PROGRAM_ID:
+        return exec_stake(ic)
+    if pid == COMPUTE_BUDGET_PROGRAM_ID:
+        return OK                    # limits handled by pack/cost
+    pa = ctx.db.peek(ctx.xid, pid)
+    if pa is not None and pa.executable and pa.owner == BPF_LOADER_ID:
+        return _exec_bpf(ctx, ic, pa, depth, budget=budget)
+    return ERR_UNKNOWN_PROGRAM
+
+
 class TxnExecutor:
     """fd_runtime_prepare_and_execute_txn analog for the host path."""
 
     def __init__(self, db: AccDb, fee_per_signature: int = 5000):
         self.db = db
         self.fee_per_signature = fee_per_signature
+        self.epoch = 0               # advanced by the bank at boundaries
 
     def execute(self, xid, payload: bytes) -> TxnResult:
         try:
@@ -284,23 +638,12 @@ class TxnExecutor:
         payer.account.lamports -= fee
         self.db.close_rw(payer)
 
-        ctx = TxnContext(self.db, xid, txn, payload)
-        from .vote import VOTE_PROGRAM_ID, exec_vote
+        ctx = TxnContext(self.db, xid, txn, payload, epoch=self.epoch)
         for instr in txn.instrs:
-            prog = keys[instr.prog_idx]
-            if prog == SYSTEM_PROGRAM_ID:
-                st = _exec_system(ctx, instr)
-            elif prog == VOTE_PROGRAM_ID:
-                st = exec_vote(ctx, instr)
-            elif prog == COMPUTE_BUDGET_PROGRAM_ID:
-                st = OK                  # limits handled by pack/cost
-            else:
-                pa = self.db.peek(xid, prog)
-                if pa is not None and pa.executable \
-                        and pa.owner == BPF_LOADER_ID:
-                    st = _exec_bpf(ctx, instr, pa)
-                else:
-                    st = ERR_UNKNOWN_PROGRAM
+            data = payload[instr.data_off:instr.data_off + instr.data_sz]
+            ic = InstrCtx(ctx, keys[instr.prog_idx],
+                          list(instr.acct_idxs), data)
+            st = dispatch_instr(ctx, ic)
             if st != OK:
                 # atomic rollback: drop the working set (fee stays)
                 return TxnResult(st, fee, ctx.logs)

@@ -1,0 +1,194 @@
+"""Native stake program: delegation lifecycle feeding consensus stake.
+
+Subset of the reference's stake program re-expressed for this runtime
+(ref: src/flamenco/runtime/program/fd_stake_program.c — Initialize /
+DelegateStake / Deactivate / Withdraw with the authorized-staker/
+withdrawer split; epoch-boundary activation semantics per the stake
+history discipline, simplified to step activation: a delegation made
+in epoch E is ACTIVE for epochs > E, a deactivation in epoch E stops
+counting for epochs > E — the reference's warmup/cooldown RATE limits
+are not modeled, documented divergence).
+
+The current epoch reaches the program through TxnContext.epoch — this
+framework's stand-in for the Clock sysvar (the reference reads
+fd_sysvar_clock).
+
+State layout (compact struct, this framework's own; semantics follow
+the reference):
+  u8 state (0 uninitialized | 1 initialized | 2 delegated)
+  staker 32 | withdrawer 32 | rent_reserve u64
+  voter 32 | amount u64 | activation_epoch u64 | deactivation_epoch u64
+"""
+from __future__ import annotations
+
+import struct
+
+STAKE_PROGRAM_ID = b"Stake" + bytes(27)
+EPOCH_NONE = (1 << 64) - 1
+
+STAKE_IX_INITIALIZE = 0
+STAKE_IX_DELEGATE = 1
+STAKE_IX_DEACTIVATE = 2
+STAKE_IX_WITHDRAW = 3
+
+_FMT = "<B32s32sQ32sQQQ"
+STATE_SZ = struct.calcsize(_FMT)
+
+ST_UNINIT, ST_INIT, ST_DELEGATED = 0, 1, 2
+
+
+class StakeState:
+    def __init__(self, state=ST_UNINIT, staker=bytes(32),
+                 withdrawer=bytes(32), rent_reserve=0, voter=bytes(32),
+                 amount=0, activation_epoch=EPOCH_NONE,
+                 deactivation_epoch=EPOCH_NONE):
+        self.state = state
+        self.staker = staker
+        self.withdrawer = withdrawer
+        self.rent_reserve = rent_reserve
+        self.voter = voter
+        self.amount = amount
+        self.activation_epoch = activation_epoch
+        self.deactivation_epoch = deactivation_epoch
+
+    def to_bytes(self) -> bytes:
+        return struct.pack(_FMT, self.state, self.staker,
+                           self.withdrawer, self.rent_reserve,
+                           self.voter, self.amount,
+                           self.activation_epoch,
+                           self.deactivation_epoch)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "StakeState":
+        return cls(*struct.unpack_from(_FMT, b, 0))
+
+    # -- epoch semantics ----------------------------------------------------
+
+    def active_at(self, epoch: int) -> int:
+        """Stake counted for `epoch` (step activation: active strictly
+        after the activation epoch, through the deactivation epoch)."""
+        if self.state != ST_DELEGATED:
+            return 0
+        if self.activation_epoch == EPOCH_NONE \
+                or epoch <= self.activation_epoch:
+            return 0
+        if self.deactivation_epoch != EPOCH_NONE \
+                and epoch > self.deactivation_epoch:
+            return 0
+        return self.amount
+
+    def fully_inactive(self, epoch: int) -> bool:
+        if self.state != ST_DELEGATED:
+            return True
+        if self.activation_epoch == EPOCH_NONE:
+            return True
+        return (self.deactivation_epoch != EPOCH_NONE
+                and epoch > self.deactivation_epoch)
+
+
+def ix_initialize(staker: bytes, withdrawer: bytes) -> bytes:
+    return struct.pack("<I", STAKE_IX_INITIALIZE) + staker + withdrawer
+
+
+def ix_delegate() -> bytes:
+    return struct.pack("<I", STAKE_IX_DELEGATE)
+
+
+def ix_deactivate() -> bytes:
+    return struct.pack("<I", STAKE_IX_DEACTIVATE)
+
+
+def ix_withdraw(lamports: int) -> bytes:
+    return struct.pack("<IQ", STAKE_IX_WITHDRAW, lamports)
+
+
+def exec_stake(ic) -> str:
+    """ic: programs.InstrCtx. Dispatched from the executor's native
+    program switch."""
+    from .programs import (
+        ERR_BAD_IX_DATA, ERR_INSUFFICIENT, ERR_INVALID_OWNER,
+        ERR_MISSING_SIG, ERR_NOT_WRITABLE, ERR_UNKNOWN_IX, OK,
+    )
+    from .vote import VOTE_PROGRAM_ID
+    data = ic.data
+    if len(data) < 4 or ic.n < 1:
+        return ERR_BAD_IX_DATA
+    disc = struct.unpack_from("<I", data, 0)[0]
+    acct = ic.account(0)
+    if acct.owner != STAKE_PROGRAM_ID:
+        return ERR_INVALID_OWNER
+    epoch = ic.ctx.epoch
+
+    if disc == STAKE_IX_INITIALIZE:
+        if len(data) < 4 + 64:
+            return ERR_BAD_IX_DATA
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        if acct.data and any(acct.data[:1]):
+            return ERR_INVALID_OWNER         # already initialized
+        st = StakeState(ST_INIT, staker=data[4:36],
+                        withdrawer=data[36:68])
+        acct.data = st.to_bytes()
+        return OK
+
+    if len(acct.data) < STATE_SZ:
+        return ERR_INVALID_OWNER
+    st = StakeState.from_bytes(acct.data)
+
+    if disc == STAKE_IX_DELEGATE:
+        if ic.n < 2:
+            return ERR_BAD_IX_DATA
+        if st.state == ST_UNINIT:
+            return ERR_INVALID_OWNER
+        if st.staker not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        vote_acct = ic.account(1)
+        if vote_acct.owner != VOTE_PROGRAM_ID:
+            return ERR_INVALID_OWNER
+        if st.state == ST_DELEGATED and not st.fully_inactive(epoch):
+            # re-delegation of live stake is refused (the reference
+            # allows it only through the deactivate-then-delegate path)
+            return ERR_INVALID_OWNER
+        amount = acct.lamports - st.rent_reserve
+        if amount <= 0:
+            return ERR_INSUFFICIENT
+        st.state = ST_DELEGATED
+        st.voter = ic.key(1)
+        st.amount = amount
+        st.activation_epoch = epoch
+        st.deactivation_epoch = EPOCH_NONE
+        acct.data = st.to_bytes()
+        return OK
+
+    if disc == STAKE_IX_DEACTIVATE:
+        if st.state != ST_DELEGATED or st.deactivation_epoch != EPOCH_NONE:
+            return ERR_INVALID_OWNER
+        if st.staker not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        st.deactivation_epoch = epoch
+        acct.data = st.to_bytes()
+        return OK
+
+    if disc == STAKE_IX_WITHDRAW:
+        if len(data) < 12 or ic.n < 2:
+            return ERR_BAD_IX_DATA
+        lamports = struct.unpack_from("<Q", data, 4)[0]
+        if st.withdrawer not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0) or not ic.is_writable(1):
+            return ERR_NOT_WRITABLE
+        if st.fully_inactive(epoch):
+            locked = 0                        # may drain + close
+        else:
+            locked = st.amount + st.rent_reserve
+        if lamports > acct.lamports - locked:
+            return ERR_INSUFFICIENT
+        acct.lamports -= lamports
+        ic.account(1).lamports += lamports
+        return OK
+
+    return ERR_UNKNOWN_IX

@@ -117,25 +117,25 @@ def ix_withdraw(lamports: int) -> bytes:
 
 # -- executor hook (called from programs.TxnExecutor) ------------------------
 
-def exec_vote(ctx, instr) -> str:
+def exec_vote(ic) -> str:
+    """ic: programs.InstrCtx — local account indices, invocation-level
+    privileges (top-level txn bits, or CPI-validated metas)."""
     from .programs import (
         ERR_BAD_IX_DATA, ERR_INSUFFICIENT, ERR_INVALID_OWNER,
         ERR_MISSING_SIG, ERR_NOT_WRITABLE, OK,
     )
-    data = ctx.payload[instr.data_off:instr.data_off + instr.data_sz]
+    data = ic.data
     if len(data) < 4:
         return ERR_BAD_IX_DATA
     disc = struct.unpack_from("<I", data, 0)[0]
-    ai = instr.acct_idxs
-    if not ai:
+    if ic.n < 1:
         return ERR_BAD_IX_DATA
-    vote_idx = ai[0]
-    acct = ctx.account(vote_idx)
+    acct = ic.account(0)
 
     if disc == VOTE_IX_INITIALIZE:
         if len(data) < 4 + 96 + 1:
             return ERR_BAD_IX_DATA
-        if not ctx.is_writable(vote_idx):
+        if not ic.is_writable(0):
             return ERR_NOT_WRITABLE
         if acct.owner != VOTE_PROGRAM_ID or acct.data.strip(b"\x00"):
             return ERR_INVALID_OWNER      # must be fresh + vote-owned
@@ -144,8 +144,7 @@ def exec_vote(ctx, instr) -> str:
         # authorities (ref: vote program InitializeAccount requires the
         # node pubkey signature)
         node = data[4:36]
-        signer_keys = {ctx.keys[i] for i in range(ctx.txn.sig_cnt)}
-        if node not in signer_keys:
+        if node not in ic.signer_keys():
             return ERR_MISSING_SIG
         st = VoteState(node, data[36:68], data[68:100], data[100])
         acct.data = st.to_bytes()
@@ -167,28 +166,26 @@ def exec_vote(ctx, instr) -> str:
         ts = struct.unpack_from("<Q", data, 6 + 8 * cnt + 32)[0]
         # the AUTHORIZED VOTER must sign (ref: vote program authority
         # checks), not merely the vote account
-        signer_keys = {ctx.keys[i] for i in range(ctx.txn.sig_cnt)}
-        if st.authorized_voter not in signer_keys:
+        if st.authorized_voter not in ic.signer_keys():
             return ERR_MISSING_SIG
-        if not ctx.is_writable(vote_idx):
+        if not ic.is_writable(0):
             return ERR_NOT_WRITABLE
         st.apply_vote(slots, ts)
         acct.data = st.to_bytes()
         return OK
 
     if disc == VOTE_IX_WITHDRAW:
-        if len(data) < 12 or len(ai) < 2:
+        if len(data) < 12 or ic.n < 2:
             return ERR_BAD_IX_DATA
         lamports = struct.unpack_from("<Q", data, 4)[0]
-        signer_keys = {ctx.keys[i] for i in range(ctx.txn.sig_cnt)}
-        if st.authorized_withdrawer not in signer_keys:
+        if st.authorized_withdrawer not in ic.signer_keys():
             return ERR_MISSING_SIG
-        if not ctx.is_writable(vote_idx) or not ctx.is_writable(ai[1]):
+        if not ic.is_writable(0) or not ic.is_writable(1):
             return ERR_NOT_WRITABLE
         if lamports > acct.lamports:
             return ERR_INSUFFICIENT
         acct.lamports -= lamports
-        ctx.account(ai[1]).lamports += lamports
+        ic.account(1).lamports += lamports
         return OK
 
     return ERR_BAD_IX_DATA

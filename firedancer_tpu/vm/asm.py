@@ -4,7 +4,7 @@ fd_vm_disasm in reverse). Mnemonics follow the conventional sBPF forms:
     mov64 r1, 5        add64 r1, r2      lddw r1, 0x1122334455
     ldxdw r2, [r1+8]   stxw [r10-4], r3  stw [r1+0], 7
     jeq r1, 0, +3      jsgt r1, r2, -2   ja +1
-    call 0x10          call_rel -5       callx r3      exit
+    call 0x10          call_fn 5         callx r3      exit
     le r1, 32          be r1, 64
 """
 from __future__ import annotations
@@ -56,7 +56,7 @@ def asm(src: str) -> bytes:
             out += _ins(0x05, off=_num(t[1]))
         elif m == "call":
             out += _ins(0x85, imm=_num(t[1]))
-        elif m == "call_rel":
+        elif m == "call_fn":      # absolute target pc (src=1 form)
             out += _ins(0x85, src=1, imm=_num(t[1]))
         elif m == "callx":
             out += _ins(0x8D, dst=_reg(t[1]))

@@ -78,16 +78,27 @@ class VmResult:
 class Vm:
     def __init__(self, program: bytes, *, input_data: bytes = b"",
                  heap_sz: int = 32 * 1024, compute_budget: int = 200_000,
-                 syscalls: dict | None = None):
+                 syscalls: dict | None = None, image: bytes | None = None,
+                 text_off: int = 0, calls: dict | None = None):
         """program: raw sBPF text section (8-byte instruction stream).
         syscalls: {id: fn(vm, r1..r5) -> r0} (the loader resolves name
-        hashes to ids; tests register directly)."""
+        hashes to ids; tests register directly).
+        image/text_off: ELF-loaded programs map the WHOLE relocated
+        file image read-only at RODATA_START with .text executing in
+        place at text_off (vm/elf.py); raw-text programs leave image
+        None and the text itself is the rodata region.
+        calls: {murmur3_32(pc): pc} internal-call registry — `call imm`
+        resolves here before the syscall table (the reference VM's
+        call-target hash map, fd_sbpf_loader.h:300-310)."""
         if len(program) % 8:
             raise ValueError("program size must be a multiple of 8")
         self.text = program
         self.n_instr = len(program) // 8
+        self.text_base = RODATA_START + text_off
         self.regions = [
-            Region(RODATA_START, bytearray(program), False),
+            Region(RODATA_START,
+                   bytearray(image if image is not None else program),
+                   False),
             Region(STACK_START, bytearray(
                 MAX_CALL_DEPTH * (FRAME_SZ + FRAME_GAP)), True),
             Region(HEAP_START, bytearray(heap_sz), True),
@@ -95,6 +106,7 @@ class Vm:
         ]
         self.compute_budget = compute_budget
         self.syscalls = dict(syscalls or {})
+        self.calls = dict(calls or {})
         self.log: list[str] = []
 
     # -- memory -------------------------------------------------------------
@@ -235,17 +247,29 @@ class Vm:
                         pc += offs
                         continue
                     if op == 0x85:        # call
-                        if src == 1:      # pc-relative internal call
+                        # resolution order = the reference's legacy
+                        # path (fd_vm_interp_core.c 0x85depr): syscall
+                        # registry first, then the hashed call registry
+                        # (loader-filled), then — for hand-assembled
+                        # raw-text programs only — src=1 with an
+                        # in-bounds imm as a direct absolute target pc
+                        fn = self.syscalls.get(imm & MASK32)
+                        tgt = None
+                        if fn is None:
+                            tgt = self.calls.get(imm & MASK32)
+                            if tgt is None and src == 1 \
+                                    and 0 <= imm < self.n_instr:
+                                tgt = imm
+                            if tgt is None:
+                                raise VmFault(ERR_SYSCALL, f"{imm:#x}")
+                        if tgt is not None:
                             if len(shadow) >= MAX_CALL_DEPTH - 1:
                                 raise VmFault(ERR_DEPTH)
                             shadow.append((reg[6], reg[7], reg[8],
                                            reg[9], reg[10], pc))
                             reg[10] += FRAME_SZ + FRAME_GAP
-                            pc = pc + imm
+                            pc = tgt
                             continue
-                        fn = self.syscalls.get(imm & MASK32)
-                        if fn is None:
-                            raise VmFault(ERR_SYSCALL, f"{imm:#x}")
                         try:
                             reg[0] = fn(self, reg[1], reg[2], reg[3],
                                         reg[4], reg[5]) & MASK64
@@ -263,13 +287,13 @@ class Vm:
                             raise VmFault(ERR_DEPTH)
                         target = reg[imm & 0x0F] if imm else reg[dst]
                         if target % 8 or not (
-                                0 <= (target - RODATA_START) // 8
+                                0 <= (target - self.text_base) // 8
                                 < self.n_instr):
                             raise VmFault(ERR_PC, f"callx {target:#x}")
                         shadow.append((reg[6], reg[7], reg[8],
                                        reg[9], reg[10], pc))
                         reg[10] += FRAME_SZ + FRAME_GAP
-                        pc = (target - RODATA_START) // 8
+                        pc = (target - self.text_base) // 8
                         continue
                     if op == 0x95:        # exit / return
                         if not shadow:

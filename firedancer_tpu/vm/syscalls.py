@@ -15,9 +15,12 @@ CU_SHA256_PER_64B = 1
 
 
 def syscall_id(name: bytes) -> int:
-    """Stable 32-bit id for a syscall symbol (sha256-derived; the
-    reference uses murmur3_32 — same role, different hash, documented)."""
-    return int.from_bytes(hashlib.sha256(name).digest()[:4], "little")
+    """Stable 32-bit id for a syscall symbol: murmur3_32 of the name —
+    the SAME hash the ELF loader stamps into relocated `call` imms
+    (vm/elf.py, matching the reference's murmur3 convention), so a
+    loaded program's syscalls hit this registry directly."""
+    from .elf import murmur3_32
+    return murmur3_32(name)
 
 
 def sys_abort(vm, r1, r2, r3, r4, r5):

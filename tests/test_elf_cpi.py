@@ -1,0 +1,279 @@
+"""sBPF ELF loading + CPI tests.
+
+The ELF fixture is built instruction-by-instruction here (no Solana
+toolchain in the image) but is a structurally valid sBPF ELF64 — the
+loader must parse real section headers, the dynamic symbol table, and
+apply all three relocation kinds exactly as it would for a
+cargo-build-sbf artifact (ref: src/ballet/sbpf/fd_sbpf_loader.c:390-395,
+747; CPI: src/flamenco/vm/syscall/fd_vm_syscall_cpi.c, PDA:
+fd_vm_syscall_pda.c)."""
+import struct
+
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account, TxnExecutor
+from firedancer_tpu.svm.accdb import SYSTEM_PROGRAM_ID
+from firedancer_tpu.svm.programs import (
+    BPF_LOADER_ID, ERR_VM, OK, create_program_address,
+    find_program_address,
+)
+from firedancer_tpu.vm import INPUT_START, asm
+from firedancer_tpu.vm import elf
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+PAYER, DEST, PROG = k(1), k(3), k(9)
+RODATA_MSG = b"hello from elf"
+
+
+# ---------------------------------------------------------------------------
+# minimal-but-valid sBPF ELF builder
+# ---------------------------------------------------------------------------
+
+def _build_elf(machine=elf.EM_SBPF):
+    """entry: log a .rodata string via sol_log_ (syscall reloc), call a
+    defined helper (pc-hash reloc), exit 0."""
+    ehdr_sz = 64
+    text = asm(f"""
+        lddw r1, 0
+        mov64 r2, {len(RODATA_MSG)}
+        call 0
+        call 0
+        exit
+        mov64 r0, 0
+        exit
+    """)
+    text_off = ehdr_sz
+    rodata_off = text_off + len(text)
+    # pre-reloc imm holds the file offset; R_BPF_64_RELATIVE adds base
+    text = bytearray(text)
+    struct.pack_into("<I", text, 4, rodata_off)
+    text = bytes(text)
+
+    dynstr = b"\x00sol_log_\x00helper\x00"
+    dynstr_off = rodata_off + len(RODATA_MSG)
+    dynsym_off = (dynstr_off + len(dynstr) + 7) & ~7
+    helper_off = text_off + 6 * 8       # pc 6: after entry's exit (pc 5)
+    dynsym = struct.pack("<IBBHQQ", 0, 0, 0, 0, 0, 0)
+    dynsym += struct.pack("<IBBHQQ", 1, 0x10, 0, 0, 0, 0)     # sol_log_
+    dynsym += struct.pack("<IBBHQQ", 10, 0x12, 0, 1, helper_off, 16)
+    rel_off = dynsym_off + len(dynsym)
+    # pc layout: lddw occupies pc 0-1, mov64 pc 2, calls pc 3 and 4
+    rel = struct.pack("<QQ", text_off + 0, elf.R_BPF_64_RELATIVE)
+    rel += struct.pack("<QQ", text_off + 3 * 8,
+                       (1 << 32) | elf.R_BPF_64_32)
+    rel += struct.pack("<QQ", text_off + 4 * 8,
+                       (2 << 32) | elf.R_BPF_64_32)
+    shstr = (b"\x00.text\x00.rodata\x00.dynstr\x00.dynsym\x00"
+             b".rel.dyn\x00.shstrtab\x00")
+    shstr_off = rel_off + len(rel)
+    shoff = (shstr_off + len(shstr) + 7) & ~7
+
+    def shdr(name, typ, addr, off, size, link=0, entsize=0):
+        return struct.pack("<IIQQQQIIQQ", name, typ, 0, addr, off,
+                           size, link, 0, 8, entsize)
+
+    shdrs = shdr(0, 0, 0, 0, 0)                               # NULL
+    shdrs += shdr(1, 1, text_off, text_off, len(text))        # .text
+    shdrs += shdr(7, 1, rodata_off, rodata_off, len(RODATA_MSG))
+    shdrs += shdr(15, 3, dynstr_off, dynstr_off, len(dynstr))
+    shdrs += shdr(23, 11, dynsym_off, dynsym_off, len(dynsym),
+                  link=3, entsize=24)
+    shdrs += shdr(31, 9, rel_off, rel_off, len(rel), link=4,
+                  entsize=16)
+    shdrs += shdr(40, 3, shstr_off, shstr_off, len(shstr))
+
+    ehdr = (b"\x7fELF" + bytes([2, 1, 1]) + bytes(9)
+            + struct.pack("<HHIQQQIHHHHHH", 3, machine, 1,
+                          text_off,              # e_entry
+                          0, shoff, 0, ehdr_sz, 0, 0, 64, 7, 6))
+    img = bytearray(ehdr)
+    img += text
+    img += RODATA_MSG
+    img += dynstr
+    img += bytes(dynsym_off - dynstr_off - len(dynstr))
+    img += dynsym
+    img += rel
+    img += shstr
+    img += bytes(shoff - shstr_off - len(shstr))
+    img += shdrs
+    return bytes(img)
+
+
+def test_loader_parses_and_relocates():
+    prog = elf.load(_build_elf())
+    assert prog.entry_pc == 0
+    assert prog.syscalls_used == {"sol_log_"}
+    # helper registered under its pc hash
+    assert prog.calls[elf.pc_hash(6)] == 6
+    # lddw imm pair patched to rodata vaddr
+    lo = struct.unpack_from("<I", prog.text, 4)[0]
+    hi = struct.unpack_from("<I", prog.text, 12)[0]
+    assert (lo | (hi << 32)) == elf.MM_PROGRAM_START + 64 + len(prog.text)
+    # call imms carry murmur hashes
+    sysc = struct.unpack_from("<I", prog.text, 3 * 8 + 4)[0]
+    assert sysc == elf.murmur3_32(b"sol_log_")
+
+
+def test_loader_rejects_bad_machine():
+    img = bytearray(_build_elf())
+    struct.pack_into("<H", img, 18, 62)          # EM_X86_64
+    with pytest.raises(elf.ElfError):
+        elf.load(bytes(img))
+
+
+def test_loader_rejects_entry_outside_text():
+    img = bytearray(_build_elf())
+    struct.pack_into("<Q", img, 24, 8)           # e_entry into ehdr
+    with pytest.raises(elf.ElfError):
+        elf.load(bytes(img))
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, PAYER, Account(lamports=1_000_000))
+    funk.txn_prepare(None, "blk")
+    return funk, db, TxnExecutor(db)
+
+
+def _txn(instr_accounts, data, extra=()):
+    msg = build_message([PAYER], list(extra) + [PROG], b"\x11" * 32,
+                        [(1 + len(extra), bytes(instr_accounts), data)],
+                        n_ro_unsigned=1)
+    return build_txn([bytes(64)], msg)
+
+
+def test_elf_program_executes_in_txn(env):
+    funk, db, ex = env
+    funk.rec_write("blk", PROG, Account(
+        lamports=1, data=_build_elf(), owner=BPF_LOADER_ID,
+        executable=True))
+    r = ex.execute("blk", _txn([], b""))
+    assert r.status == OK
+    assert any(RODATA_MSG.decode() in ln for ln in r.logs)
+
+
+# ---------------------------------------------------------------------------
+# CPI: program-derived-address signing + invoke
+# ---------------------------------------------------------------------------
+
+PDA, BUMP = find_program_address([b"vault"], PROG)
+SEEDS_BLOB = (bytes([1])                      # one signer
+              + bytes([2])                    # two seeds
+              + bytes([5]) + b"vault"
+              + bytes([1, BUMP]))
+
+
+def _cpi_blob(amount, pda_signer=True, seeds=SEEDS_BLOB):
+    """Instruction data handed to the outer program: CPI instruction
+    (system transfer PDA -> DEST) followed by the signer seeds."""
+    ix = (SYSTEM_PROGRAM_ID + struct.pack("<H", 2)
+          + PDA + bytes([1 if pda_signer else 0, 1])
+          + DEST + bytes([0, 1])
+          + struct.pack("<H", 12)
+          + struct.pack("<IQ", 2, amount))
+    return ix, seeds
+
+
+def _cpi_prog(n_outer_accounts, cpi_len):
+    """Outer sBPF program: point r1 at the CPI instruction (inside its
+    own instruction data in the input region), r2 at the seeds, invoke."""
+    data_va = INPUT_START + 2 + 42 * n_outer_accounts + 2
+    return asm(f"""
+        lddw r1, {data_va}
+        lddw r2, {data_va + cpi_len}
+        call {hex(elf.murmur3_32(b"sol_invoke_signed_c"))}
+        mov64 r0, 0
+        exit
+    """)
+
+
+def _setup_cpi(funk, amount=500, pda_signer=True, seeds=SEEDS_BLOB,
+               pda_lamports=1000):
+    ix, sd = _cpi_blob(amount, pda_signer, seeds)
+    prog = _cpi_prog(2, len(ix))
+    funk.rec_write("blk", PROG, Account(
+        lamports=1, data=prog, owner=BPF_LOADER_ID, executable=True))
+    funk.rec_write("blk", PDA, Account(lamports=pda_lamports))
+    # outer instruction accounts: [PDA, DEST] (txn idx 1, 2)
+    return _txn([1, 2], ix + sd, extra=[PDA, DEST])
+
+
+def test_cpi_transfer_with_pda_signer(env):
+    funk, db, ex = env
+    txn = _setup_cpi(funk)
+    before = db.lamports("blk", PAYER) + db.lamports("blk", PDA)
+    r = ex.execute("blk", txn)
+    assert r.status == OK, r.logs
+    assert db.lamports("blk", PDA) == 500
+    assert db.lamports("blk", DEST) == 500
+    # lamports conservation across the CPI (fee aside, nothing minted)
+    after = (db.lamports("blk", PAYER) + db.lamports("blk", PDA)
+             + db.lamports("blk", DEST))
+    assert after == before - 5000                # exactly the fee
+
+
+def test_cpi_rejects_wrong_seeds(env):
+    funk, db, ex = env
+    bad = (bytes([1]) + bytes([1]) + bytes([4]) + b"evil")
+    txn = _setup_cpi(funk, seeds=bad)
+    r = ex.execute("blk", txn)
+    assert r.status == ERR_VM
+    assert db.lamports("blk", PDA) == 1000       # untouched
+
+
+def test_cpi_rejects_signer_escalation_without_seeds(env):
+    funk, db, ex = env
+    txn = _setup_cpi(funk, seeds=bytes([0]))     # no signers
+    r = ex.execute("blk", txn)
+    assert r.status == ERR_VM
+    assert db.lamports("blk", PDA) == 1000
+
+
+def test_cpi_insufficient_funds_aborts_txn(env):
+    funk, db, ex = env
+    txn = _setup_cpi(funk, amount=10_000)        # > pda balance
+    r = ex.execute("blk", txn)
+    assert r.status == ERR_VM
+    assert db.lamports("blk", PDA) == 1000
+    assert db.lamports("blk", DEST) == 0
+
+
+def test_pda_is_off_curve_and_deterministic():
+    a1 = create_program_address([b"vault", bytes([BUMP])], PROG)
+    assert a1 == PDA
+    from firedancer_tpu.utils.ed25519_ref import pt_decompress
+    assert pt_decompress(PDA) is None
+
+
+# ---------------------------------------------------------------------------
+# real toolchain artifact (read-only from the reference fixture tree)
+# ---------------------------------------------------------------------------
+
+REAL_SO = ("/root/reference/src/ballet/sbpf/fixtures/"
+           "hello_solana_program.so")
+
+
+@pytest.mark.skipif(not __import__("os").path.exists(REAL_SO),
+                    reason="reference fixture tree not present")
+def test_real_cargo_build_sbf_program_executes(env):
+    """A REAL compiled Solana program (cargo-build-sbf artifact, read
+    from the reference's fixture tree — binary test data, not code)
+    loads, relocates, and runs to completion inside a transaction,
+    deserializing the real Solana input ABI."""
+    funk, db, ex = env
+    data = open(REAL_SO, "rb").read()
+    funk.rec_write("blk", PROG, Account(
+        lamports=1, data=data, owner=BPF_LOADER_ID, executable=True))
+    r = ex.execute("blk", _txn([], b""))
+    assert r.status == OK, r.logs
+    assert any("Hello, Solana!" in ln for ln in r.logs)
+    # the program base58-prints its program id from the input region
+    assert any("Program ID" in ln for ln in r.logs)

@@ -121,12 +121,12 @@ def test_rodata_not_writable():
 
 
 def test_internal_call_and_shadow_regs():
-    """call_rel saves r6..r9 + frame pointer; callee clobbers r6 and
+    """call_fn saves r6..r9 + frame pointer; callee clobbers r6 and
     uses its own stack frame; caller's r6 survives."""
     r = run("""
         mov64 r6, 7
         mov64 r1, 5
-        call_rel +3
+        call_fn 5
         add64 r0, r6         // r6 restored: +7
         exit
         mov64 r6, 99         // callee clobbers
@@ -139,7 +139,7 @@ def test_internal_call_and_shadow_regs():
 
 
 def test_recursion_depth_limit():
-    r = run("call_rel -1; exit")          # infinite self-call
+    r = run("call_fn 0; exit")            # infinite self-call
     assert r.error == ERR_DEPTH
 
 
