@@ -398,6 +398,21 @@ class BankAdapter:
             for acct_hex, bal in args.get("genesis", {}).items():
                 self.funk.rec_write(None, bytes.fromhex(acct_hex),
                                     int(bal))
+            # genesis_synth = N: fund the deterministic synth signers
+            # (config-file convenience — TOML can't derive pubkeys; the
+            # committed default topology uses this). The synth signer
+            # pool wraps mod 16, so fund each UNIQUE pubkey once.
+            if args.get("genesis_synth"):
+                from ..tiles.synth import synth_signer_seed
+                from ..utils.ed25519_ref import keypair
+                seen = set()
+                for i in range(int(args["genesis_synth"])):
+                    seed = synth_signer_seed(i)
+                    if seed in seen:
+                        break                 # pool wrapped: all funded
+                    seen.add(seed)
+                    pub = keypair(seed)[-1]
+                    self.funk.rec_write(None, pub, 1 << 44)
             # optional JSON-RPC surface over this bank's state (the
             # rpc-tile seam; production would read a shared accdb,
             # ref src/discof/rpc/fd_rpc_tile.c)
@@ -537,6 +552,42 @@ class SockAdapter:
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
+@register("quic")
+class QuicAdapter:
+    """QUIC TPU ingest tile (ref: src/disco/quic/fd_quic_tile.c): the
+    production txn ingest transport; each completed unidirectional
+    stream publishes one txn frag. args: port (0 = ephemeral, bound
+    port in metrics), bind_addr, batch, mtu."""
+
+    METRICS = ["rx", "txns", "conns", "bad_pkts", "oversz",
+               "backpressure", "port"]
+    GAUGES = ["port"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.quic import QuicTile
+        self.ctx = ctx
+        out_ln = next(iter(ctx.out_rings))
+        # never exceed the out link's mtu: an oversize txn must be
+        # DROPPED (oversz), not crash Ring.publish on hostile input
+        link_mtu = ctx.plan["links"][out_ln]["mtu"]
+        self.tile = QuicTile(
+            _single(ctx.out_rings, "out link", ctx.tile_name),
+            _single(ctx.out_fseqs, "out link", ctx.tile_name),
+            port=int(args.get("port", 0)),
+            bind_addr=args.get("bind_addr", "127.0.0.1"),
+            batch=int(args.get("batch", 64)),
+            mtu=min(int(args.get("mtu", 1500)), link_mtu))
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def on_halt(self):
+        self.tile.close()
 
     def metrics_items(self):
         return dict(self.tile.metrics)

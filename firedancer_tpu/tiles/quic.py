@@ -1,0 +1,61 @@
+"""QUIC ingest tile core: datagrams -> QUIC server -> txn frags.
+
+The fd_quic_tile analog (ref: src/disco/quic/fd_quic_tile.c:234,303 —
+completed TPU streams publish into the verify ring via
+fd_tpu_reasm_publish_fast). The socket is nonblocking; each poll drains
+a burst of datagrams through the QUIC server, and every completed
+unidirectional stream publishes one txn frag downstream.
+"""
+from __future__ import annotations
+
+import socket
+import time
+
+from ..waltz.quic import QuicServer
+
+
+class QuicTile:
+    def __init__(self, out_ring, out_fseqs, port: int = 0,
+                 bind_addr: str = "127.0.0.1", batch: int = 64,
+                 mtu: int = 1500):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((bind_addr, port))
+        self.sock.setblocking(False)
+        self.out = out_ring
+        self.out_fseqs = out_fseqs
+        self.batch = batch
+        self.mtu = mtu
+        self._seq = 0
+
+        def on_txn(payload: bytes):
+            if len(payload) > self.mtu:
+                self.metrics["oversz"] += 1
+                return
+            while self.out_fseqs and \
+                    self.out.credits(self.out_fseqs) <= 0:
+                self.metrics["backpressure"] += 1
+                time.sleep(20e-6)
+            self.out.publish(payload, sig=self._seq)
+            self._seq += 1
+
+        self.server = QuicServer(self.sock, on_txn)
+        self.metrics = {"rx": 0, "txns": 0, "conns": 0, "bad_pkts": 0,
+                        "oversz": 0, "backpressure": 0, "port": 0}
+        self.metrics["port"] = self.sock.getsockname()[1]
+
+    def poll_once(self) -> int:
+        n = 0
+        for _ in range(self.batch):
+            try:
+                data, addr = self.sock.recvfrom(2048)
+            except OSError:
+                break
+            self.server.on_datagram(data, addr)
+            n += 1
+        m = self.server.metrics
+        self.metrics.update(rx=m["pkts"], txns=m["txns"],
+                            conns=m["conns"], bad_pkts=m["bad_pkts"])
+        return n
+
+    def close(self):
+        self.sock.close()

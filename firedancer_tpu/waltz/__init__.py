@@ -1,0 +1,1 @@
+"""waltz: networking protocols (ref: src/waltz/)."""
