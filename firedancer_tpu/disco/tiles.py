@@ -565,7 +565,7 @@ class QuicAdapter:
     port in metrics), bind_addr, batch, mtu."""
 
     METRICS = ["rx", "txns", "conns", "bad_pkts", "oversz",
-               "backpressure", "port"]
+               "backpressure", "dropped", "replayed", "port"]
     GAUGES = ["port"]
 
     def __init__(self, ctx, args):

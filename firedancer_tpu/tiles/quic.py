@@ -31,19 +31,32 @@ class QuicTile:
             if len(payload) > self.mtu:
                 self.metrics["oversz"] += 1
                 return
+            # bounded wait, then DROP (the client's loss recovery
+            # re-sends; an unbounded spin would deadlock halt() when
+            # the consumer dies — the sock tile's discipline)
+            deadline = time.monotonic() + 0.005
             while self.out_fseqs and \
                     self.out.credits(self.out_fseqs) <= 0:
                 self.metrics["backpressure"] += 1
+                if time.monotonic() > deadline:
+                    self.metrics["dropped"] += 1
+                    return
                 time.sleep(20e-6)
             self.out.publish(payload, sig=self._seq)
             self._seq += 1
 
         self.server = QuicServer(self.sock, on_txn)
         self.metrics = {"rx": 0, "txns": 0, "conns": 0, "bad_pkts": 0,
-                        "oversz": 0, "backpressure": 0, "port": 0}
+                        "oversz": 0, "backpressure": 0, "dropped": 0,
+                        "replayed": 0, "port": 0}
         self.metrics["port"] = self.sock.getsockname()[1]
 
     def poll_once(self) -> int:
+        # leave datagrams in the kernel buffer while downstream has no
+        # credits (don't decrypt work we'd have to drop)
+        if self.out_fseqs and self.out.credits(self.out_fseqs) <= 0:
+            self.metrics["backpressure"] += 1
+            return 0
         n = 0
         for _ in range(self.batch):
             try:
@@ -54,7 +67,8 @@ class QuicTile:
             n += 1
         m = self.server.metrics
         self.metrics.update(rx=m["pkts"], txns=m["txns"],
-                            conns=m["conns"], bad_pkts=m["bad_pkts"])
+                            conns=m["conns"], bad_pkts=m["bad_pkts"],
+                            replayed=m["replayed"])
         return n
 
     def close(self):

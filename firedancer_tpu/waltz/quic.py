@@ -162,8 +162,26 @@ def _encode_pn(pn: int) -> bytes:
     return struct.pack(">I", pn & 0xFFFFFFFF)[2:]     # 2-byte pn
 
 
+def decode_pn(truncated: int, pn_len: int, largest: int) -> int:
+    """Reconstruct the full packet number from its truncated wire form
+    (RFC 9000 Appendix A.3) given the largest pn received so far."""
+    pn_nbits = 8 * pn_len
+    expected = largest + 1
+    pn_win = 1 << pn_nbits
+    pn_hwin = pn_win >> 1
+    pn_mask = pn_win - 1
+    candidate = (expected & ~pn_mask) | truncated
+    if candidate <= expected - pn_hwin and candidate < (1 << 62) - pn_win:
+        return candidate + pn_win
+    if candidate > expected + pn_hwin and candidate >= pn_win:
+        return candidate - pn_win
+    return candidate
+
+
 def seal_long(keys: Keys, ptype: int, dcid: bytes, scid: bytes,
               pn: int, payload: bytes) -> bytes:
+    if len(payload) < 4:                      # see seal_short
+        payload = payload + bytes(4 - len(payload))
     pn_bytes = _encode_pn(pn)
     first = 0xC0 | (ptype << 4) | (len(pn_bytes) - 1)
     hdr = bytes([first]) + struct.pack(">I", VERSION)
@@ -185,6 +203,11 @@ def seal_long(keys: Keys, ptype: int, dcid: bytes, scid: bytes,
 
 
 def seal_short(keys: Keys, dcid: bytes, pn: int, payload: bytes) -> bytes:
+    # header protection samples 16 bytes starting 4 past the pn offset
+    # (RFC 9001 §5.4.2): pad tiny payloads (PADDING frames) so the
+    # sample always exists
+    if len(payload) < 4:
+        payload = payload + bytes(4 - len(payload))
     pn_bytes = _encode_pn(pn)
     first = 0x40 | (len(pn_bytes) - 1)
     hdr = bytes([first]) + dcid
@@ -241,7 +264,8 @@ def open_long(keys: Keys, pkt: bytes) -> tuple[int, bytes, bytes, bytes,
     return ptype, dcid, scid, payload, end
 
 
-def open_short(keys: Keys, pkt: bytes, dcid_len: int) -> tuple[int, bytes]:
+def open_short(keys: Keys, pkt: bytes, dcid_len: int,
+               largest: int = -1) -> tuple[int, bytes]:
     if len(pkt) < 1 + dcid_len + 20 or pkt[0] & 0x80:
         raise QuicError("not a short-header packet")
     pn_off = 1 + dcid_len
@@ -251,7 +275,7 @@ def open_short(keys: Keys, pkt: bytes, dcid_len: int) -> tuple[int, bytes]:
     pn_len = (first & 0x03) + 1
     pn_bytes = bytes(pkt[pn_off + i] ^ mask[1 + i]
                      for i in range(pn_len))
-    pn = int.from_bytes(pn_bytes, "big")
+    pn = decode_pn(int.from_bytes(pn_bytes, "big"), pn_len, largest)
     hdr = bytes([first]) + pkt[1:pn_off] + pn_bytes
     ct = pkt[pn_off + pn_len:]
     try:
@@ -362,16 +386,27 @@ def parse_frames(payload: bytes):
 # server
 # ---------------------------------------------------------------------------
 
+MAX_STREAM_BYTES = 64 * 1024          # per-stream reassembly cap
+
+
 class _Stream:
-    __slots__ = ("chunks", "fin_at", "delivered")
+    __slots__ = ("chunks", "fin_at", "delivered", "buffered")
 
     def __init__(self):
         self.chunks: dict[int, bytes] = {}
         self.fin_at: int | None = None
         self.delivered = False
+        self.buffered = 0
 
     def add(self, offset: int, data: bytes, fin: bool):
-        if data:
+        """Raises QuicError when the stream exceeds the reassembly cap
+        (hostile never-FIN streams must not grow memory unboundedly)."""
+        if offset + len(data) > MAX_STREAM_BYTES:
+            raise QuicError("stream exceeds reassembly cap")
+        if data and offset not in self.chunks:
+            self.buffered += len(data)
+            if self.buffered > MAX_STREAM_BYTES:
+                raise QuicError("stream exceeds reassembly cap")
             self.chunks[offset] = data
         if fin:
             end = offset + len(data)
@@ -407,7 +442,28 @@ class _Conn:
         self.streams: dict[int, _Stream] = {}
         self.tx_pn = 0
         self.rx_largest = -1
+        self.rx_window = 0               # bitmap of the last 64 pns
         self.done_streams = 0
+        self.hs_response: bytes | None = None    # for Initial retransmit
+
+    def pn_fresh(self, pn: int) -> bool:
+        """Anti-replay window (the RFC 9001 §9.2 duty): accept each
+        1-RTT pn at most once within a 64-packet sliding window; pns
+        older than the window are rejected outright."""
+        if pn > self.rx_largest:
+            shift = pn - self.rx_largest
+            self.rx_window = ((self.rx_window << shift) | 1) \
+                & ((1 << 64) - 1)
+            self.rx_largest = pn
+            return True
+        back = self.rx_largest - pn
+        if back >= 64:
+            return False
+        bit = 1 << back
+        if self.rx_window & bit:
+            return False
+        self.rx_window |= bit
+        return True
 
 
 class QuicServer:
@@ -422,7 +478,8 @@ class QuicServer:
         self.max_streams = max_streams
         self.conns: dict[bytes, _Conn] = {}
         self.metrics = {"pkts": 0, "bad_pkts": 0, "conns": 0,
-                        "txns": 0, "streams": 0, "closed": 0}
+                        "txns": 0, "streams": 0, "closed": 0,
+                        "replayed": 0}
 
     # -- datagram ingest ----------------------------------------------------
 
@@ -456,7 +513,9 @@ class QuicServer:
             ptype, _, scid, payload, _ = open_long(conn.ckeys, data)
         handled = 0
         for ft, f in parse_frames(payload):
-            if ft == FRAME_CRYPTO and conn.c1rtt is None:
+            if ft != FRAME_CRYPTO:
+                continue
+            if conn.c1rtt is None:
                 client_rand = f["data"][:32]
                 server_rand = os.urandom(32)
                 conn.c1rtt, conn.s1rtt = derive_1rtt(
@@ -464,11 +523,16 @@ class QuicServer:
                 resp = (enc_ack_frame(0)
                         + enc_crypto_frame(0, server_rand)
                         + bytes([FRAME_HANDSHAKE_DONE]))
-                pkt = seal_long(conn.skeys, PT_INITIAL,
-                                conn.client_cid, conn.scid,
-                                conn.tx_pn, resp)
+                conn.hs_response = seal_long(
+                    conn.skeys, PT_INITIAL, conn.client_cid,
+                    conn.scid, conn.tx_pn, resp)
                 conn.tx_pn += 1
-                self.sock.sendto(pkt, addr)
+                self.sock.sendto(conn.hs_response, addr)
+                handled += 1
+            elif conn.hs_response is not None:
+                # retransmitted Initial: the client lost our response
+                # — resend it (loss tolerance, RFC 9002 spirit)
+                self.sock.sendto(conn.hs_response, addr)
                 handled += 1
         return handled
 
@@ -477,8 +541,11 @@ class QuicServer:
         conn = self.conns.get(dcid)
         if conn is None or conn.c1rtt is None:
             raise QuicError("no 1-RTT keys for connection")
-        pn, payload = open_short(conn.c1rtt, data, self.cid_len)
-        conn.rx_largest = max(conn.rx_largest, pn)
+        pn, payload = open_short(conn.c1rtt, data, self.cid_len,
+                                 conn.rx_largest)
+        if not conn.pn_fresh(pn):
+            self.metrics["replayed"] += 1
+            return 0                      # duplicate/replayed datagram
         handled = 0
         acked = False
         for ft, f in parse_frames(payload):
@@ -525,6 +592,7 @@ class QuicClient:
         self.c1rtt: Keys | None = None
         self.s1rtt: Keys | None = None
         self.tx_pn = 0
+        self.rx_largest = -1
         self.next_stream = 2                  # client-initiated uni: 2,6,..
 
     def handshake(self, timeout: float = 5.0):
@@ -573,8 +641,10 @@ class QuicClient:
             except OSError:
                 break
             try:
-                _, payload = open_short(self.s1rtt, data,
-                                        len(self.scid))
+                pn, payload = open_short(self.s1rtt, data,
+                                         len(self.scid),
+                                         self.rx_largest)
+                self.rx_largest = max(self.rx_largest, pn)
                 n += sum(1 for ft, _ in parse_frames(payload)
                          if ft == FRAME_ACK)
             except QuicError:
