@@ -1,0 +1,347 @@
+"""Solana bincode wire types: the interop codec layer.
+
+The reference generates 15k lines of (de)serializers from
+fd_types.json (ref: src/flamenco/types/fd_types.c); this module is a
+hand-written TPU-framework subset covering the types the consensus
+path actually exchanges with a real cluster:
+
+  * bincode primitives (fixed-int little-endian, Option<T> as u8 tag,
+    Vec<T>/String with u64 length — Agave's default bincode config)
+  * StakeStateV2      (stake account data, exactly 200 bytes)
+  * VoteState1_14_11  (vote account data, the layout Agave still
+                       serializes inside VoteStateVersions::V1_14_11)
+  * VoteInstruction::Vote (the vote transaction's instruction data)
+
+Byte-for-byte layouts follow the public Agave definitions; sizes are
+pinned by the well-known constants (StakeStateV2::size_of() == 200,
+vote account size 3762) in tests/test_types.py. Internal runtime
+state (svm/vote.py, svm/stake.py) CONVERTS to/from these layouts at
+the wire boundary — the in-memory form stays this framework's own.
+"""
+from __future__ import annotations
+
+import struct
+
+
+class BincodeError(ValueError):
+    pass
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.b = data
+        self.off = 0
+
+    def take(self, n: int) -> bytes:
+        if self.off + n > len(self.b):
+            raise BincodeError("truncated")
+        out = self.b[self.off:self.off + n]
+        self.off += n
+        return out
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self.take(8))[0]
+
+    def pubkey(self) -> bytes:
+        return self.take(32)
+
+    def option(self, fn):
+        tag = self.u8()
+        if tag == 0:
+            return None
+        if tag != 1:
+            raise BincodeError(f"bad Option tag {tag}")
+        return fn()
+
+    def vec(self, fn) -> list:
+        n = self.u64()
+        if n > 1 << 24:
+            raise BincodeError("vec too long")
+        return [fn() for _ in range(n)]
+
+
+class Writer:
+    def __init__(self):
+        self.out = bytearray()
+
+    def u8(self, v):
+        self.out.append(v & 0xFF)
+
+    def u32(self, v):
+        self.out += struct.pack("<I", v)
+
+    def u64(self, v):
+        self.out += struct.pack("<Q", v)
+
+    def i64(self, v):
+        self.out += struct.pack("<q", v)
+
+    def f64(self, v):
+        self.out += struct.pack("<d", v)
+
+    def pubkey(self, v: bytes):
+        assert len(v) == 32
+        self.out += v
+
+    def option(self, v, fn):
+        if v is None:
+            self.u8(0)
+        else:
+            self.u8(1)
+            fn(v)
+
+    def vec(self, items, fn):
+        self.u64(len(items))
+        for it in items:
+            fn(it)
+
+    def bytes(self) -> bytes:
+        return bytes(self.out)
+
+
+# ---------------------------------------------------------------------------
+# StakeStateV2 (Agave stake account data; 200 bytes total)
+# ---------------------------------------------------------------------------
+
+STAKE_STATE_SZ = 200
+DEFAULT_WARMUP_COOLDOWN_RATE = 0.25
+
+
+def encode_stake_state(state: str, *, rent_exempt_reserve: int = 0,
+                       staker: bytes = bytes(32),
+                       withdrawer: bytes = bytes(32),
+                       lockup_ts: int = 0, lockup_epoch: int = 0,
+                       custodian: bytes = bytes(32),
+                       voter: bytes = bytes(32), stake: int = 0,
+                       activation_epoch: int = 0,
+                       deactivation_epoch: int = (1 << 64) - 1,
+                       warmup_cooldown_rate: float =
+                       DEFAULT_WARMUP_COOLDOWN_RATE,
+                       credits_observed: int = 0,
+                       stake_flags: int = 0) -> bytes:
+    """state: 'uninitialized' | 'initialized' | 'stake' |
+    'rewards_pool'. Output is padded to exactly 200 bytes (the account
+    allocation size Agave uses)."""
+    w = Writer()
+    if state == "uninitialized":
+        w.u32(0)
+    elif state in ("initialized", "stake"):
+        w.u32(1 if state == "initialized" else 2)
+        w.u64(rent_exempt_reserve)
+        w.pubkey(staker)
+        w.pubkey(withdrawer)
+        w.i64(lockup_ts)
+        w.u64(lockup_epoch)
+        w.pubkey(custodian)
+        if state == "stake":
+            w.pubkey(voter)
+            w.u64(stake)
+            w.u64(activation_epoch)
+            w.u64(deactivation_epoch)
+            w.f64(warmup_cooldown_rate)
+            w.u64(credits_observed)
+            w.u8(stake_flags)
+    elif state == "rewards_pool":
+        w.u32(3)
+    else:
+        raise BincodeError(f"unknown stake state {state!r}")
+    out = w.bytes()
+    if len(out) > STAKE_STATE_SZ:
+        raise BincodeError("stake state overflow")
+    return out + bytes(STAKE_STATE_SZ - len(out))
+
+
+def decode_stake_state(data: bytes) -> dict:
+    r = Reader(data)
+    disc = r.u32()
+    if disc == 0:
+        return {"state": "uninitialized"}
+    if disc == 3:
+        return {"state": "rewards_pool"}
+    if disc not in (1, 2):
+        raise BincodeError(f"bad StakeStateV2 discriminant {disc}")
+    out = {"state": "initialized" if disc == 1 else "stake",
+           "rent_exempt_reserve": r.u64(), "staker": r.pubkey(),
+           "withdrawer": r.pubkey(), "lockup_ts": r.i64(),
+           "lockup_epoch": r.u64(), "custodian": r.pubkey()}
+    if disc == 2:
+        out.update(voter=r.pubkey(), stake=r.u64(),
+                   activation_epoch=r.u64(),
+                   deactivation_epoch=r.u64(),
+                   warmup_cooldown_rate=r.f64(),
+                   credits_observed=r.u64(), stake_flags=r.u8())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# VoteState1_14_11 inside VoteStateVersions (vote account data)
+# ---------------------------------------------------------------------------
+
+VOTE_ACCOUNT_SZ = 3762          # Agave VoteStateVersions::vote_state_size_of
+
+
+def encode_vote_state(node_pubkey: bytes, authorized_voter: bytes,
+                      authorized_withdrawer: bytes, commission: int,
+                      votes: list[tuple[int, int]],
+                      root_slot: int | None,
+                      epoch_credits: list[tuple[int, int, int]] = (),
+                      last_ts_slot: int = 0, last_ts: int = 0,
+                      voter_epoch: int = 0, pad: bool = True) -> bytes:
+    """VoteStateVersions::V1_14_11 (enum variant 1):
+    votes: [(slot, confirmation_count)], authorized_voters as the
+    single-entry map {voter_epoch: authorized_voter}, empty
+    prior_voters circular buffer."""
+    w = Writer()
+    w.u32(1)                                 # VoteStateVersions::V1_14_11
+    w.pubkey(node_pubkey)
+    w.pubkey(authorized_withdrawer)
+    w.u8(commission)
+    w.vec(votes, lambda v: (w.u64(v[0]), w.u32(v[1])))
+    w.option(root_slot, w.u64)
+    # authorized_voters: BTreeMap<u64, Pubkey> with u64 length
+    w.u64(1)
+    w.u64(voter_epoch)
+    w.pubkey(authorized_voter)
+    # prior_voters: [(Pubkey, u64, u64); 32] + idx u64 + is_empty bool
+    for _ in range(32):
+        w.pubkey(bytes(32))
+        w.u64(0)
+        w.u64(0)
+    w.u64(31)
+    w.u8(1)                                  # is_empty = true
+    w.vec(list(epoch_credits),
+          lambda e: (w.u64(e[0]), w.u64(e[1]), w.u64(e[2])))
+    w.u64(last_ts_slot)
+    w.i64(last_ts)
+    out = w.bytes()
+    if not pad:
+        return out
+    if len(out) > VOTE_ACCOUNT_SZ:
+        raise BincodeError("vote state overflow")
+    return out + bytes(VOTE_ACCOUNT_SZ - len(out))
+
+
+def decode_vote_state(data: bytes) -> dict:
+    r = Reader(data)
+    variant = r.u32()
+    if variant != 1:
+        raise BincodeError(
+            f"unsupported VoteStateVersions variant {variant}")
+    out = {"node_pubkey": r.pubkey(),
+           "authorized_withdrawer": r.pubkey(),
+           "commission": r.u8(),
+           "votes": r.vec(lambda: (r.u64(), r.u32()))}
+    out["root_slot"] = r.option(r.u64)
+    n_av = r.u64()
+    if n_av > 64:
+        raise BincodeError("authorized_voters too long")
+    av = [(r.u64(), r.pubkey()) for _ in range(n_av)]
+    out["authorized_voters"] = av
+    out["authorized_voter"] = av[0][1] if av else bytes(32)
+    for _ in range(32):                      # prior_voters buffer
+        r.pubkey()
+        r.u64()
+        r.u64()
+    r.u64()
+    r.u8()
+    out["epoch_credits"] = r.vec(lambda: (r.u64(), r.u64(), r.u64()))
+    out["last_ts_slot"] = r.u64()
+    out["last_ts"] = r.i64()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# VoteInstruction::Vote (vote txn instruction data)
+# ---------------------------------------------------------------------------
+
+VOTE_IX_VOTE_DISC = 2           # VoteInstruction enum variant index
+
+
+def encode_vote_instruction(slots: list[int], block_hash: bytes,
+                            timestamp: int | None = None) -> bytes:
+    """VoteInstruction::Vote(Vote { slots, hash, timestamp })."""
+    w = Writer()
+    w.u32(VOTE_IX_VOTE_DISC)
+    w.vec(slots, w.u64)
+    w.pubkey(block_hash)                     # Hash = 32 bytes
+    w.option(timestamp, w.i64)
+    return w.bytes()
+
+
+def decode_vote_instruction(data: bytes) -> dict:
+    r = Reader(data)
+    disc = r.u32()
+    if disc != VOTE_IX_VOTE_DISC:
+        raise BincodeError(f"not VoteInstruction::Vote ({disc})")
+    return {"slots": r.vec(r.u64), "hash": r.pubkey(),
+            "timestamp": r.option(r.i64)}
+
+
+# ---------------------------------------------------------------------------
+# conversions: runtime state <-> wire
+# ---------------------------------------------------------------------------
+
+def stake_state_to_wire(st) -> bytes:
+    """svm/stake.StakeState -> StakeStateV2 bytes."""
+    from ..svm.stake import ST_DELEGATED, ST_INIT
+    if st.state == ST_INIT:
+        return encode_stake_state(
+            "initialized", rent_exempt_reserve=st.rent_reserve,
+            staker=st.staker, withdrawer=st.withdrawer)
+    if st.state == ST_DELEGATED:
+        return encode_stake_state(
+            "stake", rent_exempt_reserve=st.rent_reserve,
+            staker=st.staker, withdrawer=st.withdrawer, voter=st.voter,
+            stake=st.amount, activation_epoch=st.activation_epoch,
+            deactivation_epoch=st.deactivation_epoch)
+    return encode_stake_state("uninitialized")
+
+
+def stake_state_from_wire(data: bytes):
+    from ..svm.stake import (
+        EPOCH_NONE, ST_DELEGATED, ST_INIT, ST_UNINIT, StakeState,
+    )
+    d = decode_stake_state(data)
+    if d["state"] == "initialized":
+        return StakeState(ST_INIT, d["staker"], d["withdrawer"],
+                          d["rent_exempt_reserve"])
+    if d["state"] == "stake":
+        return StakeState(ST_DELEGATED, d["staker"], d["withdrawer"],
+                          d["rent_exempt_reserve"], d["voter"],
+                          d["stake"], d["activation_epoch"],
+                          d["deactivation_epoch"])
+    return StakeState(ST_UNINIT)
+
+
+def vote_state_to_wire(vs) -> bytes:
+    """svm/vote.VoteState -> VoteStateVersions::V1_14_11 bytes."""
+    return encode_vote_state(
+        vs.node_pubkey, vs.authorized_voter, vs.authorized_withdrawer,
+        vs.commission, [(v.slot, v.conf) for v in vs.tower.votes],
+        vs.root_slot, last_ts=vs.last_ts)
+
+
+def vote_state_from_wire(data: bytes):
+    from ..choreo.tower import TowerVote
+    from ..svm.vote import VoteState
+    d = decode_vote_state(data)
+    vs = VoteState(d["node_pubkey"], d["authorized_voter"],
+                   d["authorized_withdrawer"], d["commission"])
+    for slot, conf in d["votes"]:
+        vs.tower.votes.append(TowerVote(slot, conf))
+    vs.root_slot = d["root_slot"]
+    vs.tower.root = vs.root_slot
+    vs.last_ts = d["last_ts"]
+    return vs

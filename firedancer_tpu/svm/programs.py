@@ -79,22 +79,26 @@ class TxnResult:
     status: str
     fee: int
     logs: list
+    return_data: bytes = b""
 
 
 class TxnContext:
     """Per-txn view: copy-on-write accounts over one accdb fork."""
 
     def __init__(self, db: AccDb, xid, txn: ParsedTxn, payload: bytes,
-                 epoch: int = 0):
+                 epoch: int = 0, slot: int = 0):
         self.db = db
         self.xid = xid
         self.txn = txn
         self.payload = payload
         self.epoch = epoch            # Clock-sysvar stand-in
+        self.slot = slot
         self.keys = txn.account_keys(payload)
         self._work: dict[bytes, Account] = {}
         self.logs: list[str] = []
         self.last_exec_cu = 0        # CU used by the last BPF frame
+        self.return_data = b""       # sol_set_return_data (txn-wide)
+        self.return_data_program = bytes(32)
 
     def is_signer(self, idx: int) -> bool:
         return idx < self.txn.sig_cnt
@@ -462,6 +466,10 @@ def _make_cpi_syscalls(ctx: TxnContext, ic: InstrCtx, depth: int):
         if st != OK:
             raise VmFault(ERR_ABORT, f"CPI failed: {st}")
         vm.charge(ctx.last_exec_cu)
+        # the callee's return data becomes visible to the caller's
+        # sol_get_return_data (the CPI-result ABI)
+        vm.return_data = ctx.return_data
+        vm.return_data_program = ctx.return_data_program
         _refresh_input_lamports(vm, ic)
         return 0
 
@@ -540,6 +548,10 @@ def _exec_bpf(ctx: TxnContext, ic: InstrCtx, program: Account,
     syscalls = dict(DEFAULT_SYSCALLS)
     syscalls.update(_make_cpi_syscalls(ctx, ic, depth))
     kw = {} if budget is None else {"compute_budget": budget}
+    # sysvars the VM exposes via get_*_sysvar syscalls (the reference's
+    # fd_sysvar_cache; Clock layout = the Solana 40-byte struct)
+    sysvars = {"clock": struct.pack(
+        "<QqQQq", ctx.slot, 0, ctx.epoch, ctx.epoch, 0)}
     if program.data[:4] == b"\x7fELF":
         from ..vm import elf
         try:
@@ -553,14 +565,25 @@ def _exec_bpf(ctx: TxnContext, ic: InstrCtx, program: Account,
                 image=prog.image, text_off=prog.text_off,
                 calls=prog.calls, **kw)
         vm._lam_offsets = lam_offs
+        vm.sysvars = sysvars
+        vm.program_id = ic.program_id
+        vm.return_data = ctx.return_data
+        vm.return_data_program = ctx.return_data_program
         res = vm.run(entry_pc=prog.entry_pc)
     else:
         blob, lam_offs = _build_input(ic)
         vm = Vm(program.data, input_data=blob, syscalls=syscalls, **kw)
         vm._lam_offsets = lam_offs
+        vm.sysvars = sysvars
+        vm.program_id = ic.program_id
+        vm.return_data = ctx.return_data
+        vm.return_data_program = ctx.return_data_program
         res = vm.run()
     ctx.logs.extend(res.log)
     ctx.last_exec_cu = res.compute_used
+    ctx.return_data = getattr(vm, "return_data", b"")
+    ctx.return_data_program = getattr(vm, "return_data_program",
+                                      bytes(32))
     if res.error != VM_OK or res.r0 != 0:
         return ERR_VM
     # lamports write-back with conservation over UNIQUE accounts: an
@@ -620,6 +643,7 @@ class TxnExecutor:
         self.db = db
         self.fee_per_signature = fee_per_signature
         self.epoch = 0               # advanced by the bank at boundaries
+        self.slot = 0
 
     def execute(self, xid, payload: bytes) -> TxnResult:
         try:
@@ -638,7 +662,8 @@ class TxnExecutor:
         payer.account.lamports -= fee
         self.db.close_rw(payer)
 
-        ctx = TxnContext(self.db, xid, txn, payload, epoch=self.epoch)
+        ctx = TxnContext(self.db, xid, txn, payload, epoch=self.epoch,
+                         slot=self.slot)
         for instr in txn.instrs:
             data = payload[instr.data_off:instr.data_off + instr.data_sz]
             ic = InstrCtx(ctx, keys[instr.prog_idx],
@@ -648,4 +673,4 @@ class TxnExecutor:
                 # atomic rollback: drop the working set (fee stays)
                 return TxnResult(st, fee, ctx.logs)
         ctx.commit()
-        return TxnResult(OK, fee, ctx.logs)
+        return TxnResult(OK, fee, ctx.logs, ctx.return_data)

@@ -1,0 +1,2 @@
+"""vinyl: log-structured disk account store (ref: src/vinyl/)."""
+from .vinyl import Vinyl, VinylError  # noqa: F401

@@ -1,0 +1,192 @@
+"""Vinyl: the disk-resident account store under funk's published root.
+
+The reference's vinyl is a log-structured store driven by a dedicated
+tile over a bstream (append-only record log) with crash recovery by
+replaying the stream past the last sync point, plus GC/partitioning
+thresholds (ref: src/vinyl/fd_vinyl.h:13-29 — the SYNC/GET/SET/GC
+control verbs; src/groove/fd_groove.h:1-13 is the cold-store data
+layer). This module is that design re-expressed host-side:
+
+  * one append-only log file; every record CRC-framed
+  * in-memory index {key -> (offset, len)} rebuilt by scanning on open
+    (crash recovery: a torn tail fails its CRC and truncates there —
+    the bstream "resume from the current past" discipline)
+  * tombstones for deletes; `compact()` rewrites live records to a
+    fresh log and atomically renames it in (the GC verb)
+  * `sync()` fsyncs the log (the SYNC verb)
+
+Account values serialize through the checkpoint codec (utils/checkpt),
+so a vinyl log, a snapshot stream, and the funk root all speak the
+same record encoding.
+
+Record wire: u32 magic | u8 type (1 put, 2 del) | u16 klen | u32 vlen
+| key | val | u32 crc32(over all prior fields).
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+_MAGIC = 0xFD71A1C5
+_PUT, _DEL = 1, 2
+_HDR = struct.Struct("<IBHI")
+
+
+class VinylError(RuntimeError):
+    pass
+
+
+class Vinyl:
+    def __init__(self, path: str):
+        self.path = path
+        self.index: dict[bytes, tuple[int, int]] = {}
+        self.live_bytes = 0
+        self.dead_bytes = 0
+        self._fp = open(path, "a+b")
+        self._recover()
+
+    # -- recovery -----------------------------------------------------------
+
+    def _recover(self):
+        """Scan the log, rebuild the index, truncate a torn tail."""
+        self._fp.seek(0)
+        off = 0
+        data = self._fp.read()
+        n = len(data)
+        while off < n:
+            if off + _HDR.size > n:
+                break                        # torn header
+            magic, typ, klen, vlen = _HDR.unpack_from(data, off)
+            end = off + _HDR.size + klen + vlen + 4
+            if magic != _MAGIC or typ not in (_PUT, _DEL) or end > n:
+                break                        # torn/corrupt: stop here
+            body = data[off:end - 4]
+            (crc,) = struct.unpack_from("<I", data, end - 4)
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                break                        # torn tail
+            key = data[off + _HDR.size:off + _HDR.size + klen]
+            if typ == _PUT:
+                old = self.index.get(key)
+                if old is not None:
+                    self.dead_bytes += old[1]
+                    self.live_bytes -= old[1]
+                self.index[key] = (off, end - off)
+                self.live_bytes += end - off
+            else:
+                old = self.index.pop(key, None)
+                if old is not None:
+                    self.dead_bytes += old[1]
+                    self.live_bytes -= old[1]
+                self.dead_bytes += end - off
+            off = end
+        if off < n:
+            # torn tail: truncate to the last good record boundary
+            self._fp.truncate(off)
+        self._end = off
+
+    # -- ops ----------------------------------------------------------------
+
+    def _append(self, typ: int, key: bytes, val: bytes) -> int:
+        rec = _HDR.pack(_MAGIC, typ, len(key), len(val)) + key + val
+        rec += struct.pack("<I", zlib.crc32(rec) & 0xFFFFFFFF)
+        self._fp.seek(0, os.SEEK_END)
+        off = self._fp.tell()
+        self._fp.write(rec)
+        self._end = off + len(rec)
+        return off
+
+    def put(self, key: bytes, val: bytes):
+        if len(key) > 0xFFFF or len(val) > 0xFFFF_FFFF:
+            raise VinylError("record too large")
+        off = self._append(_PUT, key, val)
+        old = self.index.get(key)
+        if old is not None:
+            self.dead_bytes += old[1]
+            self.live_bytes -= old[1]
+        sz = _HDR.size + len(key) + len(val) + 4
+        self.index[key] = (off, sz)
+        self.live_bytes += sz
+
+    def get(self, key: bytes) -> bytes | None:
+        ent = self.index.get(key)
+        if ent is None:
+            return None
+        off, sz = ent
+        self._fp.seek(off)
+        rec = self._fp.read(sz)
+        magic, typ, klen, vlen = _HDR.unpack_from(rec, 0)
+        return rec[_HDR.size + klen:_HDR.size + klen + vlen]
+
+    def delete(self, key: bytes):
+        if key not in self.index:
+            return
+        self._append(_DEL, key, b"")
+        off, sz = self.index.pop(key)
+        self.dead_bytes += sz + _HDR.size + len(key) + 4
+        self.live_bytes -= sz
+
+    def sync(self):
+        self._fp.flush()
+        os.fsync(self._fp.fileno())
+
+    def __len__(self):
+        return len(self.index)
+
+    def keys(self):
+        return self.index.keys()
+
+    # -- GC -----------------------------------------------------------------
+
+    def compact(self):
+        """Rewrite live records into a fresh log; atomic rename-in
+        (the reference's GC pass)."""
+        tmp = self.path + ".compact"
+        new = Vinyl.__new__(Vinyl)
+        new.path = tmp
+        new.index = {}
+        new.live_bytes = 0
+        new.dead_bytes = 0
+        new._fp = open(tmp, "w+b")
+        new._end = 0
+        for key in list(self.index):
+            val = self.get(key)
+            new.put(key, val)
+        new.sync()
+        self._fp.close()
+        os.replace(tmp, self.path)
+        self._fp = new._fp
+        self.index = new.index
+        self.live_bytes = new.live_bytes
+        self.dead_bytes = 0
+        self._end = new._end
+
+    def maybe_compact(self, gc_thresh: float = 0.5):
+        """Compact when dead bytes dominate (FD_VINYL_OPT_GC_THRESH)."""
+        total = self.live_bytes + self.dead_bytes
+        if total and self.dead_bytes / total > gc_thresh:
+            self.compact()
+
+    def close(self):
+        self._fp.close()
+
+
+# ---------------------------------------------------------------------------
+# funk integration: the cold store under the published root
+# ---------------------------------------------------------------------------
+
+def store_root(funk, vinyl: Vinyl):
+    """Write funk's published root through to vinyl (accounts encode
+    via the checkpoint codec — one record format across snapshot,
+    checkpt, and the cold store)."""
+    from ..utils.checkpt import _enc_val
+    for key, val in funk.root_items().items():
+        vinyl.put(key, _enc_val(val))
+    vinyl.sync()
+
+
+def load_root(funk, vinyl: Vinyl):
+    """Restore vinyl's contents into funk's root (boot path)."""
+    from ..utils.checkpt import _dec_val
+    for key in vinyl.keys():
+        funk.rec_write(None, key, _dec_val(vinyl.get(key)))

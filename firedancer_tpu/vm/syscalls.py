@@ -80,6 +80,57 @@ def sys_sha256(vm, r1, r2, r3, r4, r5):
     return 0
 
 
+def sys_get_clock_sysvar(vm, r1, r2, r3, r4, r5):
+    """Write the 40-byte Clock sysvar (slot, epoch_start_timestamp,
+    epoch, leader_schedule_epoch, unix_timestamp — the Solana layout)
+    to r1 (ref: fd_vm_syscall_runtime.c sol_get_clock_sysvar,
+    fd_sysvar_clock.h). The executor injects vm.sysvars."""
+    vm.charge(CU_SYSCALL_BASE)
+    clock = getattr(vm, "sysvars", {}).get("clock", bytes(40))
+    vm.mem_write(r1, clock)
+    return 0
+
+
+def sys_get_rent_sysvar(vm, r1, r2, r3, r4, r5):
+    """17-byte Rent sysvar (lamports_per_byte_year u64, exemption
+    threshold f64, burn_percent u8)."""
+    vm.charge(CU_SYSCALL_BASE)
+    rent = getattr(vm, "sysvars", {}).get(
+        "rent", struct_pack_rent(3480, 2.0, 50))
+    vm.mem_write(r1, rent)
+    return 0
+
+
+def struct_pack_rent(lamports_per_byte_year: int, threshold: float,
+                     burn_percent: int) -> bytes:
+    import struct
+    return struct.pack("<Qd", lamports_per_byte_year, threshold) \
+        + bytes([burn_percent])
+
+
+RETURN_DATA_MAX = 1024
+
+
+def sys_set_return_data(vm, r1, r2, r3, r4, r5):
+    vm.charge(CU_SYSCALL_BASE + r2 // 250)
+    if r2 > RETURN_DATA_MAX:
+        raise VmFault(ERR_ABORT, "return data too large")
+    vm.return_data = vm.mem_read(r1, r2) if r2 else b""
+    vm.return_data_program = getattr(vm, "program_id", bytes(32))
+    return 0
+
+
+def sys_get_return_data(vm, r1, r2, r3, r4, r5):
+    vm.charge(CU_SYSCALL_BASE)
+    data = getattr(vm, "return_data", b"")
+    n = min(len(data), r2)
+    if n:
+        vm.mem_write(r1, data[:n])
+        vm.mem_write(r3, getattr(vm, "return_data_program",
+                                 bytes(32)))
+    return len(data)
+
+
 DEFAULT_SYSCALLS = {
     syscall_id(b"abort"): sys_abort,
     syscall_id(b"sol_log_"): sys_log,
@@ -88,4 +139,8 @@ DEFAULT_SYSCALLS = {
     syscall_id(b"sol_memset_"): sys_memset,
     syscall_id(b"sol_memcmp_"): sys_memcmp,
     syscall_id(b"sol_sha256"): sys_sha256,
+    syscall_id(b"sol_get_clock_sysvar"): sys_get_clock_sysvar,
+    syscall_id(b"sol_get_rent_sysvar"): sys_get_rent_sysvar,
+    syscall_id(b"sol_set_return_data"): sys_set_return_data,
+    syscall_id(b"sol_get_return_data"): sys_get_return_data,
 }

@@ -277,3 +277,55 @@ def test_real_cargo_build_sbf_program_executes(env):
     assert any("Hello, Solana!" in ln for ln in r.logs)
     # the program base58-prints its program id from the input region
     assert any("Program ID" in ln for ln in r.logs)
+
+
+REAL_CLOCK_SO = ("/root/reference/src/ballet/sbpf/fixtures/"
+                 "clock_sysvar_program.so")
+
+
+@pytest.mark.skipif(not __import__("os").path.exists(REAL_CLOCK_SO),
+                    reason="reference fixture tree not present")
+def test_real_clock_sysvar_program_reads_injected_clock(env):
+    """The real clock-sysvar fixture program executes against OUR
+    sysvar injection (sol_get_clock_sysvar) and returns clean."""
+    funk, db, ex = env
+    ex.slot, ex.epoch = 12345, 77
+    funk.rec_write("blk", PROG, Account(
+        lamports=1, data=open(REAL_CLOCK_SO, "rb").read(),
+        owner=BPF_LOADER_ID, executable=True))
+    r = ex.execute("blk", _txn([], b""))
+    assert r.status == OK, r.logs
+
+
+def test_cpi_return_data_propagates(env):
+    """A CPI callee's sol_set_return_data is visible to the caller and
+    surfaces in the txn result (the CPI-result ABI)."""
+    funk, db, ex = env
+    PROG_B = k(0x0B)
+    # B: set_return_data(input_data_ptr, 6); exit 0
+    data_va_b = INPUT_START + 4          # compact layout, 0 accounts
+    prog_b = asm(f"""
+        lddw r1, {data_va_b}
+        mov64 r2, 6
+        call {hex(elf.murmur3_32(b"sol_set_return_data"))}
+        mov64 r0, 0
+        exit
+    """)
+    funk.rec_write("blk", PROG_B, Account(
+        lamports=1, data=prog_b, owner=BPF_LOADER_ID, executable=True))
+    # A: CPI to B with data "from-B", no accounts, no signers
+    ix = PROG_B + struct.pack("<H", 0) + struct.pack("<H", 6) + b"from-B"
+    seeds = bytes([0])
+    data_va_a = INPUT_START + 2 + 0 * 42 + 2
+    prog_a = asm(f"""
+        lddw r1, {data_va_a}
+        lddw r2, {data_va_a + len(ix)}
+        call {hex(elf.murmur3_32(b"sol_invoke_signed_c"))}
+        mov64 r0, 0
+        exit
+    """)
+    funk.rec_write("blk", PROG, Account(
+        lamports=1, data=prog_a, owner=BPF_LOADER_ID, executable=True))
+    r = ex.execute("blk", _txn([], ix + seeds))
+    assert r.status == OK, r.logs
+    assert r.return_data == b"from-B"

@@ -1,0 +1,113 @@
+"""Solana bincode wire-type tests: size pins against the well-known
+Agave constants, round-trips, and runtime-state conversions
+(ref: src/flamenco/types/fd_types.c generated codecs; sizes
+StakeStateV2::size_of()==200, vote account size 3762)."""
+import pytest
+
+from firedancer_tpu.choreo.tower import TowerVote
+from firedancer_tpu.flamenco import types as t
+from firedancer_tpu.svm.stake import (
+    EPOCH_NONE, ST_DELEGATED, StakeState,
+)
+from firedancer_tpu.svm.vote import VoteState
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+def test_stake_state_size_pins():
+    # the famous 200-byte stake account
+    assert len(t.encode_stake_state("uninitialized")) == 200
+    assert len(t.encode_stake_state("stake", staker=k(1),
+                                    withdrawer=k(2))) == 200
+    # unpadded content sizes: disc 4 + meta 120 (= 4+8+32+32+8+8+32
+    # ... exactly Agave's Meta) + stake 73
+    raw = t.Writer()
+    raw.u32(2)
+    assert len(t.encode_stake_state("initialized").rstrip(b"\x00")) \
+        <= 4 + 120
+
+
+def test_stake_state_roundtrip():
+    b = t.encode_stake_state(
+        "stake", rent_exempt_reserve=2282880, staker=k(3),
+        withdrawer=k(4), voter=k(5), stake=7_000_000_000,
+        activation_epoch=11, deactivation_epoch=(1 << 64) - 1,
+        credits_observed=42, stake_flags=0)
+    d = t.decode_stake_state(b)
+    assert d["state"] == "stake"
+    assert d["rent_exempt_reserve"] == 2282880
+    assert d["voter"] == k(5) and d["stake"] == 7_000_000_000
+    assert d["warmup_cooldown_rate"] == 0.25
+    assert d["credits_observed"] == 42
+
+
+def test_vote_state_size_pin_and_roundtrip():
+    b = t.encode_vote_state(k(1), k(2), k(3), 5,
+                            votes=[(100, 31), (101, 30)],
+                            root_slot=99,
+                            epoch_credits=[(7, 1000, 900)],
+                            last_ts_slot=101, last_ts=1234567)
+    assert len(b) == 3762                    # the vote account size
+    d = t.decode_vote_state(b)
+    assert d["node_pubkey"] == k(1)
+    assert d["authorized_voter"] == k(2)
+    assert d["authorized_withdrawer"] == k(3)
+    assert d["commission"] == 5
+    assert d["votes"] == [(100, 31), (101, 30)]
+    assert d["root_slot"] == 99
+    assert d["epoch_credits"] == [(7, 1000, 900)]
+    assert d["last_ts"] == 1234567
+
+
+def test_vote_instruction_roundtrip():
+    b = t.encode_vote_instruction([5, 6, 7], k(9), timestamp=1700000000)
+    d = t.decode_vote_instruction(b)
+    assert d == {"slots": [5, 6, 7], "hash": k(9),
+                 "timestamp": 1700000000}
+    # layout spot-pin: u32 disc | u64 len | slots.. | hash | opt tag
+    assert b[:4] == b"\x02\x00\x00\x00"
+    assert b[4:12] == (3).to_bytes(8, "little")
+    assert b[12:20] == (5).to_bytes(8, "little")
+    b2 = t.encode_vote_instruction([1], k(1))
+    assert b2[-1:] == b"\x00"                # None timestamp tag
+
+
+def test_option_and_vec_edges():
+    r = t.Reader(b"\x02")
+    with pytest.raises(t.BincodeError):
+        r.option(r.u64)                      # bad tag
+    r = t.Reader((1 << 30).to_bytes(8, "little"))
+    with pytest.raises(t.BincodeError):
+        r.vec(r.u64)                         # absurd length
+    with pytest.raises(t.BincodeError):
+        t.Reader(b"\x01\x02").u64()          # truncated
+
+
+def test_runtime_stake_conversion_roundtrip():
+    st = StakeState(ST_DELEGATED, k(1), k(2), 1000, k(3), 5_000_000,
+                    4, EPOCH_NONE)
+    wire = t.stake_state_to_wire(st)
+    assert len(wire) == 200
+    back = t.stake_state_from_wire(wire)
+    assert (back.state, back.staker, back.withdrawer,
+            back.rent_reserve, back.voter, back.amount,
+            back.activation_epoch, back.deactivation_epoch) == \
+        (ST_DELEGATED, k(1), k(2), 1000, k(3), 5_000_000, 4, EPOCH_NONE)
+
+
+def test_runtime_vote_conversion_roundtrip():
+    vs = VoteState(k(1), k(2), k(3), 7)
+    for v in ((10, 3), (12, 2), (13, 1)):
+        vs.tower.votes.append(TowerVote(*v))
+    vs.root_slot = 9
+    vs.last_ts = 555
+    wire = t.vote_state_to_wire(vs)
+    assert len(wire) == 3762
+    back = t.vote_state_from_wire(wire)
+    assert back.node_pubkey == k(1)
+    assert back.authorized_voter == k(2)
+    assert [(v.slot, v.conf) for v in back.tower.votes] == \
+        [(10, 3), (12, 2), (13, 1)]
+    assert back.root_slot == 9 and back.last_ts == 555

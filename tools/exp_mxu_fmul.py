@@ -1,0 +1,166 @@
+"""MXU field-multiply experiment — PERF.md item 4, the 1M/s unlock.
+
+Question: can the 255-bit field multiply's digit convolution ride the
+MXU (systolic array) instead of the VPU? The VPU floor measured in r3
+is ~0.65 ns/fmul/lane (tools/exp_vpu.py, ops/pallas_ed.py roll-mac of
+20x20 radix-2^13 limbs). The MXU multiplies 128x128 int8/bf16 tiles
+per cycle-ish; if the convolution maps onto it at even ~5% utilization
+the constant changes by ~10x. (The reference's analogous move is
+exploiting the widest multiplier available:
+src/ballet/ed25519/avx512/fd_r43x6.h:10-32 — 52-bit IFMA lanes.)
+
+The 2^13 limb scheme cannot half-split uniformly (13 is odd), so the
+MXU formulations re-express elements in RADIX 2^7: 37 int8 digits
+(pad to 40). Products of 7-bit digits are <=14 bits; 40-term
+convolution sums stay < 2^20 — exact in int32 accumulation, which is
+what the TPU's int8 MXU path produces natively.
+
+Formulations measured (batch B lanes):
+
+  vpu    roll-mac digit convolution in radix 2^7 (the control: same
+         digit count, same unit of work, VPU lanes)
+  toep   per-lane Toeplitz matrix built with jnp.roll, then ONE
+         batched dot_general  C[b,k] = sum_i T[b,k,i] a[b,i]
+         (int8 x int8 -> int32, contraction 40 — the MXU candidate)
+  onehot Toeplitz build itself as a matmul against a CONSTANT one-hot
+         tensor (b,40)@(40,79*40), then the batched matvec — both
+         stages MXU, no per-lane roll chains
+
+Each formulation is timed with the in-graph repeat methodology
+(PERF.md: lax.fori_loop with data dependence so per-dispatch tunnel
+latency amortizes) and byte-checked against the Python bigint oracle.
+
+Run on the chip:  python tools/exp_mxu_fmul.py [--batch 1024] [--reps 64]
+(on CPU it validates correctness; the ns numbers only mean something
+on TPU hardware).
+"""
+import argparse
+import time
+
+import numpy as np
+
+N_DIG = 40          # radix-2^7 digits (37 used, 3 slack)
+OUT_DIG = 2 * N_DIG - 1
+
+
+def to_digits(x: int) -> np.ndarray:
+    return np.array([(x >> (7 * i)) & 0x7F for i in range(N_DIG)],
+                    np.int8)
+
+
+def from_digits(d) -> int:
+    return sum(int(v) << (7 * i) for i, v in enumerate(np.asarray(d)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    B = args.batch
+    rng = np.random.default_rng(1)
+    P = (1 << 255) - 19
+    av = [int.from_bytes(rng.bytes(31), "little") for _ in range(B)]
+    bv = [int.from_bytes(rng.bytes(31), "little") for _ in range(B)]
+    A = jnp.asarray(np.stack([to_digits(x) for x in av]))   # (B, 40) i8
+    Bm = jnp.asarray(np.stack([to_digits(x) for x in bv]))
+
+    # --- formulations -----------------------------------------------------
+
+    def conv_vpu(a, b):
+        """Control: roll-mac convolution on the VPU (int32 lanes)."""
+        a32 = a.astype(jnp.int32)
+        b32 = b.astype(jnp.int32)
+        acc = jnp.zeros((a.shape[0], OUT_DIG), jnp.int32)
+        for i in range(N_DIG):
+            term = a32[:, i:i + 1] * b32                    # (B, 40)
+            acc = acc.at[:, i:i + N_DIG].add(term)
+        return acc
+
+    def conv_toep(a, b):
+        """Per-lane Toeplitz + one batched int8 dot_general (MXU)."""
+        # T[b, k, i] = b_digits[b, k - i]  (0 outside range)
+        bz = jnp.pad(b, ((0, 0), (0, OUT_DIG - N_DIG)))     # (B, 79)
+        rows = [jnp.roll(bz, i, axis=1) for i in range(N_DIG)]
+        T = jnp.stack(rows, axis=2)                         # (B, 79, 40)
+        # zero the wrapped tail of each roll
+        mask = np.zeros((OUT_DIG, N_DIG), np.int8)
+        for i in range(N_DIG):
+            mask[i:i + N_DIG, i] = 1
+        T = T * jnp.asarray(mask)[None]
+        return jax.lax.dot_general(
+            T, a, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)               # (B, 79)
+
+    # constant one-hot shift tensor: S[j, k*40+i] = 1 iff k == i + j
+    S_np = np.zeros((N_DIG, OUT_DIG * N_DIG), np.int8)
+    for j in range(N_DIG):
+        for i in range(N_DIG):
+            S_np[j, (i + j) * N_DIG + i] = 1
+    S = jnp.asarray(S_np)
+
+    def conv_onehot(a, b):
+        """Both stages as matmuls: Toeplitz build via the constant
+        one-hot tensor, then the batched matvec."""
+        T = jax.lax.dot_general(
+            b, S, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)               # (B, 79*40)
+        T = T.reshape(b.shape[0], OUT_DIG, N_DIG).astype(jnp.int8)
+        return jax.lax.dot_general(
+            T, a, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)
+
+    forms = {"vpu": conv_vpu, "toep": conv_toep, "onehot": conv_onehot}
+
+    # --- correctness vs the bigint oracle ---------------------------------
+    for name, fn in forms.items():
+        out = np.asarray(jax.jit(fn)(A, Bm))
+        for lane in (0, 1, B - 1):
+            got = sum(int(v) << (7 * k) for k, v in enumerate(out[lane]))
+            want = av[lane] * bv[lane]
+            assert got == want, (name, lane)
+        print(f"{name:7s} correctness ok (raw 510-bit products exact)")
+
+    # --- timing (in-graph repeat, data-dependent) --------------------------
+    dev = jax.devices()[0]
+    print(f"platform: {dev.platform} ({dev.device_kind})")
+    results = {}
+    for name, fn in forms.items():
+        def repeat(a, b, fn=fn):
+            def body(_, carry):
+                a, b = carry
+                c = fn(a, b)
+                # fold the output back into the inputs (data dependence)
+                a2 = (a.astype(jnp.int32)
+                      + c[:, :N_DIG]) % 127
+                return a2.astype(jnp.int8), b
+            a, b = jax.lax.fori_loop(0, args.reps, body, (a, b))
+            return a
+        jf = jax.jit(repeat)
+        jf(A, Bm).block_until_ready()                       # compile
+        t0 = time.perf_counter()
+        jf(A, Bm).block_until_ready()
+        dt = time.perf_counter() - t0
+        ns = dt / args.reps / B * 1e9
+        results[name] = ns
+        print(f"{name:7s} {ns:8.2f} ns/fmul-conv/lane "
+              f"({args.reps} reps, batch {B})")
+
+    # --- verdict -----------------------------------------------------------
+    base = results["vpu"]
+    best = min(results, key=results.get)
+    speedup = base / results[best]
+    # the r3 Pallas roll-mac does the same convolution (radix 2^13) in
+    # ~0.65 ns/lane; a formulation must beat the VPU control by >2x to
+    # justify the radix-2^7 conversion overhead it drags into the kernel
+    verdict = "GO" if best != "vpu" and speedup > 2.0 else "NO-GO"
+    print(f"best={best} speedup_vs_vpu_control={speedup:.2f}x "
+          f"-> {verdict} (decision threshold 2.0x; update PERF.md)")
+
+
+if __name__ == "__main__":
+    main()
