@@ -42,6 +42,13 @@ def tile_main(plan: dict, tile_name: str):
     elif os.environ.get("FDTPU_JAX_PLATFORM"):
         os.environ.setdefault("JAX_PLATFORMS",
                               os.environ["FDTPU_JAX_PLATFORM"])
+    # core pinning (ref: src/util/tile/fd_tile.h:6-38 — tiles pin to
+    # dedicated cores; here args.cpu_idx pins this tile PROCESS via
+    # sched_setaffinity, clamped to the machine's online set)
+    cpu_idx = plan["tiles"][tile_name]["args"].get("cpu_idx")
+    if cpu_idx is not None:
+        avail = sorted(os.sched_getaffinity(0))
+        os.sched_setaffinity(0, {avail[int(cpu_idx) % len(avail)]})
     # per-tile thread-tagged logging (ref: fd_topo_run.c
     # initialize_logging before tile init)
     from ..utils import log

@@ -1,0 +1,95 @@
+"""Bank hash: the per-slot state commitment.
+
+The reference assembles each slot's bank hash from the parent bank
+hash, the accounts delta (now the homomorphic lattice hash), the
+signature count, and the last blockhash (ref: fd_runtime bank-hash
+assembly; lthash accumulator per src/ballet/lthash/fd_lthash.h — the
+accounts_lt_hash feature). TPU-first shape: every modified account's
+lattice element is one lane of ONE batched blake3-XOF device call
+(ops/blake3.lthash_batch), and the accumulator update is a pair of
+wrapping u16 vector sums — the same lthash kernels the snapshot
+pipeline uses.
+
+  account_lt(pubkey, account) = lthash_2048(serialized account)
+  acc' = acc - Σ account_lt(old_i) + Σ account_lt(new_i)
+  bank_hash = sha256(parent || checksum(acc') || sig_cnt_le || blockhash)
+
+Zero-lamport (deleted) accounts contribute nothing — removing an
+account subtracts its old element only, mirroring the reference's
+delete discipline."""
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+
+LT_MSG_MAX = 2048           # lthash_batch input cap per lane
+
+
+def serialize_account(pubkey: bytes, acct) -> bytes:
+    """Canonical per-account hash input: lamports | rent_epoch |
+    data | executable | owner | pubkey (the reference's account-hash
+    field order, truncated to the lattice input cap; longer data folds
+    through sha256 first so every account hashes in one lane)."""
+    data = acct.data
+    head = struct.pack("<QQ", acct.lamports, acct.rent_epoch)
+    tail = bytes([1 if acct.executable else 0]) + acct.owner + pubkey
+    if len(head) + len(data) + len(tail) > LT_MSG_MAX:
+        data = hashlib.sha256(data).digest()
+    return head + data + tail
+
+
+def accounts_lthash(items) -> np.ndarray:
+    """[(pubkey, Account)] -> summed lattice element (1024 u16), all
+    lanes in one batched device call. Zero-lamport accounts skip."""
+    from ..ops.blake3 import lthash_batch
+    msgs, lens = [], []
+    for pk, a in items:
+        if a is None or a.lamports == 0:
+            continue
+        m = serialize_account(pk, a)
+        buf = np.zeros(LT_MSG_MAX, np.uint8)
+        buf[:len(m)] = np.frombuffer(m, np.uint8)
+        msgs.append(buf)
+        lens.append(len(m))
+    if not msgs:
+        return np.zeros(1024, np.uint16)
+    lt = np.asarray(lthash_batch(np.stack(msgs),
+                                 np.asarray(lens, np.int32)))
+    return lt.astype(np.uint32).sum(axis=0).astype(np.uint16)
+
+
+class BankHasher:
+    """Running accounts lattice + the per-slot hash chain."""
+
+    def __init__(self, acc: np.ndarray | None = None):
+        self.acc = (np.zeros(1024, np.uint16) if acc is None
+                    else acc.astype(np.uint16))
+
+    def apply_delta(self, old_items, new_items):
+        """old/new: [(pubkey, Account|None)] for every record the slot
+        modified (old = parent-visible value)."""
+        self.acc = (self.acc
+                    - accounts_lthash(old_items)
+                    + accounts_lthash(new_items))
+
+    def checksum(self) -> bytes:
+        """32-byte lattice checksum (blake3 of the 2048-byte element in
+        the reference; sha256 here — internal commitment, documented)."""
+        return hashlib.sha256(self.acc.tobytes()).digest()
+
+    def bank_hash(self, parent: bytes, sig_cnt: int,
+                  last_blockhash: bytes) -> bytes:
+        return hashlib.sha256(
+            parent + self.checksum()
+            + struct.pack("<Q", sig_cnt) + last_blockhash).digest()
+
+
+def lthash_of_root(funk) -> np.ndarray:
+    """Full recompute over the published root (the snapshot-verify
+    fan-out; the delta path must always agree with this oracle)."""
+    from ..svm.accdb import Account
+    items = [(k, v) for k, v in funk.root_items().items()
+             if isinstance(v, Account)]
+    return accounts_lthash(items)

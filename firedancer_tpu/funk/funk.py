@@ -183,6 +183,15 @@ class Funk:
     def root_items(self):
         return dict(self._root)
 
+    def txn_recs(self, xid) -> dict:
+        """The in-preparation txn's OWN pending writes (no ancestor
+        fold; tombstones surface as None) — the bank-hash delta scan."""
+        t = self._txns.get(xid)
+        if t is None:
+            raise FunkTxnError(f"unknown txn {xid!r}")
+        return {k: (None if v is _TOMBSTONE else v)
+                for k, v in t.recs.items()}
+
     def items_at(self, xid) -> dict:
         """All records visible at xid: the same fork-overlay visibility
         rule as rec_query, folded over the whole keyspace (nearest

@@ -90,6 +90,14 @@ def meta_from_payload(payload: bytes, seq: int = 0,
                       reward: int | None = None,
                       cost: int | None = None) -> TxnMeta:
     t = parse_txn(payload)
+    if t.version == 0 and t.aluts:
+        # pack's conflict bitsets require RESOLVED account sets; the
+        # reference resolves v0 table loads upstream of pack (the
+        # resolv tile, src/discof/resolv/). Until that tile lands in
+        # the leader topology, unresolved v0 txns are refused here —
+        # mis-scheduling them would break the serial-fiction invariant
+        from .cost import CostError
+        raise CostError("unresolved v0 address table lookups")
     keys = t.account_keys(payload)
     writes = tuple(k for i, k in enumerate(keys) if t.is_writable(i))
     reads = tuple(k for i, k in enumerate(keys) if not t.is_writable(i))

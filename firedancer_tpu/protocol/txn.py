@@ -75,6 +75,8 @@ class ParsedTxn:
     blockhash_off: int
     instrs: list[Instr] = field(default_factory=list)
     alut_cnt: int = 0
+    # v0: [(table_key, writable_idxs bytes, readonly_idxs bytes)]
+    aluts: tuple = ()
 
     def signatures(self, payload: bytes) -> list[bytes]:
         return [payload[self.sig_off + 64 * i: self.sig_off + 64 * (i + 1)]
@@ -162,7 +164,9 @@ def parse_txn(payload: bytes) -> ParsedTxn:
         off += n_acct
         if off > len(payload):
             raise TxnParseError("truncated instr accounts")
-        if any(ix >= acct_cnt for ix in acct_idxs):
+        if version != 0 and any(ix >= acct_cnt for ix in acct_idxs):
+            # v0 indexes may address table-loaded accounts; bounded
+            # below once the alut section is parsed
             raise TxnParseError("instr account index out of range")
         n_data, off = _cu16(payload, off)
         data_off = off
@@ -172,23 +176,36 @@ def parse_txn(payload: bytes) -> ParsedTxn:
         instrs.append(Instr(prog_idx, acct_idxs, data_off, n_data))
 
     alut_cnt = 0
+    aluts = []
     if version == 0:
         alut_cnt, off = _cu16(payload, off)
         for _ in range(alut_cnt):
+            tkey = payload[off:off + 32]
             off += 32
             n_w, off = _cu16(payload, off)
+            w_idxs = payload[off:off + n_w]
             off += n_w
             n_ro, off = _cu16(payload, off)
+            ro_idxs = payload[off:off + n_ro]
             off += n_ro
             if off > len(payload):
                 raise TxnParseError("truncated address lookup table")
+            aluts.append((tkey, w_idxs, ro_idxs))
+        # now the loaded-account count is known: bound every instr
+        # index against static + loaded (consumers like the pack cost
+        # model index keys BEFORE resolution and must never IndexError)
+        n_loaded = sum(len(w) + len(r) for _, w, r in aluts)
+        for ins in instrs:
+            if ins.prog_idx >= acct_cnt + n_loaded or any(
+                    ix >= acct_cnt + n_loaded for ix in ins.acct_idxs):
+                raise TxnParseError("instr account index out of range")
 
     if off != len(payload):
         raise TxnParseError(f"trailing bytes: {len(payload) - off}")
 
     return ParsedTxn(sig_cnt, sig_off, msg_off, version, n_signed,
                      n_ro_signed, n_ro_unsigned, acct_cnt, acct_off,
-                     blockhash_off, instrs, alut_cnt)
+                     blockhash_off, instrs, alut_cnt, tuple(aluts))
 
 
 def parse_message_shape(data: bytes) -> bool:
@@ -270,8 +287,12 @@ def _cu16_enc(v: int) -> bytes:
 def build_message(signer_pubkeys: list[bytes], extra_accounts: list[bytes],
                   blockhash: bytes, instrs: list[tuple[int, bytes, bytes]],
                   n_ro_signed: int = 0, n_ro_unsigned: int = 0,
-                  version: int = -1) -> bytes:
-    """instrs: (prog_idx, acct_idxs, data)."""
+                  version: int = -1, aluts=()) -> bytes:
+    """instrs: (prog_idx, acct_idxs, data).
+    aluts (v0): [(table_key, writable_idxs, readonly_idxs)] — loaded
+    addresses extend the key list past the static accounts, writables
+    first (the reference's v0 address-table section,
+    src/ballet/txn/fd_txn.h address table lookups)."""
     accounts = list(signer_pubkeys) + list(extra_accounts)
     out = bytearray()
     if version == 0:
@@ -289,7 +310,12 @@ def build_message(signer_pubkeys: list[bytes], extra_accounts: list[bytes],
         out += _cu16_enc(len(acct_idxs)) + bytes(acct_idxs)
         out += _cu16_enc(len(data)) + bytes(data)
     if version == 0:
-        out += _cu16_enc(0)  # no ALUTs
+        out += _cu16_enc(len(aluts))
+        for tkey, w_idxs, ro_idxs in aluts:
+            assert len(tkey) == 32
+            out += tkey
+            out += _cu16_enc(len(w_idxs)) + bytes(w_idxs)
+            out += _cu16_enc(len(ro_idxs)) + bytes(ro_idxs)
     return bytes(out)
 
 

@@ -98,10 +98,14 @@ class Shredder:
     fd_shredder.h:249-266)."""
 
     def __init__(self, sign_fn, shred_version: int = 0,
-                 rs_backend: str = "host"):
+                 rs_backend: str = "host", tpool=None):
+        """tpool: optional utils.tpool.TPool — parallelizes the
+        per-shred merkle leaf hashing (sha256 releases the GIL, the
+        fd_tpool_exec_all pattern; P5 on the host side)."""
         self.sign_fn = sign_fn
         self.shred_version = shred_version
         self.rs_backend = rs_backend
+        self.tpool = tpool
         self.slot = None
         self.data_idx = 0
         self.parity_idx = 0
@@ -216,10 +220,14 @@ class Shredder:
         # -- merkle tree over all shreds' leaf regions --
         d_region = fmt.data_merkle_region_sz(d_variant)
         c_region = fmt.code_merkle_region_sz(c_variant)
-        leaves = [shred_merkle_leaf(bytes(w[64:64 + d_region]))
-                  for w in data_wires]
-        leaves += [shred_merkle_leaf(bytes(w[64:64 + c_region]))
-                   for w in code_wires]
+        regions = [bytes(w[64:64 + d_region]) for w in data_wires] \
+            + [bytes(w[64:64 + c_region]) for w in code_wires]
+        if self.tpool is not None:
+            leaves = self.tpool.map_chunks(
+                lambda chunk: [shred_merkle_leaf(r) for r in chunk],
+                regions)
+        else:
+            leaves = [shred_merkle_leaf(r) for r in regions]
         tree = MerkleTree20(leaves)
         root = tree.root
         sig = self.sign_fn(root)

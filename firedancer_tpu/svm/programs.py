@@ -72,6 +72,7 @@ ERR_BAD_IX_DATA = "bad_instruction_data"
 ERR_VM = "program_failed"
 ERR_BALANCE_VIOLATION = "sum_of_lamports_changed"
 ERR_CPI = "cpi_violation"
+ERR_ALUT = "alut_resolution_failed"
 
 
 @dataclass
@@ -86,14 +87,18 @@ class TxnContext:
     """Per-txn view: copy-on-write accounts over one accdb fork."""
 
     def __init__(self, db: AccDb, xid, txn: ParsedTxn, payload: bytes,
-                 epoch: int = 0, slot: int = 0):
+                 epoch: int = 0, slot: int = 0, loaded_keys=(),
+                 loaded_writable=()):
         self.db = db
         self.xid = xid
         self.txn = txn
         self.payload = payload
         self.epoch = epoch            # Clock-sysvar stand-in
         self.slot = slot
-        self.keys = txn.account_keys(payload)
+        # v0: table-loaded addresses extend the static list (writables
+        # first — the resolv contract, svm/alut.py)
+        self.keys = txn.account_keys(payload) + list(loaded_keys)
+        self._loaded_writable = list(loaded_writable)
         self._work: dict[bytes, Account] = {}
         self.logs: list[str] = []
         self.last_exec_cu = 0        # CU used by the last BPF frame
@@ -104,6 +109,8 @@ class TxnContext:
         return idx < self.txn.sig_cnt
 
     def is_writable(self, idx: int) -> bool:
+        if idx >= self.txn.acct_cnt:
+            return self._loaded_writable[idx - self.txn.acct_cnt]
         return self.txn.is_writable(idx)
 
     def account(self, idx: int) -> Account:
@@ -619,6 +626,7 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
                    budget: int | None = None) -> str:
     """Route one instruction frame to its program (the fd_executor
     native-program dispatch switch + BPF fallback)."""
+    from .alut import ALUT_PROGRAM_ID, exec_alut
     from .stake import STAKE_PROGRAM_ID, exec_stake
     from .vote import VOTE_PROGRAM_ID, exec_vote
     pid = ic.program_id
@@ -628,6 +636,8 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
         return exec_vote(ic)
     if pid == STAKE_PROGRAM_ID:
         return exec_stake(ic)
+    if pid == ALUT_PROGRAM_ID:
+        return exec_alut(ic)
     if pid == COMPUTE_BUDGET_PROGRAM_ID:
         return OK                    # limits handled by pack/cost
     pa = ctx.db.peek(ctx.xid, pid)
@@ -662,9 +672,24 @@ class TxnExecutor:
         payer.account.lamports -= fee
         self.db.close_rw(payer)
 
+        loaded_keys, loaded_writable = (), ()
+        if txn.version == 0 and txn.aluts:
+            from .alut import AlutResolveError, resolve_loaded_keys
+            try:
+                loaded_keys, loaded_writable = resolve_loaded_keys(
+                    self.db, xid, txn, slot=self.slot)
+            except AlutResolveError:
+                return TxnResult(ERR_ALUT, fee, [])
         ctx = TxnContext(self.db, xid, txn, payload, epoch=self.epoch,
-                         slot=self.slot)
+                         slot=self.slot, loaded_keys=loaded_keys,
+                         loaded_writable=loaded_writable)
+        keys = ctx.keys                # static + table-loaded
+        total = len(keys)
         for instr in txn.instrs:
+            # v0 defers the index bound to post-resolution
+            if instr.prog_idx >= total or \
+                    any(i >= total for i in instr.acct_idxs):
+                return TxnResult(ERR_PARSE, fee, ctx.logs)
             data = payload[instr.data_off:instr.data_off + instr.data_sz]
             ic = InstrCtx(ctx, keys[instr.prog_idx],
                           list(instr.acct_idxs), data)

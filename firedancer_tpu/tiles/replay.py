@@ -47,9 +47,14 @@ class ReplayCore:
         self.out_fseqs = out_fseqs
         self.hashes_per_tick = hashes_per_tick
         self.verify_poh = verify_poh
+        from ..flamenco.bank_hash import BankHasher, lthash_of_root
         self.next_slot: int | None = None     # next slot to execute
         self.pending: dict[int, bytes] = {}   # completed, not yet run
         self.hash_of: dict[int, bytes] = {}   # slot -> final PoH hash
+        self.bank_hash_of: dict[int, bytes] = {}
+        # seed the accounts lattice from the boot state (the reference
+        # initializes accounts_lt_hash from the snapshot)
+        self.hasher = BankHasher(lthash_of_root(self.funk))
         self.anchored = False                 # saw a full prior slot
         self.metrics = {"slices": 0, "slots_replayed": 0, "entries": 0,
                         "txns": 0, "exec_ok": 0, "exec_fail": 0,
@@ -92,13 +97,21 @@ class ReplayCore:
             if not self._verify_entries(prev, entries):
                 self.metrics["poh_fail"] += 1
         txns = [t for _, _, ts in entries for t in ts]
+        self._slot_sigs = 0          # set per slot by _execute
         self._execute(slot, txns)
         tip = entries[-1][1] if entries else (prev or bytes(32))
         self.hash_of[slot] = tip
-        parent_id = self.hash_of.get(slot - 1) or \
+        # block identity = the BANK HASH (state commitment chained from
+        # the parent; flamenco/bank_hash.py), not the PoH tip — forks
+        # that diverge in state diverge in id (the reference's block id)
+        parent_bank = self.bank_hash_of.get(slot - 1) or \
             hashlib.sha256(b"fdtpu-parent" + (slot - 1).to_bytes(
                 8, "little", signed=True)).digest()
-        self.hash_of.setdefault(slot - 1, parent_id)
+        self.bank_hash_of.setdefault(slot - 1, parent_bank)
+        bank_hash = self.hasher.bank_hash(parent_bank, self._slot_sigs,
+                                          tip)
+        self.bank_hash_of[slot] = bank_hash
+        tip, parent_id = bank_hash, parent_bank
         if self.out_ring is not None:
             import time
             while self.out_fseqs and \
@@ -115,6 +128,8 @@ class ReplayCore:
             cut = slot - 512
             self.hash_of = {s: h for s, h in self.hash_of.items()
                             if s >= cut}
+            self.bank_hash_of = {
+                s: h for s, h in self.bank_hash_of.items() if s >= cut}
 
     def _verify_entries(self, prev: bytes, entries) -> bool:
         """Batched device verification of a slice's PoH chain
@@ -145,6 +160,7 @@ class ReplayCore:
         fiction; rdisp.waves() is the device-dispatch shape)."""
         if not txns:
             return
+        from ..svm.alut import AlutResolveError, resolve_loaded_keys
         dag = ConflictDag()
         parsed = []
         for t in txns:
@@ -156,10 +172,20 @@ class ReplayCore:
                 dag.add_txn((), ())
                 continue
             keys = p.account_keys(t)
-            writes = [keys[i] for i in range(p.acct_cnt)
-                      if p.is_writable(i)]
-            reads = [keys[i] for i in range(p.acct_cnt)
-                     if not p.is_writable(i)]
+            flags = [p.is_writable(i) for i in range(p.acct_cnt)]
+            if p.version == 0 and p.aluts:
+                # table-loaded accounts MUST be in the conflict graph
+                # (the serial-fiction invariant) — resolve before
+                # scheduling, like the reference's resolv-before-exec
+                try:
+                    lk, lw = resolve_loaded_keys(self.db, None, p,
+                                                 slot=slot)
+                    keys = keys + lk
+                    flags = flags + lw
+                except AlutResolveError:
+                    pass             # executor fails it; no state touch
+            writes = [k for k, w in zip(keys, flags) if w]
+            reads = [k for k, w in zip(keys, flags) if not w]
             parsed.append(p)
             dag.add_txn(writes, reads)
         xid = ("replay", slot)
@@ -176,4 +202,16 @@ class ReplayCore:
                     self.metrics["exec_ok"] += 1
                 else:
                     self.metrics["exec_fail"] += 1
+        self._slot_sigs = sum(p.sig_cnt for p in parsed
+                              if p is not None)
+        # accounts-delta lattice update: old values from the parent
+        # view, new from the slot's pending writes — one batched
+        # device lthash per side (flamenco/bank_hash.py)
+        recs = self.funk.txn_recs(xid)
+        old_items = [(key, v) for key in recs
+                     if isinstance(v := self.funk.rec_query(None, key),
+                                   Account)]
+        new_items = [(key, v) for key, v in recs.items()
+                     if isinstance(v, Account)]
+        self.hasher.apply_delta(old_items, new_items)
         self.funk.txn_publish(xid)

@@ -1,0 +1,93 @@
+"""tpool: fork-join thread pool for host-parallel work.
+
+The reference's tpool is a spin-synchronized worker group with
+exec_all range-splitting (ref: src/util/tpool/fd_tpool.h:933-972 —
+FD_TPOOL_EXEC_ALL family: split [i0,i1) across workers, barrier at
+the end). Python translation notes (documented divergence): workers
+are threads, so the wins come from GIL-RELEASING workloads — hashlib,
+zlib, numpy, socket IO — which is exactly the host-side profile this
+framework keeps off the TPU (merkle leaf hashing, checkpoint
+compression, signature oracles). Pure-python loops won't speed up;
+that work belongs in batched device kernels instead.
+
+Workers are persistent (created once, woken per fork-join), matching
+the reference's "tpool threads are parked, not respawned" discipline.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class TPool:
+    def __init__(self, workers: int = 4):
+        if workers < 1:
+            raise ValueError("workers >= 1")
+        self.n = workers
+        self._fn = None
+        self._ranges: list[tuple[int, int]] = []
+        self._go = [threading.Event() for _ in range(workers)]
+        self._done = [threading.Event() for _ in range(workers)]
+        self._errs: list = [None] * workers
+        self._halt = False
+        self._threads = [
+            threading.Thread(target=self._worker, args=(i,), daemon=True)
+            for i in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self, wid: int):
+        while True:
+            self._go[wid].wait()
+            self._go[wid].clear()
+            if self._halt:
+                return
+            try:
+                i0, i1 = self._ranges[wid]
+                if i0 < i1:
+                    self._fn(wid, i0, i1)
+            except Exception as e:          # surfaced at join
+                self._errs[wid] = e
+            self._done[wid].set()
+
+    def exec_all(self, fn, n_items: int):
+        """fork-join: fn(worker_idx, i0, i1) over [0, n_items) split
+        into contiguous ranges (fd_tpool_exec_all_rrobin's blocked
+        flavor). Blocks until every worker finishes; re-raises the
+        first worker exception."""
+        if n_items <= 0:
+            return
+        self._fn = fn
+        per = -(-n_items // self.n)
+        self._ranges = [(min(i * per, n_items),
+                         min((i + 1) * per, n_items))
+                        for i in range(self.n)]
+        self._errs = [None] * self.n
+        for d in self._done:
+            d.clear()
+        for g in self._go:
+            g.set()
+        for d in self._done:
+            d.wait()
+        for e in self._errs:
+            if e is not None:
+                raise e
+
+    def map_chunks(self, fn, items: list) -> list:
+        """Convenience: fn(sublist) per worker range; returns results
+        in item order (list concatenation of range outputs)."""
+        out: list = [None] * self.n
+        def run(wid, i0, i1):
+            out[wid] = fn(items[i0:i1])
+        self.exec_all(run, len(items))
+        res = []
+        for part in out:
+            if part:
+                res.extend(part)
+        return res
+
+    def close(self):
+        self._halt = True
+        for g in self._go:
+            g.set()
+        for t in self._threads:
+            t.join(timeout=1)

@@ -1,0 +1,158 @@
+"""Address lookup table program + v0 resolution tests
+(ref: src/flamenco/runtime/program/fd_address_lookup_table_program.c,
+src/discof/resolv/ — the v0 loaded-addresses contract)."""
+import struct
+
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn, parse_txn
+from firedancer_tpu.svm import AccDb, Account, TxnExecutor
+from firedancer_tpu.svm.accdb import SYSTEM_PROGRAM_ID
+from firedancer_tpu.svm.alut import (
+    ALUT_PROGRAM_ID, AlutState, SLOT_MAX, derive_table_address,
+    ix_close, ix_create, ix_deactivate, ix_extend, resolve_loaded_keys,
+)
+from firedancer_tpu.svm.programs import (
+    ERR_ALUT, ERR_INVALID_OWNER, ERR_MISSING_SIG, OK,
+)
+
+FEE = 5000
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+PAYER = k(1)
+LOOKED_UP = [k(0x41), k(0x42), k(0x43)]
+
+
+def txn(signers, extra, instrs, n_ro_unsigned=0, version=-1, aluts=()):
+    msg = build_message(signers, extra, b"\x11" * 32, instrs,
+                        n_ro_unsigned=n_ro_unsigned, version=version,
+                        aluts=aluts)
+    return build_txn([bytes(64)] * len(signers), msg)
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, PAYER, Account(lamports=1 << 30))
+    funk.txn_prepare(None, "blk")
+    ex = TxnExecutor(db)
+    ex.slot = 100
+    return funk, db, ex
+
+
+def _create_and_extend(funk, db, ex, addresses):
+    table, bump = derive_table_address(PAYER, 90)
+    r = ex.execute("blk", txn(
+        [PAYER], [table, ALUT_PROGRAM_ID],
+        [(2, bytes([1, 0]), ix_create(90, bump))], n_ro_unsigned=1))
+    assert r.status == OK, r.status
+    r = ex.execute("blk", txn(
+        [PAYER], [table, ALUT_PROGRAM_ID],
+        [(2, bytes([1, 0]), ix_extend(addresses))], n_ro_unsigned=1))
+    assert r.status == OK, r.status
+    return table
+
+
+def test_create_extend_state(env):
+    funk, db, ex = env
+    table = _create_and_extend(funk, db, ex, LOOKED_UP)
+    st = AlutState.from_bytes(db.peek("blk", table).data)
+    assert st.addresses == LOOKED_UP
+    assert st.authority == PAYER
+    assert st.deactivation_slot == SLOT_MAX
+    assert st.last_extended_slot == 100
+
+
+def test_create_rejects_wrong_pda(env):
+    funk, db, ex = env
+    _, bump = derive_table_address(PAYER, 90)
+    r = ex.execute("blk", txn(
+        [PAYER], [k(0x77), ALUT_PROGRAM_ID],
+        [(2, bytes([1, 0]), ix_create(90, bump))], n_ro_unsigned=1))
+    assert r.status == ERR_INVALID_OWNER
+
+
+def test_extend_requires_authority_signature(env):
+    funk, db, ex = env
+    table = _create_and_extend(funk, db, ex, LOOKED_UP[:1])
+    evil = k(0x66)
+    funk.rec_write("blk", evil, Account(lamports=1 << 30))
+    r = ex.execute("blk", txn(
+        [evil], [table, PAYER, ALUT_PROGRAM_ID],
+        [(3, bytes([1, 2]), ix_extend([k(0x55)]))], n_ro_unsigned=2))
+    # authority (PAYER) is present but NOT a signer
+    assert r.status == ERR_MISSING_SIG
+
+
+def test_v0_txn_executes_through_looked_up_account(env):
+    """A v0 transfer whose destination exists ONLY via the lookup
+    table: resolution extends the key list and the transfer lands."""
+    funk, db, ex = env
+    table = _create_and_extend(funk, db, ex, LOOKED_UP)
+    # static keys: [PAYER, SYSTEM]; loaded writable idx 2 -> LOOKED_UP[1]
+    t = txn([PAYER], [SYSTEM_PROGRAM_ID],
+            [(1, bytes([0, 2]), struct.pack("<IQ", 2, 999))],
+            n_ro_unsigned=1, version=0,
+            aluts=[(table, bytes([1]), b"")])
+    parsed = parse_txn(t)
+    assert parsed.aluts[0][0] == table
+    keys, flags = resolve_loaded_keys(db, "blk", parsed, slot=100)
+    assert keys == [LOOKED_UP[1]] and flags == [True]
+    r = ex.execute("blk", t)
+    assert r.status == OK, r.status
+    assert db.lamports("blk", LOOKED_UP[1]) == 999
+
+
+def test_v0_loaded_readonly_cannot_be_written(env):
+    funk, db, ex = env
+    table = _create_and_extend(funk, db, ex, LOOKED_UP)
+    t = txn([PAYER], [SYSTEM_PROGRAM_ID],
+            [(1, bytes([0, 2]), struct.pack("<IQ", 2, 999))],
+            n_ro_unsigned=1, version=0,
+            aluts=[(table, b"", bytes([1]))])     # loaded as READONLY
+    r = ex.execute("blk", t)
+    assert r.status == "account_not_writable"
+    assert db.lamports("blk", LOOKED_UP[1]) == 0
+
+
+def test_v0_missing_table_fails_cleanly(env):
+    funk, db, ex = env
+    t = txn([PAYER], [SYSTEM_PROGRAM_ID],
+            [(1, bytes([0, 2]), struct.pack("<IQ", 2, 1))],
+            n_ro_unsigned=1, version=0,
+            aluts=[(k(0x77), bytes([0]), b"")])
+    r = ex.execute("blk", t)
+    assert r.status == ERR_ALUT
+    assert r.fee == FEE                  # fee still charged
+
+
+def test_deactivate_blocks_resolution_then_close(env):
+    funk, db, ex = env
+    table = _create_and_extend(funk, db, ex, LOOKED_UP)
+    r = ex.execute("blk", txn(
+        [PAYER], [table, ALUT_PROGRAM_ID],
+        [(2, bytes([1, 0]), ix_deactivate())], n_ro_unsigned=1))
+    assert r.status == OK
+    # resolution at a later slot fails (deactivated)
+    ex.slot = 200
+    t = txn([PAYER], [SYSTEM_PROGRAM_ID],
+            [(1, bytes([0, 2]), struct.pack("<IQ", 2, 1))],
+            n_ro_unsigned=1, version=0,
+            aluts=[(table, bytes([0]), b"")])
+    assert ex.execute("blk", t).status == ERR_ALUT
+    # close after cooldown returns lamports to the recipient
+    funk.rec_write("blk", table, Account(
+        lamports=777, data=db.peek("blk", table).data,
+        owner=ALUT_PROGRAM_ID))
+    r = ex.execute("blk", txn(
+        [PAYER], [table, k(0x50), ALUT_PROGRAM_ID],
+        [(3, bytes([1, 0, 2]), ix_close())], n_ro_unsigned=1))
+    assert r.status == OK, r.status
+    assert db.lamports("blk", k(0x50)) == 777
+    assert db.peek("blk", table).data == b""

@@ -1,0 +1,109 @@
+"""tpool fork-join + tile core pinning tests
+(ref: src/util/tpool/fd_tpool.h:933-972 exec_all range splitting;
+src/util/tile/fd_tile.h:6-38 core pinning)."""
+import hashlib
+import os
+import time
+
+import pytest
+
+from firedancer_tpu.shred.shredder import Shredder
+from firedancer_tpu.utils.tpool import TPool
+
+
+def test_exec_all_covers_every_index():
+    tp = TPool(4)
+    hits = [0] * 103
+    def fn(wid, i0, i1):
+        for i in range(i0, i1):
+            hits[i] += 1
+    tp.exec_all(fn, 103)
+    assert hits == [1] * 103
+    tp.exec_all(fn, 3)                   # fewer items than workers
+    assert sum(hits) == 106
+    tp.exec_all(fn, 0)                   # empty is a no-op
+    tp.close()
+
+
+def test_exec_all_reraises_worker_exception():
+    tp = TPool(3)
+    def boom(wid, i0, i1):
+        if i0 == 0:
+            raise RuntimeError("worker died")
+    with pytest.raises(RuntimeError, match="worker died"):
+        tp.exec_all(boom, 9)
+    # pool survives a failed fork-join
+    out = []
+    tp.exec_all(lambda w, a, b: out.append((a, b)), 6)
+    assert sorted(out) == [(0, 2), (2, 4), (4, 6)]
+    tp.close()
+
+
+def test_map_chunks_preserves_order():
+    tp = TPool(4)
+    items = list(range(50))
+    got = tp.map_chunks(lambda chunk: [x * 2 for x in chunk], items)
+    assert got == [x * 2 for x in items]
+    tp.close()
+
+
+def test_gil_releasing_workload_actually_parallelizes():
+    """sha256 releases the GIL: the pool must beat serial on a chunky
+    hashing workload (the shredder's leaf profile)."""
+    if len(os.sched_getaffinity(0)) < 2:
+        pytest.skip("single-core machine")
+    blobs = [bytes([i & 0xFF]) * 200_000 for i in range(64)]
+    def hash_all(chunk):
+        return [hashlib.sha256(b).digest() for b in chunk]
+    t0 = time.perf_counter()
+    serial = hash_all(blobs)
+    t_serial = time.perf_counter() - t0
+    tp = TPool(4)
+    tp.map_chunks(hash_all, blobs)       # warm
+    t0 = time.perf_counter()
+    par = tp.map_chunks(hash_all, blobs)
+    t_par = time.perf_counter() - t0
+    tp.close()
+    assert par == serial
+    assert t_par < t_serial * 0.9, (t_par, t_serial)
+
+
+def test_shredder_with_tpool_is_byte_identical():
+    tp = TPool(3)
+    batch = bytes(range(256)) * 40
+    sets_serial = Shredder(lambda r: b"\x05" * 64).shred_batch(
+        batch, 3, 1, 0, True)
+    sets_pool = Shredder(lambda r: b"\x05" * 64, tpool=tp).shred_batch(
+        batch, 3, 1, 0, True)
+    tp.close()
+    assert len(sets_serial) == len(sets_pool)
+    for a, b in zip(sets_serial, sets_pool):
+        assert a.merkle_root == b.merkle_root
+        assert a.data_shreds == b.data_shreds
+        assert a.parity_shreds == b.parity_shreds
+
+
+@pytest.mark.slow
+def test_tile_process_pinning():
+    """cpu_idx pins the tile process to one core (sched_getaffinity
+    observed from inside via /proc)."""
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    topo = (
+        Topology(f"pin{os.getpid()}", wksp_size=1 << 22)
+        .link("a_b", depth=32, mtu=256)
+        .tile("src", "synth", outs=["a_b"], count=0, cpu_idx=1)
+        .tile("dst", "sink", ins=["a_b"], cpu_idx=2)
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=120)
+        avail = sorted(os.sched_getaffinity(0))
+        want = {"src": avail[1 % len(avail)],
+                "dst": avail[2 % len(avail)]}
+        for name, proc in runner.procs.items():
+            allowed = os.sched_getaffinity(proc.pid)
+            assert allowed == {want[name]}, (name, allowed)
+    finally:
+        runner.halt()
+        runner.close()
