@@ -1245,21 +1245,54 @@ class SnapLdAdapter:
         return dict(self.tile.metrics)
 
 
+@register("snapdc")
+class SnapDcAdapter:
+    """Snapshot decompress tile (ref: src/discof/restore/ snapdc —
+    streaming zstd between two frag links)."""
+
+    METRICS = ["in_bytes", "out_bytes", "frags", "done", "stream_err",
+               "backpressure"]
+    GAUGES = ["done"]
+
+    def __init__(self, ctx, args):
+        from ..tiles.snapshot import SnapDecompress
+        self.ctx = ctx
+        self.in_link = next(iter(ctx.in_rings))
+        self.tile = SnapDecompress(
+            ctx.in_rings[self.in_link],
+            _single(ctx.out_rings, "out link", ctx.tile_name),
+            _single(ctx.out_fseqs, "out link", ctx.tile_name))
+
+    def poll_once(self) -> int:
+        return self.tile.poll_once()
+
+    def in_seqs(self):
+        return {self.in_link: self.tile.seq}
+
+    def metrics_items(self):
+        return dict(self.tile.metrics)
+
+
 @register("snapin")
 class SnapInAdapter:
     """Snapshot inserter tile (ref: src/discof/restore/fd_snapin_tile.c
-    — stream -> account DB; decompress+integrity ride the checkpoint
-    frame reader, standing in for the snapdc stage)."""
+    — stream -> account DB). format="checkpt" (default): the
+    framework's own checkpoint frames (integrity trailer inside the
+    reader). format="archive": the real tar+AppendVec layout, fed
+    DECOMPRESSED bytes by an upstream snapdc tile, lattice checksum
+    verified at EOM."""
 
     METRICS = ["frags", "bytes", "accounts", "restored", "fingerprint",
-               "stream_err"]
-    GAUGES = ["accounts", "fingerprint"]
+               "slot", "lattice_ok", "stream_err"]
+    GAUGES = ["accounts", "fingerprint", "slot", "lattice_ok"]
 
     def __init__(self, ctx, args):
-        from ..tiles.snapshot import SnapInserter
+        from ..tiles.snapshot import ArchiveInserter, SnapInserter
         self.ctx = ctx
         self.in_link = next(iter(ctx.in_rings))
-        self.tile = SnapInserter(ctx.in_rings[self.in_link])
+        cls = ArchiveInserter if args.get("format") == "archive" \
+            else SnapInserter
+        self.tile = cls(ctx.in_rings[self.in_link])
 
     def poll_once(self) -> int:
         return self.tile.poll_once()

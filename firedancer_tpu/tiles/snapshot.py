@@ -39,6 +39,22 @@ def state_fingerprint(funk) -> int:
         hashlib.sha256(buf.getvalue()).digest()[:8], "little")
 
 
+
+
+def _read_frag(ring, seq):
+    """Shared speculative lock-free read: -> (rc, ctl, payload).
+    rc 1 = nothing new, rc -1 = overrun at seq, rc 0 = validated copy
+    (payload re-checked against the slot's seq after copying)."""
+    rc, frag = ring.consume(seq)
+    if rc != 0:
+        return rc, 0, b""
+    payload = bytes(ring.payload(frag))
+    rc2, check = ring.consume(seq)
+    if rc2 != 0 or check.seq != frag.seq:
+        return -1, 0, b""
+    return 0, frag.ctl, payload
+
+
 class SnapLoader:
     """snapld core: stream one file as a multi-frag message.
 
@@ -125,7 +141,7 @@ class SnapInserter:
     def poll_once(self) -> int:
         got = 0
         while True:
-            rc, frag = self.ring.consume(self.seq)
+            rc, ctl, payload = _read_frag(self.ring, self.seq)
             if rc == 1:
                 return got
             if rc == -1:
@@ -136,23 +152,18 @@ class SnapInserter:
                 self.seq += 1
                 got += 1
                 continue
-            payload = bytes(self.ring.payload(frag))
-            # re-validate the slot after copying (speculative read)
-            rc2, check = self.ring.consume(self.seq)
-            if rc2 != 0 or check.seq != frag.seq:
-                continue
             self.seq += 1
             got += 1
             self.metrics["frags"] += 1
             self.metrics["bytes"] += len(payload)
-            if frag.ctl & CTL_SOM:
+            if ctl & CTL_SOM:
                 self._buf.clear()
                 self._in_msg = True
             if not self._in_msg:
                 self.metrics["stream_err"] += 1
                 continue
             self._buf += payload
-            if frag.ctl & CTL_EOM:
+            if ctl & CTL_EOM:
                 self._restore()
                 self._in_msg = False
 
@@ -164,3 +175,119 @@ class SnapInserter:
         self.metrics["accounts"] = len(self.funk.root_items())
         self.metrics["fingerprint"] = state_fingerprint(self.funk)
         self.metrics["restored"] += 1
+
+
+class SnapDecompress:
+    """snapdc core (ref: src/discof/restore/ snapdc stage): streaming
+    zstd decompress between two frag links. SOM/EOM bracket the
+    message on both sides; decompressed output re-chunks to the out
+    ring's mtu."""
+
+    def __init__(self, in_ring, out_ring, out_fseqs):
+        import zstandard
+        self.ring = in_ring
+        self.out = out_ring
+        self.fseqs = out_fseqs or []
+        self.seq = 0
+        self._d = zstandard.ZstdDecompressor().decompressobj()
+        self._started = False
+        self._out_seq = 0
+        self._pending: list[tuple[bytes, int]] = []
+        self.metrics = {"in_bytes": 0, "out_bytes": 0, "frags": 0,
+                        "done": 0, "stream_err": 0, "backpressure": 0}
+
+    def _drain(self) -> bool:
+        """Publish pending chunks; False on backpressure (return to
+        the stem — the tile must keep heartbeating, SnapLoader's
+        discipline)."""
+        while self._pending:
+            if self.fseqs and self.out.credits(self.fseqs) <= 0:
+                self.metrics["backpressure"] += 1
+                return False
+            data, ctl = self._pending.pop(0)
+            self.out.publish(data, sig=self._out_seq, ctl=ctl)
+            self._out_seq += 1
+            self.metrics["out_bytes"] += len(data)
+        return True
+
+    def poll_once(self) -> int:
+        got = 0
+        while True:
+            if not self._drain():
+                return got
+            rc, ctl_in, payload = _read_frag(self.ring, self.seq)
+            if rc == 1:
+                return got
+            if rc == -1:
+                # an overrun or corrupt stream desyncs zstd for good:
+                # fail LOUDLY (stem flips cnc FAIL) instead of hanging
+                # the pipeline with no EOM
+                raise RuntimeError("snapdc: input stream overrun")
+            self.seq += 1
+            got += 1
+            self.metrics["frags"] += 1
+            self.metrics["in_bytes"] += len(payload)
+            try:
+                raw = self._d.decompress(payload)
+            except Exception as e:
+                raise RuntimeError(f"snapdc: corrupt zstd stream: {e}")
+            last_in = bool(ctl_in & CTL_EOM)
+            mtu = self.out.mtu
+            chunks = [raw[i:i + mtu] for i in range(0, len(raw), mtu)] \
+                or ([b""] if last_in or not self._started else [])
+            for i, c in enumerate(chunks):
+                ctl = 0
+                if not self._started:
+                    ctl |= CTL_SOM
+                    self._started = True
+                if last_in and i == len(chunks) - 1:
+                    ctl |= CTL_EOM
+                self._pending.append((c, ctl))
+            if last_in:
+                self.metrics["done"] = 1
+
+
+class ArchiveInserter:
+    """Real-format snapin: decompressed tar stream -> AppendVec parse
+    -> funk root, lattice checksum verified at EOM (ref:
+    fd_snapin_tile.c:14-17 + the snapla/snapls verify fan-in)."""
+
+    def __init__(self, in_ring, funk_cls=None):
+        from ..flamenco.snapshot import SnapshotRestorer
+        from ..funk.funk import Funk
+        self.ring = in_ring
+        self.funk = (funk_cls or Funk)()
+        # stream is ALREADY decompressed (snapdc upstream)
+        self._restorer = SnapshotRestorer(self.funk, compressed=False)
+        self.seq = 0
+        self.metrics = {"frags": 0, "bytes": 0, "accounts": 0,
+                        "slot": 0, "lattice_ok": 0, "restored": 0,
+                        "stream_err": 0}
+
+    def poll_once(self) -> int:
+        got = 0
+        while True:
+            rc, ctl, payload = _read_frag(self.ring, self.seq)
+            if rc == 1:
+                return got
+            if rc == -1:
+                raise RuntimeError("snapin: input stream overrun")
+            self.seq += 1
+            got += 1
+            self.metrics["frags"] += 1
+            self.metrics["bytes"] += len(payload)
+            try:
+                self._restorer.feed(payload)
+            except Exception as e:
+                # corrupt stream: fail the TILE (loud) — never leave
+                # the pipeline waiting on an EOM that cannot land
+                raise RuntimeError(f"snapin: corrupt snapshot: {e}")
+            if ctl & CTL_EOM:
+                ok = self._restorer.finish()
+                self.metrics["accounts"] = self._restorer.accounts
+                self.metrics["slot"] = self._restorer.slot or 0
+                self.metrics["lattice_ok"] = 1 if ok else 0
+                self.metrics["restored"] += 1
+                if not ok:
+                    raise RuntimeError(
+                        "snapin: snapshot failed lattice verification")
