@@ -10,7 +10,9 @@ speak:
   * AppendVec: Agave's account-storage file layout, byte-compatible —
     per entry StoredMeta(write_version u64, data_len u64, pubkey 32) |
     AccountMeta(lamports u64, rent_epoch u64, owner 32, executable u8,
-    7B pad) | data | pad to 8
+    7B pad) | stored hash 32 | data | pad to 8 (the 136-byte
+    STORE_META_OVERHEAD; the hash field is vestigial in modern Agave
+    and written as zeros, accepted as-is on read)
   * TarStream: incremental ustar parser (512-byte headers, NUL-name
     terminator) usable from a tile that receives the byte stream as
     ring frags
@@ -34,6 +36,7 @@ from ..svm.accdb import Account
 
 STORED_META = struct.Struct("<QQ32s")          # write_version, dlen, key
 ACCOUNT_META = struct.Struct("<QQ32sB7x")      # lamports, rent, owner, exec
+STORED_HASH_SZ = 32                            # vestigial, zeros
 
 
 def _pad8(n: int) -> int:
@@ -48,6 +51,7 @@ def write_append_vec(items) -> bytes:
         out += STORED_META.pack(wv, len(a.data), pk)
         out += ACCOUNT_META.pack(a.lamports, a.rent_epoch, a.owner,
                                  1 if a.executable else 0)
+        out += bytes(STORED_HASH_SZ)
         out += a.data
         out += bytes(_pad8(len(a.data)))
     return bytes(out)
@@ -59,7 +63,7 @@ def parse_append_vec(data: bytes) -> list:
     out = []
     off = 0
     n = len(data)
-    hdr = STORED_META.size + ACCOUNT_META.size
+    hdr = STORED_META.size + ACCOUNT_META.size + STORED_HASH_SZ
     while off + hdr <= n:
         wv, dlen, pk = STORED_META.unpack_from(data, off)
         lam, rent, owner, execu = ACCOUNT_META.unpack_from(
@@ -238,6 +242,11 @@ class SnapshotRestorer:
         if got != self._checksum:
             return False
         for pk, acct in self._staging.items():
+            # zero-lamport entries are outside the lattice commitment:
+            # installing them would let a tampered snapshot smuggle
+            # unverified state past the checksum
+            if acct.lamports == 0:
+                continue
             self.funk.rec_write(None, pk, acct)
         self._staging.clear()
         return True

@@ -42,7 +42,8 @@ from dataclasses import dataclass
 from ..protocol.txn import ParsedTxn, parse_txn
 from .accdb import AccDb, Account, SYSTEM_PROGRAM_ID
 
-COMPUTE_BUDGET_PROGRAM_ID = b"ComputeBudget" + bytes(19)
+# the REAL base58 program id (shared with the pack cost model)
+from ..pack.cost import COMPUTE_BUDGET_PROGRAM_ID  # noqa: E402
 BPF_LOADER_ID = b"BPFLoader" + bytes(23)
 MAX_PERMITTED_DATA_LENGTH = 10 * 1024 * 1024
 MAX_CPI_DEPTH = 4                  # instruction stack height limit
@@ -75,6 +76,33 @@ ERR_CPI = "cpi_violation"
 ERR_ALUT = "alut_resolution_failed"
 
 
+class LogCollector(list):
+    """Bounded program-log buffer (the reference's fd_log_collector:
+    10KB budget, a single truncation marker once exceeded)."""
+
+    MAX_BYTES = 10_000
+
+    def __init__(self):
+        super().__init__()
+        self._bytes = 0
+        self._truncated = False
+
+    def append(self, line):
+        if self._truncated:
+            return
+        n = len(line.encode()) if isinstance(line, str) else len(line)
+        if self._bytes + n > self.MAX_BYTES:
+            self._truncated = True
+            super().append("Log truncated")
+            return
+        self._bytes += n
+        super().append(line)
+
+    def extend(self, lines):
+        for ln in lines:
+            self.append(ln)
+
+
 @dataclass
 class TxnResult:
     status: str
@@ -100,8 +128,11 @@ class TxnContext:
         self.keys = txn.account_keys(payload) + list(loaded_keys)
         self._loaded_writable = list(loaded_writable)
         self._work: dict[bytes, Account] = {}
-        self.logs: list[str] = []
+        self.logs = LogCollector()
         self.last_exec_cu = 0        # CU used by the last BPF frame
+        self.cu_limit = 200_000      # SetComputeUnitLimit applies here
+        self.cu_used = 0             # shared meter across instructions
+        self.heap_sz = 32 * 1024     # RequestHeapFrame applies here
         self.return_data = b""       # sol_set_return_data (txn-wide)
         self.return_data_program = bytes(32)
 
@@ -554,7 +585,11 @@ def _exec_bpf(ctx: TxnContext, ic: InstrCtx, program: Account,
     from ..vm import DEFAULT_SYSCALLS, ERR_NONE as VM_OK, Vm
     syscalls = dict(DEFAULT_SYSCALLS)
     syscalls.update(_make_cpi_syscalls(ctx, ic, depth))
-    kw = {} if budget is None else {"compute_budget": budget}
+    if budget is None:
+        # top-level frame: the txn's shared meter (requested limit
+        # minus CU already burned by earlier instructions)
+        budget = max(0, ctx.cu_limit - ctx.cu_used)
+    kw = {"compute_budget": budget, "heap_sz": ctx.heap_sz}
     # sysvars the VM exposes via get_*_sysvar syscalls (the reference's
     # fd_sysvar_cache; Clock layout = the Solana 40-byte struct)
     sysvars = {"clock": struct.pack(
@@ -588,6 +623,8 @@ def _exec_bpf(ctx: TxnContext, ic: InstrCtx, program: Account,
         res = vm.run()
     ctx.logs.extend(res.log)
     ctx.last_exec_cu = res.compute_used
+    if depth == 0:
+        ctx.cu_used += res.compute_used
     ctx.return_data = getattr(vm, "return_data", b"")
     ctx.return_data_program = getattr(vm, "return_data_program",
                                       bytes(32))
@@ -627,6 +664,10 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
     """Route one instruction frame to its program (the fd_executor
     native-program dispatch switch + BPF fallback)."""
     from .alut import ALUT_PROGRAM_ID, exec_alut
+    from .precompiles import (
+        ED25519_PROGRAM_ID, SECP256K1_PROGRAM_ID,
+        exec_ed25519_precompile, exec_secp256k1_precompile,
+    )
     from .stake import STAKE_PROGRAM_ID, exec_stake
     from .vote import VOTE_PROGRAM_ID, exec_vote
     pid = ic.program_id
@@ -638,8 +679,12 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
         return exec_stake(ic)
     if pid == ALUT_PROGRAM_ID:
         return exec_alut(ic)
+    if pid == ED25519_PROGRAM_ID:
+        return exec_ed25519_precompile(ic)
+    if pid == SECP256K1_PROGRAM_ID:
+        return exec_secp256k1_precompile(ic)
     if pid == COMPUTE_BUDGET_PROGRAM_ID:
-        return OK                    # limits handled by pack/cost
+        return OK                    # requests pre-scanned by execute()
     pa = ctx.db.peek(ctx.xid, pid)
     if pa is not None and pa.executable and pa.owner == BPF_LOADER_ID:
         return _exec_bpf(ctx, ic, pa, depth, budget=budget)
@@ -685,6 +730,23 @@ class TxnExecutor:
                          loaded_writable=loaded_writable)
         keys = ctx.keys                # static + table-loaded
         total = len(keys)
+        # pre-scan ComputeBudget requests (the reference resolves the
+        # whole budget before dispatch, fd_compute_budget_program.h)
+        from ..pack.cost import ComputeBudgetState, CostError
+        cb = ComputeBudgetState()
+        for instr in txn.instrs:
+            if instr.prog_idx < len(keys) \
+                    and keys[instr.prog_idx] == COMPUTE_BUDGET_PROGRAM_ID:
+                data = payload[instr.data_off:
+                               instr.data_off + instr.data_sz]
+                try:
+                    cb.parse_instr(data)
+                except CostError:
+                    return TxnResult(ERR_BAD_IX_DATA, fee, [])
+        if cb.set_cu:
+            ctx.cu_limit = cb.compute_units
+        if cb.set_heap:
+            ctx.heap_sz = cb.heap_size
         for instr in txn.instrs:
             # v0 defers the index bound to post-resolution
             if instr.prog_idx >= total or \

@@ -1,0 +1,113 @@
+"""Keccak / secp256k1 / precompile tests (ref: src/ballet/keccak256/,
+src/ballet/secp256k1/, src/flamenco/runtime/fd_precompiles.c)."""
+import struct
+
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account, TxnExecutor
+from firedancer_tpu.svm.precompiles import (
+    ED25519_PROGRAM_ID, SECP256K1_PROGRAM_ID, THIS_IX,
+)
+from firedancer_tpu.svm.programs import ERR_VM, OK
+from firedancer_tpu.utils import secp256k1 as secp
+from firedancer_tpu.utils.ed25519_ref import keypair, sign
+from firedancer_tpu.utils.keccak import keccak256
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+def test_keccak_vectors():
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45")
+    assert keccak256(b"The quick brown fox jumps over the lazy dog"
+                     ).hex() == ("4d741b6f1eb29cb2a9b9911c82f56fa8d73b0"
+                                 "4959d3d9d222895df6c0b28aa15")
+    # rate-boundary lengths
+    for n in (135, 136, 137, 271, 272):
+        assert len(keccak256(b"q" * n)) == 32
+
+
+def test_secp_sign_verify_recover():
+    priv = 0xC0FFEE1234567890C0FFEE1234567890C0FFEE1234567890C0FFEE12345678
+    q = secp._mul(priv, (secp.GX, secp.GY))
+    for i in range(4):
+        h = keccak256(b"message-%d" % i)
+        r, s, rec = secp.sign(priv, h)
+        assert secp.verify(q, h, r, s)
+        assert not secp.verify(q, keccak256(b"other"), r, s)
+        got = secp.recover(h, r, s, rec)
+        assert got == q
+        assert secp.eth_address(got) == secp.eth_address(q)
+    assert secp.recover(h, r, s, rec ^ 1) != q      # wrong parity
+
+
+def _exec(txn_bytes):
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(1), Account(lamports=1_000_000))
+    funk.txn_prepare(None, "blk")
+    return TxnExecutor(db).execute("blk", txn_bytes)
+
+
+def _txn(program_id, ix_data):
+    msg = build_message([k(1)], [program_id], b"\x11" * 32,
+                        [(1, b"", ix_data)], n_ro_unsigned=1)
+    return build_txn([bytes(64)], msg)
+
+
+def _ed25519_ix(sig, pub, msg):
+    hdr_sz = 2 + 14
+    data = bytearray(bytes([1, 0]))
+    data += struct.pack("<HHHHHHH", hdr_sz, THIS_IX,
+                        hdr_sz + 64, THIS_IX,
+                        hdr_sz + 96, len(msg), THIS_IX)
+    data += sig + pub + msg
+    return bytes(data)
+
+
+def test_ed25519_precompile():
+    seed = bytes(range(32))
+    _, _, pub = keypair(seed)
+    msg = b"precompile me"
+    sig = sign(seed, msg)
+    assert _exec(_txn(ED25519_PROGRAM_ID,
+                      _ed25519_ix(sig, pub, msg))).status == OK
+    bad = bytearray(sig)
+    bad[0] ^= 1
+    r = _exec(_txn(ED25519_PROGRAM_ID,
+                   _ed25519_ix(bytes(bad), pub, msg)))
+    assert r.status == ERR_VM
+
+
+def _secp_ix(sig65, addr, msg):
+    hdr_sz = 1 + 11
+    data = bytearray(bytes([1]))
+    data += struct.pack("<HBHBHHB", hdr_sz, 0xFF,
+                        hdr_sz + 65, 0xFF,
+                        hdr_sz + 85, len(msg), 0xFF)
+    data += sig65 + addr + msg
+    return bytes(data)
+
+
+def test_secp256k1_precompile():
+    priv = 0xD00D
+    q = secp._mul(priv, (secp.GX, secp.GY))
+    msg = b"ethereum-flavored auth"
+    r, s, rec = secp.sign(priv, keccak256(msg))
+    sig65 = r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([rec])
+    addr = secp.eth_address(q)
+    assert _exec(_txn(SECP256K1_PROGRAM_ID,
+                      _secp_ix(sig65, addr, msg))).status == OK
+    # wrong address refused
+    r2 = _exec(_txn(SECP256K1_PROGRAM_ID,
+                    _secp_ix(sig65, bytes(20), msg)))
+    assert r2.status == ERR_VM
+    # truncated offsets refused, not crashed
+    r3 = _exec(_txn(SECP256K1_PROGRAM_ID, bytes([3]) + bytes(5)))
+    assert r3.status == "bad_instruction_data"

@@ -178,3 +178,44 @@ def test_assign_requires_writable(env):
     # writable form succeeds
     r2 = ex.execute("blk", txn)
     assert r2.status == OK
+
+
+def test_compute_budget_limit_enforced(env):
+    """SetComputeUnitLimit caps BPF execution through the shared txn
+    meter (ref: fd_compute_budget_program.h -> VM budget)."""
+    from firedancer_tpu.pack.cost import COMPUTE_BUDGET_PROGRAM_ID
+    from firedancer_tpu.svm.programs import BPF_LOADER_ID, ERR_VM
+    from firedancer_tpu.vm import asm
+    funk, db, ex = env
+    # ~3000-instruction spin loop then clean exit
+    prog = asm("""
+        mov64 r1, 1000
+        jeq r1, 0, +2
+        sub64 r1, 1
+        ja -3
+        mov64 r0, 0
+        exit
+    """)
+    funk.rec_write("blk", k(7), Account(
+        lamports=1, data=prog, owner=BPF_LOADER_ID, executable=True))
+    cb_set_limit = bytes([2]) + (100).to_bytes(4, "little")  # 100 CU
+    txn_capped = make_txn(
+        [k(1)], [k(7), COMPUTE_BUDGET_PROGRAM_ID],
+        [(2, [], cb_set_limit), (1, [], b"")], n_ro_unsigned=2)
+    r = ex.execute("blk", txn_capped)
+    assert r.status == ERR_VM                    # budget exhausted
+    txn_free = make_txn([k(1)], [k(7)], [(1, [], b"")],
+                        n_ro_unsigned=1)
+    assert ex.execute("blk", txn_free).status == OK
+
+
+def test_log_collector_truncates(env):
+    from firedancer_tpu.svm.programs import LogCollector
+    lc = LogCollector()
+    for i in range(200):
+        lc.append("x" * 100)
+    assert lc[-1] == "Log truncated"
+    assert sum(len(ln) for ln in lc[:-1]) <= LogCollector.MAX_BYTES
+    n = len(lc)
+    lc.append("more")                            # dropped after marker
+    assert len(lc) == n

@@ -22,7 +22,8 @@ def k(n):
 
 def test_append_vec_byte_layout():
     """The exact Agave entry layout: 48B StoredMeta + 56B AccountMeta
-    + data padded to 8."""
+    + 32B stored hash (vestigial zeros) + data padded to 8 — the
+    136-byte STORE_META_OVERHEAD."""
     a = Account(lamports=7, data=b"hello", owner=k(9),
                 executable=True, rent_epoch=3)
     b = write_append_vec([(k(1), a)])
@@ -35,8 +36,9 @@ def test_append_vec_byte_layout():
     assert struct.unpack_from("<Q", b, 56)[0] == 3
     assert b[64:96] == k(9)
     assert b[96] == 1 and b[97:104] == bytes(7)
-    assert b[104:109] == b"hello"
-    assert len(b) == 104 + 5 + 3                 # padded to 8
+    assert b[104:136] == bytes(32)               # stored hash field
+    assert b[136:141] == b"hello"
+    assert len(b) == 136 + 5 + 3                 # padded to 8
     [(pk, back)] = parse_append_vec(b)
     assert pk == k(1)
     assert (back.lamports, back.data, back.owner, back.executable,
