@@ -49,6 +49,14 @@ def tile_main(plan: dict, tile_name: str):
     if cpu_idx is not None:
         avail = sorted(os.sched_getaffinity(0))
         os.sched_setaffinity(0, {avail[int(cpu_idx) % len(avail)]})
+    # sandbox hardening (ref: src/util/sandbox/fd_sandbox.h — the
+    # python-enforceable subset: no-new-privs + rlimit caps; fd
+    # closing stays opt-in because adapters open sockets/files later)
+    if plan["tiles"][tile_name]["args"].get("sandbox"):
+        from ..utils import sandbox
+        sandbox.apply(max_files=int(
+            plan["tiles"][tile_name]["args"].get("sandbox_files", 1024)),
+            close_high_fds=False)
     # per-tile thread-tagged logging (ref: fd_topo_run.c
     # initialize_logging before tile init)
     from ..utils import log

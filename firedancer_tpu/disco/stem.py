@@ -33,6 +33,23 @@ class Stem:
         """ctx: TileCtx (cnc/metrics/fseqs); tile: the callback object."""
         self.ctx, self.tile = ctx, tile
         self.hk_interval_s = hk_interval_s
+        # tempo-derived cadence (ref: fd_tempo_lazy_default): a tile
+        # may pin lazy_ns explicitly, or ask for depth-derived lazy
+        # with lazy_auto (credits must return ~10x faster than the
+        # smallest out-link window drains)
+        args = ctx.spec.get("args", {})
+        if args.get("lazy_ns"):
+            self.hk_interval_s = int(args["lazy_ns"]) * 1e-9
+        elif args.get("lazy_auto"):
+            from ..utils.tempo import lazy_default
+            depths = [ctx.plan["links"][ln]["depth"]
+                      for ln in getattr(ctx, "out_rings", {})]
+            if depths:
+                # floor = the python loop's useful granularity (100us)
+                # so the depth derivation actually differentiates
+                # windows; ceiling keeps heartbeats frequent
+                self.hk_interval_s = min(0.05, max(
+                    1e-4, lazy_default(min(depths)) * 1e-9))
         self.idle_sleep_s = idle_sleep_s
         # slot-name ABI comes from the plan (explicit, reorder-proof);
         # a tile kind with no registered names falls back to the dict

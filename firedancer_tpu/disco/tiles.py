@@ -1194,16 +1194,20 @@ class GossipAdapter:
     bind_addr, entrypoints (["host:port", ...]), publish (list of
     {kind, index, data_hex} values to originate at boot)."""
 
-    METRICS = ["rx", "tx", "values", "contacts", "bad_msg", "port"]
+    METRICS = ["gossvf_bad", "rx", "tx", "values", "contacts",
+               "bad_msg", "port"]
     GAUGES = ["values", "contacts", "port"]
 
     def __init__(self, ctx, args):
         from ..tiles.gossip import GossipTile
+        if args.get("device_verify"):
+            _setup_jax()
         self.tile = GossipTile(
             bytes.fromhex(args["seed"]),
             port=int(args.get("port", 0)),
             bind_addr=args.get("bind_addr", "127.0.0.1"),
-            entrypoints=args.get("entrypoints", ()))
+            entrypoints=args.get("entrypoints", ()),
+            device_verify=bool(args.get("device_verify", False)))
         for v in args.get("publish", []):
             self.tile.publish(int(v["kind"]), int(v.get("index", 0)),
                               bytes.fromhex(v["data_hex"]))

@@ -1,0 +1,59 @@
+"""gossvf: batched device signature verification for gossip ingest.
+
+The reference fronts its gossip tile with gossvf — a tile that
+sigchecks inbound gossip traffic before the CRDS logic sees it
+(ref: src/discof/gossip/ gossvf). This framework's re-expression:
+every gossip packet carries a LIST of CRDS values, so the natural TPU
+shape is one `verify_batch` kernel call per packet (or per poll burst)
+instead of per-value host verifies — the same microbatch discipline
+the verify tile applies to transactions.
+
+Padding: messages pad to the batch max length rounded up to a 64-byte
+bucket so compile shapes stay cacheable across packets.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+MAX_SIGNABLE = 1232            # gossip values ride single datagrams
+
+
+def _bucket(n: int) -> int:
+    return max(64, -(-n // 64) * 64)
+
+
+def batch_verify(values) -> list[bool]:
+    """values: [CrdsValue] -> per-value signature verdicts. The common
+    case (signable <= MAX_SIGNABLE) verifies on the device as ONE
+    batch; oversize values fall back to the host oracle so verdicts
+    NEVER diverge from the host path — truncating would wrongly drop
+    legitimately signed large values."""
+    if not values:
+        return []
+    from ..ops.ed25519 import verify_batch
+    from ..utils.ed25519_ref import verify as host_verify
+    msgs = [v.signable() for v in values]
+    n = len(values)
+    out: list[bool | None] = [None] * n
+    width = _bucket(max((len(m) for m in msgs
+                         if len(m) <= MAX_SIGNABLE), default=64))
+    sig = np.zeros((n, 64), np.uint8)
+    pub = np.zeros((n, 32), np.uint8)
+    msg = np.zeros((n, width), np.uint8)
+    ln = np.zeros((n,), np.int32)
+    for i, (v, m) in enumerate(zip(values, msgs)):
+        if len(v.signature) != 64 or len(v.origin) != 32:
+            out[i] = False                # malformed
+        elif len(m) > MAX_SIGNABLE:
+            out[i] = bool(host_verify(v.signature, v.origin, m))
+        else:
+            sig[i] = np.frombuffer(v.signature, np.uint8)
+            pub[i] = np.frombuffer(v.origin, np.uint8)
+            msg[i, :len(m)] = np.frombuffer(m, np.uint8)
+            ln[i] = len(m)
+    if int(ln.max(initial=0)) > 0:
+        ok = np.asarray(verify_batch(sig, pub, msg, ln))
+        for i in range(n):
+            if out[i] is None:
+                out[i] = bool(ok[i]) and int(ln[i]) > 0
+    return [bool(o) for o in out]

@@ -56,13 +56,16 @@ class GossipNode:
         return self.active.push_targets(v.origin)
 
     def handle_push(self, values: list[CrdsValue],
-                    relayer: bytes) -> list[CrdsValue]:
+                    relayer: bytes,
+                    pre_verified: bool = False) -> list[CrdsValue]:
         """Ingest pushed values; returns the NEW ones (to relay onward).
-        Duplicates feed the prune finder."""
+        Duplicates feed the prune finder. pre_verified=True when a
+        gossvf stage already batch-checked the signatures on device."""
         fresh = []
         for v in values:
             self.metrics["push_rx"] += 1
-            if self.verify_fn and not self.verify_fn(
+            if not pre_verified and self.verify_fn \
+                    and not self.verify_fn(
                     v.signature, v.origin, v.signable()):
                 self.metrics["push_bad_sig"] += 1
                 continue
@@ -95,10 +98,12 @@ class GossipNode:
         self.metrics["pull_rs"] += 1
         return self.crds.missing_for(Bloom.from_wire(bloom_wire), limit)
 
-    def handle_pull_response(self, values: list[CrdsValue]) -> int:
+    def handle_pull_response(self, values: list[CrdsValue],
+                             pre_verified: bool = False) -> int:
         n = 0
         for v in values:
-            if self.verify_fn and not self.verify_fn(
+            if not pre_verified and self.verify_fn \
+                    and not self.verify_fn(
                     v.signature, v.origin, v.signable()):
                 continue
             n += self.crds.upsert(v)

@@ -39,8 +39,10 @@ def _pack_values(msg_type: int, sender: bytes, values) -> bytes:
 class GossipTile:
     def __init__(self, seed: bytes, port: int = 0,
                  bind_addr: str = "127.0.0.1", entrypoints=(),
-                 stake_of=None, now_ms: int = 0):
+                 stake_of=None, now_ms: int = 0,
+                 device_verify: bool = False):
         self.seed = seed
+        self.device_verify = device_verify
         _, _, self.pubkey = keypair(seed)
         self.node = GossipNode(
             self.pubkey, stake_of=stake_of,
@@ -57,7 +59,8 @@ class GossipTile:
                             for e in entrypoints]
         self._push_queue: list[CrdsValue] = []
         self._tick = 0
-        self.metrics = {"rx": 0, "tx": 0, "values": 0, "contacts": 0,
+        self.metrics = {"gossvf_bad": 0,
+                        "rx": 0, "tx": 0, "values": 0, "contacts": 0,
                         "bad_msg": 0, "port": self.addr[1]}
         self.node.publish_contact_info(self.addr)
 
@@ -110,11 +113,23 @@ class GossipTile:
             for _ in range(cnt):
                 v, off = CrdsValue.from_wire(body, off)
                 values.append(v)
+            pre = False
+            if self.device_verify and values:
+                # gossvf: ONE device batch checks the whole packet's
+                # signatures (gossip/gossvf.py); invalid values drop
+                from ..gossip.gossvf import batch_verify
+                verdicts = batch_verify(values)
+                self.metrics["gossvf_bad"] += \
+                    sum(1 for ok in verdicts if not ok)
+                values = [v for v, ok in zip(values, verdicts) if ok]
+                pre = True
             if mtype == MSG_PUSH:
-                fresh = self.node.handle_push(values, relayer=sender)
+                fresh = self.node.handle_push(values, relayer=sender,
+                                              pre_verified=pre)
                 self._push_queue.extend(fresh)     # relay onward
             else:
-                self.node.handle_pull_response(values)
+                self.node.handle_pull_response(values,
+                                               pre_verified=pre)
         elif mtype == MSG_PULL_REQ:
             resp = self.node.handle_pull_request(body, limit=16)
             if resp:

@@ -1,0 +1,53 @@
+"""Tempo: tick/ns calibration + lazy-interval math.
+
+The reference calibrates RDTSC ticks against the wallclock and derives
+every tile's housekeeping cadence from its flow-control depth
+(ref: src/tango/tempo/fd_tempo.c — fd_tempo_tick_per_ns joint
+calibration, fd_tempo_lazy_default from cr_max, fd_tempo_async_min
+power-of-two event spacing with jitter). Python translation: the
+monotonic tick source is time.perf_counter_ns; the CALIBRATION is
+still real (measured against time.time_ns, median of trials), and the
+lazy math is the same credit-return reasoning — a producer must
+housekeep at least ~10x faster than its credit window drains.
+"""
+from __future__ import annotations
+
+import time
+
+
+def tick_per_ns(trials: int = 9, window_s: float = 0.002) -> float:
+    """Median ratio of perf_counter ticks to wallclock ns (the joint
+    observation discipline of fd_tempo_tick_per_ns)."""
+    obs = []
+    for _ in range(max(3, trials)):
+        t0 = time.perf_counter_ns()
+        w0 = time.time_ns()
+        time.sleep(window_s)
+        t1 = time.perf_counter_ns()
+        w1 = time.time_ns()
+        if w1 > w0:
+            obs.append((t1 - t0) / (w1 - w0))
+    obs.sort()
+    return obs[len(obs) // 2] if obs else 1.0
+
+
+def lazy_default(cr_max: int, ns_per_frag: float = 1_000.0) -> int:
+    """Housekeeping interval (ns) for a producer with cr_max credits:
+    credits must return well before the window drains, so housekeep
+    ~10x faster than worst-case drain (the reference's
+    fd_tempo_lazy_default shape: O(cr_max) with a safety factor)."""
+    drain_ns = max(1.0, cr_max * ns_per_frag)
+    return max(1_000, int(drain_ns / 10))
+
+
+def async_min(lazy_ns: int, event_cnt: int) -> int:
+    """Largest power-of-two tick spacing such that event_cnt events
+    complete within ~lazy (fd_tempo_async_min): the caller jitters
+    within [async_min, 2*async_min)."""
+    if lazy_ns <= 0 or event_cnt <= 0:
+        raise ValueError("lazy_ns and event_cnt must be positive")
+    per = max(1, lazy_ns // max(1, 2 * event_cnt))
+    p = 1
+    while p * 2 <= per:
+        p *= 2
+    return p

@@ -54,3 +54,24 @@ def test_three_nodes_converge_over_udp():
     finally:
         runner.halt()
         runner.close()
+
+
+def test_gossvf_batch_verify_drops_forgeries():
+    """The gossvf device batch admits valid CRDS values and drops
+    forged ones — same verdicts as the host oracle, one kernel call."""
+    from firedancer_tpu.gossip.crds import CrdsValue
+    from firedancer_tpu.gossip.gossvf import batch_verify
+    from firedancer_tpu.utils.ed25519_ref import keypair, sign
+    import dataclasses
+    vals = []
+    for i in range(6):
+        seed = bytes([i + 1]) * 32
+        _, _, pub = keypair(seed)
+        v = CrdsValue(pub, 1, 0, 1000 + i, b"data-%d" % i)
+        sig = bytes(64) if i % 3 == 2 else sign(seed, v.signable())
+        vals.append(dataclasses.replace(v, signature=sig))
+    got = batch_verify(vals)
+    assert got == [True, True, False, True, True, False]
+    # malformed signature length: verdict False, no crash
+    vals[0] = dataclasses.replace(vals[0], signature=b"short")
+    assert batch_verify(vals)[0] is False

@@ -1,0 +1,107 @@
+"""Sandbox hardening + tempo calibration tests
+(ref: src/util/sandbox/fd_sandbox.h, src/tango/tempo/fd_tempo.c)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_sandbox_apply_in_subprocess():
+    code = """
+import resource
+from firedancer_tpu.utils import sandbox
+rep = sandbox.apply(max_files=128, max_mem_gb=0, close_high_fds=False)
+assert rep["no_new_privs"], rep
+assert rep["nofile"] == 128
+assert resource.getrlimit(resource.RLIMIT_NOFILE) == (128, 128)
+assert resource.getrlimit(resource.RLIMIT_CORE) == (0, 0)
+nnp = [l for l in open("/proc/self/status") if l.startswith("NoNewPrivs")]
+assert nnp and nnp[0].split()[1] == "1", nnp
+print("SANDBOXED")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert "SANDBOXED" in r.stdout, r.stderr
+
+
+@pytest.mark.slow
+def test_sandboxed_tile_runs():
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    topo = (
+        Topology(f"sb{os.getpid()}", wksp_size=1 << 22)
+        .link("a_b", depth=32, mtu=256)
+        .tile("src", "synth", outs=["a_b"], count=8, sandbox=True)
+        .tile("dst", "sink", ins=["a_b"], sandbox=True)
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=120)
+        import time
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if runner.metrics("dst")["rx"] >= 8:
+                break
+            time.sleep(0.1)
+        assert runner.metrics("dst")["rx"] >= 8
+        for name, proc in runner.procs.items():
+            nnp = [l for l in open(f"/proc/{proc.pid}/status")
+                   if l.startswith("NoNewPrivs")]
+            assert nnp[0].split()[1] == "1", (name, nnp)
+    finally:
+        runner.halt()
+        runner.close()
+
+
+def test_tempo_calibration_and_lazy_math():
+    from firedancer_tpu.utils import tempo
+    r = tempo.tick_per_ns(trials=5, window_s=0.002)
+    # perf_counter_ns and time_ns both count ns: ratio ~1
+    assert 0.5 < r < 2.0, r
+    # lazy scales with the credit window
+    assert tempo.lazy_default(64) < tempo.lazy_default(4096)
+    assert tempo.lazy_default(1) >= 1_000
+    # async_min: power of two, and event_cnt events fit within ~lazy
+    for lazy, n in ((1_000_000, 7), (50_000, 3), (10_000, 1)):
+        m = tempo.async_min(lazy, n)
+        assert m & (m - 1) == 0
+        assert m * n <= lazy
+    with pytest.raises(ValueError):
+        tempo.async_min(0, 1)
+
+
+@pytest.mark.slow
+def test_lazy_ns_pins_housekeeping_cadence():
+    """A tile with lazy_ns set housekeeps at that cadence (observed
+    through the poh tile: ticks are housekeeping-driven)."""
+    import time
+
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    topo = (
+        Topology(f"tp{os.getpid()}", wksp_size=1 << 23)
+        .link("drv_poh", depth=32, mtu=64)
+        .link("poh_ent", depth=4096, mtu=256)
+        .tile("drv", "synth", outs=["drv_poh"], count=0)
+        .tile("poh", "poh", ins=["drv_poh"], outs=["poh_ent"],
+              hashes_per_tick=4, ticks_per_slot=4, lazy_ns=2_000_000)
+        .tile("snk", "sink", ins=["poh_ent"])
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=120)
+        t0 = time.time()
+        ticks0 = runner.metrics("poh")["ticks"]
+        time.sleep(2.0)
+        rate = (runner.metrics("poh")["ticks"] - ticks0) \
+            / (time.time() - t0)
+        # 2ms lazy -> ~500 ticks/s; allow wide slack (single core box)
+        assert 100 < rate < 1000, rate
+    finally:
+        runner.halt()
+        runner.close()
