@@ -51,6 +51,23 @@ def _gather_all(ctx, seqs: dict, mtus: dict, batch: int, handle,
     return total
 
 
+def _synth_genesis(n: int) -> dict:
+    """Fund the deterministic synth signer pool (wraps mod its size):
+    the ONE genesis map both the leader bank and non-leader replay
+    derive from a config count."""
+    from ..tiles.synth import synth_signer_seed
+    from ..utils.ed25519_ref import keypair
+    out = {}
+    seen = set()
+    for i in range(n):
+        seed = synth_signer_seed(i)
+        if seed in seen:
+            break
+        seen.add(seed)
+        out[keypair(seed)[-1]] = 1 << 44
+    return out
+
+
 def _setup_jax():
     """Per-process jax config for device-using tiles: honor the test
     harness's platform override and share the persistent compile cache."""
@@ -403,16 +420,9 @@ class BankAdapter:
             # committed default topology uses this). The synth signer
             # pool wraps mod 16, so fund each UNIQUE pubkey once.
             if args.get("genesis_synth"):
-                from ..tiles.synth import synth_signer_seed
-                from ..utils.ed25519_ref import keypair
-                seen = set()
-                for i in range(int(args["genesis_synth"])):
-                    seed = synth_signer_seed(i)
-                    if seed in seen:
-                        break                 # pool wrapped: all funded
-                    seen.add(seed)
-                    pub = keypair(seed)[-1]
-                    self.funk.rec_write(None, pub, 1 << 44)
+                for pub, bal in _synth_genesis(
+                        int(args["genesis_synth"])).items():
+                    self.funk.rec_write(None, pub, bal)
             # optional JSON-RPC surface over this bank's state (the
             # rpc-tile seam; production would read a shared accdb,
             # ref src/discof/rpc/fd_rpc_tile.c)
@@ -755,7 +765,8 @@ class ShredAdapter:
     METRICS = ["entries", "batches", "fec_sets", "data_shreds",
                "parity_shreds", "sent", "no_dest", "sign_fail",
                "slots", "dropped", "shreds", "fecs", "slices",
-               "slots_done", "parse_fail", "overruns"]
+               "slots_done", "parse_fail", "retransmitted",
+               "overruns"]
 
     def __init__(self, ctx, args):
         import socket
@@ -807,21 +818,55 @@ class ShredAdapter:
             self.in_links = [self.in_link]
         else:
             # recover mode fans in every in link (turbine ingest +
-            # repair responses feed the same resolver)
+            # repair responses feed the same resolver); with a cluster
+            # + identity it also RETRANSMITS to its turbine children
             self.in_links = list(ctx.in_rings)
+            dest = identity = rt_sock = None
+            if args.get("cluster") and args.get("identity_hex"):
+                identity = bytes.fromhex(args["identity_hex"])
+                cluster = [ClusterNode(
+                    bytes.fromhex(n["pubkey_hex"]), int(n["stake"]),
+                    (n["addr"].rsplit(":", 1)[0],
+                     int(n["addr"].rsplit(":", 1)[1])))
+                    for n in args["cluster"]]
+                dest = shredmod.ShredDest(
+                    cluster, identity,
+                    fanout=int(args.get("fanout", 200)))
+                rt_sock = socket.socket(socket.AF_INET,
+                                        socket.SOCK_DGRAM)
             self.core = shredmod.ShredRecoverCore(
                 bytes.fromhex(args["leader_pubkey_hex"]),
                 _single(ctx.out_rings, "out link", ctx.tile_name),
-                _single(ctx.out_fseqs, "out link", ctx.tile_name))
-            self._handle = self.core.on_shred
+                _single(ctx.out_fseqs, "out link", ctx.tile_name),
+                dest=dest, identity=identity, sock=rt_sock)
+            # repair responses must NOT re-enter turbine: only the
+            # turbine ingest link (default: the first in link)
+            # retransmits
+            turbine_in = args.get("turbine_in", self.in_links[0])
+
+            def handle_factory(ln):
+                rt = ln == turbine_in
+                return lambda w: self.core.on_shred(w, retransmit=rt)
+            self._handlers = {ln: handle_factory(ln)
+                              for ln in self.in_links}
+            self._handle = None
         self.seqs = {ln: 0 for ln in self.in_links}
         self.mtus = {ln: ctx.plan["links"][ln]["mtu"]
                      for ln in self.in_links}
 
     def poll_once(self) -> int:
         m = {"overruns": 0}
-        n = _gather_all(self.ctx, self.seqs, self.mtus, 16,
-                        self._handle, m)
+        if self._handle is not None:
+            n = _gather_all(self.ctx, self.seqs, self.mtus, 16,
+                            self._handle, m)
+        else:
+            n = 0
+            for ln in self.in_links:
+                only = {ln: self.seqs[ln]}
+                n += _gather_all(self.ctx, only,
+                                 {ln: self.mtus[ln]}, 16,
+                                 self._handlers[ln], m)
+                self.seqs[ln] = only[ln]
         self._ovr += m["overruns"]
         return n
 
@@ -1059,6 +1104,8 @@ class ReplayAdapter:
         self.ring = ctx.in_rings[self.in_link]
         genesis = {bytes.fromhex(k): int(v)
                    for k, v in args.get("genesis", {}).items()}
+        if args.get("genesis_synth"):
+            genesis.update(_synth_genesis(int(args["genesis_synth"])))
         self.core = ReplayCore(
             out_ring=_single(ctx.out_rings, "out link", ctx.tile_name),
             out_fseqs=_single(ctx.out_fseqs, "out link", ctx.tile_name),

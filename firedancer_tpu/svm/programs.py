@@ -663,7 +663,9 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
                    budget: int | None = None) -> str:
     """Route one instruction frame to its program (the fd_executor
     native-program dispatch switch + BPF fallback)."""
+    from ..pack.cost import BPF_UPGRADEABLE_LOADER_ID
     from .alut import ALUT_PROGRAM_ID, exec_alut
+    from .loader import exec_upgradeable_loader, resolve_program_elf
     from .precompiles import (
         ED25519_PROGRAM_ID, SECP256K1_PROGRAM_ID,
         exec_ed25519_precompile, exec_secp256k1_precompile,
@@ -683,11 +685,22 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
         return exec_ed25519_precompile(ic)
     if pid == SECP256K1_PROGRAM_ID:
         return exec_secp256k1_precompile(ic)
+    if pid == BPF_UPGRADEABLE_LOADER_ID:
+        return exec_upgradeable_loader(ic)
     if pid == COMPUTE_BUDGET_PROGRAM_ID:
         return OK                    # requests pre-scanned by execute()
     pa = ctx.db.peek(ctx.xid, pid)
-    if pa is not None and pa.executable and pa.owner == BPF_LOADER_ID:
-        return _exec_bpf(ctx, ic, pa, depth, budget=budget)
+    if pa is not None and pa.executable:
+        if pa.owner == BPF_LOADER_ID:
+            return _exec_bpf(ctx, ic, pa, depth, budget=budget)
+        if pa.owner == BPF_UPGRADEABLE_LOADER_ID:
+            # loader-v3 indirection: program -> programdata -> ELF
+            elf_bytes = resolve_program_elf(ctx.db, ctx.xid, pa)
+            if elf_bytes is None:
+                return ERR_UNKNOWN_PROGRAM
+            shim = Account(pa.lamports, bytes(elf_bytes), pa.owner,
+                           True, pa.rent_epoch)
+            return _exec_bpf(ctx, ic, shim, depth, budget=budget)
     return ERR_UNKNOWN_PROGRAM
 
 

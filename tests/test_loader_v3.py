@@ -1,0 +1,189 @@
+"""Upgradeable BPF loader tests: the full deploy path through
+transactions — buffer write, deploy, execute, upgrade, authority
+discipline (ref: src/flamenco/runtime/program/fd_bpf_loader_program.c)."""
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.pack.cost import BPF_UPGRADEABLE_LOADER_ID
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account, TxnExecutor
+from firedancer_tpu.svm.loader import (
+    ix_deploy, ix_init_buffer, ix_upgrade, ix_write, parse_state,
+)
+from firedancer_tpu.svm.programs import (
+    ERR_BAD_IX_DATA, ERR_INVALID_OWNER, ERR_MISSING_SIG, OK,
+)
+from tests.test_elf_cpi import RODATA_MSG, _build_elf
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+PAYER, BUFFER, PROGRAM, PROGDATA = k(1), k(0x21), k(0x22), k(0x23)
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, PAYER, Account(lamports=1 << 30))
+    for a in (BUFFER, PROGRAM, PROGDATA):
+        funk.rec_write(None, a, Account(
+            lamports=1, owner=BPF_UPGRADEABLE_LOADER_ID))
+    funk.txn_prepare(None, "blk")
+    ex = TxnExecutor(db)
+    ex.slot = 50
+    return funk, db, ex
+
+
+def _run(ex, accts, data, signers=None):
+    signers = signers or [PAYER]
+    extra = [a for a in accts if a not in signers] \
+        + [BPF_UPGRADEABLE_LOADER_ID]
+    keys = list(signers) + extra
+    prog_idx = len(keys) - 1
+    idxs = [keys.index(a) for a in accts]     # signers map to slot 0..
+    msg = build_message(signers, extra, b"\x11" * 32,
+                        [(prog_idx, bytes(idxs), data)],
+                        n_ro_unsigned=1)
+    return ex.execute("blk", build_txn(
+        [bytes(64)] * len(signers), msg))
+
+
+def _deploy(ex, elf):
+    assert _run(ex, [BUFFER, PAYER], ix_init_buffer()).status == OK
+    # write in two chunks
+    mid = len(elf) // 2
+    assert _run(ex, [BUFFER, PAYER], ix_write(0, elf[:mid])).status == OK
+    assert _run(ex, [BUFFER, PAYER],
+                ix_write(mid, elf[mid:])).status == OK
+    r = _run(ex, [PROGRAM, PROGDATA, BUFFER, PAYER],
+             ix_deploy(len(elf)))
+    assert r.status == OK, r.status
+
+
+def test_deploy_and_execute(env):
+    funk, db, ex = env
+    elf = _build_elf()
+    _deploy(ex, elf)
+    prog = db.peek("blk", PROGRAM)
+    assert prog.executable
+    st, info = parse_state(prog.data)
+    assert info["programdata"] == PROGDATA
+    pst, pinfo = parse_state(db.peek("blk", PROGDATA).data)
+    assert pinfo["elf"] == elf and pinfo["slot"] == 50
+    # the deployed program EXECUTES through the indirection
+    msg = build_message([PAYER], [PROGRAM], b"\x11" * 32,
+                        [(1, b"", b"")], n_ro_unsigned=1)
+    r = ex.execute("blk", build_txn([bytes(64)], msg))
+    assert r.status == OK, r.logs
+    assert any(RODATA_MSG.decode() in ln for ln in r.logs)
+
+
+def test_write_requires_buffer_authority(env):
+    funk, db, ex = env
+    assert _run(ex, [BUFFER, PAYER], ix_init_buffer()).status == OK
+    evil = k(0x66)
+    funk.rec_write("blk", evil, Account(lamports=1 << 30))
+    r = _run(ex, [BUFFER, evil], ix_write(0, b"x" * 8), signers=[evil])
+    assert r.status == ERR_MISSING_SIG
+
+
+def test_deploy_rejects_broken_elf(env):
+    funk, db, ex = env
+    assert _run(ex, [BUFFER, PAYER], ix_init_buffer()).status == OK
+    assert _run(ex, [BUFFER, PAYER],
+                ix_write(0, b"\x7fELFjunk" * 4)).status == OK
+    r = _run(ex, [PROGRAM, PROGDATA, BUFFER, PAYER], ix_deploy(64))
+    assert r.status == ERR_BAD_IX_DATA
+    assert not db.peek("blk", PROGRAM).executable
+
+
+def test_upgrade_swaps_elf_with_authority_check(env):
+    funk, db, ex = env
+    elf = _build_elf()
+    _deploy(ex, elf)
+    # stage a second buffer with a (different but valid) ELF
+    elf2 = _build_elf()
+    BUF2 = k(0x31)
+    funk.rec_write("blk", BUF2, Account(
+        lamports=1, owner=BPF_UPGRADEABLE_LOADER_ID))
+    assert _run(ex, [BUF2, PAYER], ix_init_buffer()).status == OK
+    assert _run(ex, [BUF2, PAYER], ix_write(0, elf2)).status == OK
+    # wrong authority refused
+    evil = k(0x66)
+    funk.rec_write("blk", evil, Account(lamports=1 << 30))
+    r = _run(ex, [PROGDATA, PROGRAM, BUF2, evil], ix_upgrade(),
+             signers=[evil])
+    assert r.status == ERR_INVALID_OWNER
+    # right authority upgrades
+    ex.slot = 60
+    r = _run(ex, [PROGDATA, PROGRAM, BUF2, PAYER], ix_upgrade())
+    assert r.status == OK, r.status
+    pst, pinfo = parse_state(db.peek("blk", PROGDATA).data)
+    assert pinfo["slot"] == 60
+
+
+def test_upgrade_cannot_repoint_foreign_program(env):
+    """Security pin: Upgrade with accounts [attacker_pdata,
+    victim_program, attacker_buffer, attacker] must refuse — the
+    program's state must point at the PASSED programdata."""
+    funk, db, ex = env
+    elf = _build_elf()
+    _deploy(ex, elf)                      # victim PROGRAM deployed
+    A_PD, A_BUF = k(0x41), k(0x42)
+    evil = k(0x66)
+    funk.rec_write("blk", evil, Account(lamports=1 << 30))
+    for a in (A_PD, A_BUF):
+        funk.rec_write("blk", a, Account(
+            lamports=1, owner=BPF_UPGRADEABLE_LOADER_ID))
+    assert _run(ex, [A_BUF, evil], ix_init_buffer(),
+                signers=[evil]).status == OK
+    assert _run(ex, [A_BUF, evil], ix_write(0, _build_elf()),
+                signers=[evil]).status == OK
+    # attacker deploys their own pdata so it has THEIR authority
+    A_PROG = k(0x43)
+    funk.rec_write("blk", A_PROG, Account(
+        lamports=1, owner=BPF_UPGRADEABLE_LOADER_ID))
+    assert _run(ex, [A_PROG, A_PD, A_BUF, evil],
+                ix_deploy(4096), signers=[evil]).status == OK
+    # refill a buffer and try to repoint the VICTIM program
+    assert _run(ex, [A_BUF, evil], ix_init_buffer(),
+                signers=[evil]).status == OK
+    assert _run(ex, [A_BUF, evil], ix_write(0, _build_elf()),
+                signers=[evil]).status == OK
+    r = _run(ex, [A_PD, PROGRAM, A_BUF, evil], ix_upgrade(),
+             signers=[evil])
+    assert r.status == ERR_INVALID_OWNER
+    st, info = parse_state(db.peek("blk", PROGRAM).data)
+    assert info["programdata"] == PROGDATA       # untouched
+
+
+def test_deploy_cannot_overwrite_live_programdata(env):
+    """Security pin: Deploy into an initialized programdata refuses."""
+    funk, db, ex = env
+    _deploy(ex, _build_elf())                    # PROGDATA now live
+    evil = k(0x66)
+    A_BUF, A_PROG = k(0x42), k(0x43)
+    funk.rec_write("blk", evil, Account(lamports=1 << 30))
+    for a in (A_BUF, A_PROG):
+        funk.rec_write("blk", a, Account(
+            lamports=1, owner=BPF_UPGRADEABLE_LOADER_ID))
+    assert _run(ex, [A_BUF, evil], ix_init_buffer(),
+                signers=[evil]).status == OK
+    assert _run(ex, [A_BUF, evil], ix_write(0, _build_elf()),
+                signers=[evil]).status == OK
+    r = _run(ex, [A_PROG, PROGDATA, A_BUF, evil], ix_deploy(4096),
+             signers=[evil])
+    assert r.status == ERR_INVALID_OWNER
+    pst, pinfo = parse_state(db.peek("blk", PROGDATA).data)
+    assert pinfo["authority"] == PAYER           # untouched
+
+
+def test_write_offset_bounded(env):
+    funk, db, ex = env
+    assert _run(ex, [BUFFER, PAYER], ix_init_buffer()).status == OK
+    r = _run(ex, [BUFFER, PAYER], ix_write(0xFFFF_FF00, b"x"))
+    assert r.status == ERR_BAD_IX_DATA
+    assert len(db.peek("blk", BUFFER).data) < 1024

@@ -328,3 +328,63 @@ def test_two_topology_shred_interop():
     finally:
         runner_b.halt()
         runner_b.close()
+
+
+def test_recover_core_retransmits_to_turbine_children():
+    """A non-leader forwards valid shreds to its children in the
+    stake-weighted tree; invalid shreds are never retransmitted."""
+    import struct as _struct
+
+    from firedancer_tpu.shred import format as fmt
+    from firedancer_tpu.tiles.shred import ShredDest
+    txns = make_signed_txns(2, seed=4)
+    sent_out = []
+
+    class _Sock:
+        def sendto(self, wire, addr):
+            sent_out.append((bytes(wire), addr))
+
+    # leader produces several slots' shreds
+    wires = []
+
+    class _LeaderSock:
+        def sendto(self, wire, addr):
+            wires.append(bytes(wire))
+
+    core = ShredLeaderCore(
+        lambda root: sign(SEED, root), LEADER_PUB,
+        [ClusterNode(PEER, 100, ("127.0.0.1", 9))], _LeaderSock())
+    state = bytes(32)
+    from tests.test_shred_tile import _gen_entries as gen
+    for slot in range(4):
+        frames, state = gen(slot, [txns] if slot == 1 else [],
+                            seed=state)
+        for f in frames:
+            core.on_entry(f)
+
+    ME, OTHER = b"\x61" * 32, b"\x62" * 32
+    dest = ShredDest([ClusterNode(ME, 50, ("127.0.0.1", 21)),
+                      ClusterNode(OTHER, 50, ("127.0.0.1", 22))],
+                     self_pubkey=ME, fanout=1)
+    rec = ShredRecoverCore(LEADER_PUB, _CaptureRing(), None,
+                           dest=dest, identity=ME, sock=_Sock())
+    expected = 0
+    for w in wires:
+        slot, = _struct.unpack_from("<Q", w, 0x41)
+        idx, = _struct.unpack_from("<I", w, 0x49)
+        t = 1 if fmt.is_data(w[fmt.VARIANT_OFF]) else 0
+        expected += len(dest.children(slot, idx, t, LEADER_PUB))
+        rec.on_shred(w)
+    assert rec.metrics["retransmitted"] == expected
+    assert expected > 0                      # we ARE root sometimes
+    assert all(a == ("127.0.0.1", 22) for _, a in sent_out)
+    # garbage never retransmits
+    before = rec.metrics["retransmitted"]
+    rec.on_shred(b"\xde\xad" * 100)
+    assert rec.metrics["retransmitted"] == before
+    # a REPLAYED shred never amplifies (per-shred dedup)
+    rec.on_shred(wires[0])
+    assert rec.metrics["retransmitted"] == before
+    # repair responses never re-enter turbine
+    rec.on_shred(wires[1], retransmit=False)
+    assert rec.metrics["retransmitted"] == before
