@@ -368,8 +368,8 @@ class BankAdapter:
     remaining out link."""
 
     METRICS = ["microblocks", "txns", "transfers", "exec_skip",
-               "exec_fail", "overruns", "rpc_port"]
-    GAUGES = ["rpc_port"]
+               "exec_fail", "overruns", "rpc_port", "ws_port"]
+    GAUGES = ["rpc_port", "ws_port"]
 
     def __init__(self, ctx, args):
         self.ctx = ctx
@@ -435,6 +435,14 @@ class BankAdapter:
                              "txn_count": self.m["transfers"]},
                     port=int(args["rpc_port"]))
                 self.m["rpc_port"] = self.rpc.port
+            # websocket pub-sub surface (slot + account notifications,
+            # ref: the rpc tile's subscription API)
+            self.ws = None
+            self._ws_last_slot = -1
+            if args.get("ws_port") is not None:
+                from ..rpc.ws import WsServer
+                self.ws = WsServer(port=int(args["ws_port"]))
+                self.m["ws_port"] = self.ws.port
         self.seq = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
 
@@ -494,6 +502,10 @@ class BankAdapter:
             bank, txn_cnt, mb_id, slot = struct.unpack_from("<HHQQ",
                                                             frame, 0)
             self.slot = max(self.slot, slot)
+            if self.exec_mode == "svm" and self.ws is not None \
+                    and self.slot != self._ws_last_slot:
+                self._ws_last_slot = self.slot
+                self.ws.publish_slot(self.slot)
             self.m["txns"] += txn_cnt
             self.m["microblocks"] += 1
             if self.exec_mode == "svm" and txn_cnt:
@@ -514,6 +526,18 @@ class BankAdapter:
                     except Exception:
                         self.funk.txn_cancel(new_xid)
                         raise
+                    # ws notifications OUTSIDE the funk guard (a
+                    # notification error must not cancel a published
+                    # txn); unique touched keys, once per microblock,
+                    # and zero cost with no subscribers
+                    if self.ws is not None and self.ws.has_clients:
+                        touched = {key for t, s in zip(txns, st)
+                                   if s == STATUS_OK
+                                   for key in (t.src, t.dst)}
+                        for key in touched:
+                            self.ws.publish_account(
+                                key, self.funk.rec_query(None, key),
+                                self.slot)
                 if self.poh_out is not None:
                     while self.poh_fseqs and \
                             self.poh_out.credits(self.poh_fseqs) <= 0:

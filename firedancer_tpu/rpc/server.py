@@ -105,15 +105,20 @@ class RpcServer:
         return int(v) if v is not None else 0
 
     def _account(self, st, pubkey_b58: str):
-        v = self._rec(st, pubkey_b58)
-        if v is None:
-            return None
-        if not isinstance(v, Account):
-            v = Account(lamports=int(v))
-        return {
-            "lamports": v.lamports,
-            "owner": b58_encode_32(v.owner),
-            "executable": v.executable,
-            "rentEpoch": v.rent_epoch,
-            "data": [base64.b64encode(v.data).decode(), "base64"],
-        }
+        return account_to_json(self._rec(st, pubkey_b58))
+
+
+def account_to_json(v):
+    """Account | int | None -> the Solana account JSON envelope (ONE
+    coercion shared by the http and websocket surfaces)."""
+    if v is None:
+        return None
+    if not isinstance(v, Account):
+        v = Account(lamports=int(v))
+    return {
+        "lamports": v.lamports,
+        "owner": b58_encode_32(v.owner),
+        "executable": v.executable,
+        "rentEpoch": v.rent_epoch,
+        "data": [base64.b64encode(v.data).decode(), "base64"],
+    }

@@ -1,0 +1,305 @@
+"""WebSocket pub-sub for the RPC surface.
+
+The reference serves Solana's websocket subscription API next to the
+HTTP one (ref: src/discof/rpc/ — slot/account notifications out of
+replay state; the ws framing rides src/waltz/http/fd_http_server.h's
+upgrade path). This is a dependency-free RFC 6455 subset server:
+
+  * GET + Upgrade handshake (Sec-WebSocket-Accept per §4.2.2)
+  * text frames in/out, masked client frames, ping/pong, close
+  * methods: slotSubscribe / slotUnsubscribe,
+             accountSubscribe(pubkey) / accountUnsubscribe
+  * `publish_slot(slot)` and `publish_account(pubkey, account)` fan
+    notifications out to every matching subscriber (the tile calls
+    these from its housekeeping — the replay/bank seam)
+
+Notification envelopes follow Solana's {jsonrpc, method:
+"slotNotification"|"accountNotification", params: {subscription,
+result}} shape.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+
+WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _accept_key(key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1(key.encode() + WS_GUID).digest()).decode()
+
+
+def _encode_frame(payload: bytes, opcode: int = 0x1) -> bytes:
+    hdr = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        hdr += bytes([n])
+    elif n < 1 << 16:
+        hdr += bytes([126]) + struct.pack(">H", n)
+    else:
+        hdr += bytes([127]) + struct.pack(">Q", n)
+    return hdr + payload
+
+
+def _read_exact(sock, n: int) -> bytes:
+    """select-based blocking read: the send side's timeout flips the
+    SHARED file description non-blocking (the wsock fd is a dup), so
+    the reader waits on select and retries EAGAIN."""
+    import select
+    out = b""
+    while len(out) < n:
+        select.select([sock], [], [])
+        try:
+            chunk = sock.recv(n - len(out))
+        except (BlockingIOError, InterruptedError):
+            continue
+        except socket.timeout:
+            continue
+        if not chunk:
+            raise ConnectionError("peer closed")
+        out += chunk
+    return out
+
+
+def _read_frame(sock):
+    """-> (opcode, payload); unmasks client frames (required §5.1)."""
+    b0, b1 = _read_exact(sock, 2)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        n, = struct.unpack(">H", _read_exact(sock, 2))
+    elif n == 127:
+        n, = struct.unpack(">Q", _read_exact(sock, 8))
+    if n > 1 << 20:
+        raise ConnectionError("frame too large")
+    mask = _read_exact(sock, 4) if masked else b"\x00" * 4
+    payload = bytearray(_read_exact(sock, n))
+    if masked:
+        for i in range(len(payload)):
+            payload[i] ^= mask[i & 3]
+    return opcode, bytes(payload)
+
+
+class _Client:
+    def __init__(self, sock):
+        import os as _os
+        self.sock = sock                 # reader side: blocking
+        # sender side: an independent socket OBJECT over a dup'd fd so
+        # its 0.5s timeout never affects the blocking reader (python
+        # socket timeouts are per-object, not per-fd)
+        self.wsock = socket.socket(fileno=_os.dup(sock.fileno()))
+        self.wsock.settimeout(0.5)
+        self.lock = threading.Lock()
+        self.slot_subs: set[int] = set()
+        self.acct_subs: dict[int, bytes] = {}    # sub id -> pubkey
+
+    def send_json(self, obj) -> bool:
+        """Bounded send: a slow/stalled subscriber must never block
+        the publishing tile — on timeout the client is dropped."""
+        data = _encode_frame(json.dumps(obj).encode())
+        try:
+            with self.lock:
+                self.wsock.sendall(data)
+            return True
+        except OSError:
+            for s in (self.wsock, self.sock):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            return False
+
+    def close(self):
+        for s in (self.wsock, self.sock):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class WsServer:
+    def __init__(self, port: int = 0, bind_addr: str = "127.0.0.1"):
+        self.lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind((bind_addr, port))
+        self.lsock.listen(16)
+        self.port = self.lsock.getsockname()[1]
+        self._clients: list[_Client] = []
+        self._next_sub = 1
+        self._lock = threading.Lock()
+        self._halt = False
+        self.metrics = {"clients": 0, "subs": 0, "notifs": 0}
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # -- connection handling ------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._halt:
+            try:
+                sock, _ = self.lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock):
+        try:
+            req = b""
+            while b"\r\n\r\n" not in req:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    return
+                req += chunk
+            headers = {}
+            for line in req.split(b"\r\n")[1:]:
+                if b":" in line:
+                    k, v = line.split(b":", 1)
+                    headers[k.strip().lower()] = v.strip()
+            key = headers.get(b"sec-websocket-key", b"").decode()
+            if not key:
+                sock.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+                return
+            sock.sendall(
+                b"HTTP/1.1 101 Switching Protocols\r\n"
+                b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                b"Sec-WebSocket-Accept: "
+                + _accept_key(key).encode() + b"\r\n\r\n")
+            client = _Client(sock)
+            with self._lock:
+                self._clients.append(client)
+                self.metrics["clients"] = len(self._clients)
+            self._client_loop(client)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._lock:
+                if any(c.sock is sock for c in self._clients):
+                    self._clients = [c for c in self._clients
+                                     if c.sock is not sock]
+                    self.metrics["clients"] = len(self._clients)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _client_loop(self, client: _Client):
+        while not self._halt:
+            opcode, payload = _read_frame(client.sock)
+            if opcode == 0x8:                    # close
+                return
+            if opcode == 0x9:                    # ping -> pong
+                with client.lock:
+                    client.wsock.sendall(_encode_frame(payload, 0xA))
+                continue
+            if opcode != 0x1:
+                continue
+            try:
+                req = json.loads(payload)
+            except ValueError:
+                continue
+            if not isinstance(req, dict):
+                client.send_json({"jsonrpc": "2.0", "id": None,
+                                  "error": {"code": -32600,
+                                            "message": "not an object"}})
+                continue
+            try:
+                self._dispatch(client, req)
+            except Exception as e:      # noqa: BLE001 — answer, don't die
+                client.send_json({"jsonrpc": "2.0",
+                                  "id": req.get("id"),
+                                  "error": {"code": -32602,
+                                            "message": str(e)}})
+
+    def _dispatch(self, client: _Client, req: dict):
+        method = req.get("method")
+        rid = req.get("id")
+        params = req.get("params") or []
+        result = None
+        error = None
+        with self._lock:
+            if method == "slotSubscribe":
+                sub = self._next_sub
+                self._next_sub += 1
+                client.slot_subs.add(sub)
+                result = sub
+            elif method == "accountSubscribe" and params:
+                from ..utils.base58 import b58_decode_32
+                try:
+                    pk = b58_decode_32(params[0])
+                    sub = self._next_sub
+                    self._next_sub += 1
+                    client.acct_subs[sub] = pk
+                    result = sub
+                except Exception as e:
+                    error = {"code": -32602, "message": str(e)}
+            elif method == "slotUnsubscribe" and params:
+                sub = int(params[0])
+                result = sub in client.slot_subs
+                client.slot_subs.discard(sub)
+            elif method == "accountUnsubscribe" and params:
+                sub = int(params[0])
+                result = sub in client.acct_subs
+                client.acct_subs.pop(sub, None)
+            else:
+                error = {"code": -32601,
+                         "message": f"method not found: {method}"}
+            self.metrics["subs"] = sum(
+                len(c.slot_subs) + len(c.acct_subs)
+                for c in self._clients)
+        resp = {"jsonrpc": "2.0", "id": rid}
+        resp["error" if error else "result"] = \
+            error if error else result
+        client.send_json(resp)
+
+    # -- publication (called by the owning tile) ----------------------------
+
+    def publish_slot(self, slot: int):
+        with self._lock:
+            targets = [(c, s) for c in self._clients
+                       for s in c.slot_subs]
+        for c, sub in targets:
+            if c.send_json({"jsonrpc": "2.0",
+                            "method": "slotNotification",
+                            "params": {"subscription": sub,
+                                       "result": {"slot": slot}}}):
+                self.metrics["notifs"] += 1
+
+    @property
+    def has_clients(self) -> bool:
+        return bool(self._clients)
+
+    def publish_account(self, pubkey: bytes, account, slot: int = 0):
+        with self._lock:
+            targets = [(c, s) for c in self._clients
+                       for s, pk in c.acct_subs.items() if pk == pubkey]
+        if not targets:
+            return
+        from .server import account_to_json
+        value = account_to_json(account)
+        if value is None:
+            return
+        for c, sub in targets:
+            if c.send_json({"jsonrpc": "2.0",
+                            "method": "accountNotification",
+                            "params": {"subscription": sub,
+                                       "result": {
+                                           "context": {"slot": slot},
+                                           "value": value}}}):
+                self.metrics["notifs"] += 1
+
+    def close(self):
+        self._halt = True
+        try:
+            self.lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for c in self._clients:
+                c.close()
+            self._clients.clear()

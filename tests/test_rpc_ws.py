@@ -1,0 +1,186 @@
+"""WebSocket pub-sub tests: RFC 6455 handshake/frames and the Solana
+subscription envelopes (ref: src/discof/rpc/ subscription API over
+src/waltz/http upgrade path)."""
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from firedancer_tpu.rpc.ws import WsServer
+from firedancer_tpu.svm.accdb import Account
+from firedancer_tpu.utils.base58 import b58_encode_32
+
+
+class WsClient:
+    """Tiny RFC 6455 client: masked frames, blocking reads."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=30)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall((
+            f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n")[0]
+        want = base64.b64encode(hashlib.sha1(
+            key.encode()
+            + b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11").digest())
+        assert want in resp                      # accept key verified
+
+    def send_json(self, obj):
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        masked = bytes(b ^ mask[i & 3] for i, b in enumerate(payload))
+        hdr = bytes([0x81])
+        n = len(payload)
+        assert n < 126
+        self.sock.sendall(hdr + bytes([0x80 | n]) + mask + masked)
+
+    def recv_json(self):
+        b0 = self._exact(2)
+        n = b0[1] & 0x7F
+        if n == 126:
+            n, = struct.unpack(">H", self._exact(2))
+        return json.loads(self._exact(n))
+
+    def _exact(self, n):
+        out = b""
+        while len(out) < n:
+            c = self.sock.recv(n - len(out))
+            assert c
+            out += c
+        return out
+
+    def close(self):
+        self.sock.close()
+
+
+def test_ws_slot_and_account_subscriptions():
+    srv = WsServer()
+    c = WsClient(srv.port)
+    c.send_json({"jsonrpc": "2.0", "id": 1, "method": "slotSubscribe"})
+    sub_slot = c.recv_json()["result"]
+    pk = b"\x11" * 32
+    c.send_json({"jsonrpc": "2.0", "id": 2,
+                 "method": "accountSubscribe",
+                 "params": [b58_encode_32(pk)]})
+    sub_acct = c.recv_json()["result"]
+    assert sub_slot != sub_acct
+    time.sleep(0.05)
+
+    srv.publish_slot(77)
+    note = c.recv_json()
+    assert note["method"] == "slotNotification"
+    assert note["params"] == {"subscription": sub_slot,
+                              "result": {"slot": 77}}
+
+    srv.publish_account(pk, Account(lamports=555, data=b"ab",
+                                    owner=b"\x07" * 32), slot=77)
+    note = c.recv_json()
+    assert note["method"] == "accountNotification"
+    v = note["params"]["result"]["value"]
+    assert v["lamports"] == 555
+    assert v["data"] == [base64.b64encode(b"ab").decode(), "base64"]
+    # a different account does NOT notify; unsubscribe stops slot notes
+    srv.publish_account(b"\x22" * 32, Account(lamports=1), slot=78)
+    c.send_json({"jsonrpc": "2.0", "id": 3,
+                 "method": "slotUnsubscribe", "params": [sub_slot]})
+    assert c.recv_json()["result"] is True
+    time.sleep(0.05)
+    srv.publish_slot(78)
+    # only traffic left should be nothing: probe with a fresh request
+    c.send_json({"jsonrpc": "2.0", "id": 4, "method": "nosuch"})
+    assert "error" in c.recv_json()
+    c.close()
+    srv.close()
+
+
+def test_ws_ping_pong_and_bad_method():
+    srv = WsServer()
+    c = WsClient(srv.port)
+    # ping -> pong echo
+    mask = os.urandom(4)
+    body = bytes(b ^ mask[i & 3] for i, b in enumerate(b"hi"))
+    c.sock.sendall(bytes([0x89, 0x82]) + mask + body)
+    hdr = c._exact(2)
+    assert hdr[0] & 0x0F == 0xA
+    assert c._exact(hdr[1] & 0x7F) == b"hi"
+    c.close()
+    srv.close()
+
+
+@pytest.mark.slow
+def test_bank_tile_ws_notifications():
+    """The leader loop's bank tile pushes slot + account notifications
+    to a live websocket subscriber."""
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    from firedancer_tpu.tiles.synth import make_signed_txns, synth_signer_seed
+    from firedancer_tpu.utils.ed25519_ref import keypair
+    from firedancer_tpu.protocol.txn import parse_txn
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    N = 8
+    genesis = {keypair(synth_signer_seed(i))[-1].hex(): 1 << 44
+               for i in range(16)}
+    topo = (
+        Topology(f"ws{os.getpid()}", wksp_size=1 << 25)
+        .link("synth_verify", depth=128, mtu=1280)
+        .link("verify_pack", depth=128, mtu=1280)
+        .link("pack_bank0", depth=32, mtu=1 << 14)
+        .link("bank0_done", depth=32, mtu=64)
+        .tcache("verify_tc", depth=4096)
+        .tile("synth", "synth", outs=["synth_verify"], count=N,
+              unique=N, seed=6)
+        .tile("verify", "verify", ins=["synth_verify"],
+              outs=["verify_pack"], batch=16, tcache="verify_tc")
+        .tile("pack", "pack", ins=["verify_pack", "bank0_done"],
+              outs=["pack_bank0"], txn_in="verify_pack",
+              bank_links=["pack_bank0"], done_links=["bank0_done"],
+              slot_ms=200.0, max_txn_per_microblock=4)
+        .tile("bank0", "bank", ins=["pack_bank0"],
+              outs=["bank0_done"], exec="svm", genesis=genesis,
+              ws_port=0)
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=540)
+        deadline = time.time() + 60
+        while time.time() < deadline \
+                and runner.metrics("bank0")["ws_port"] == 0:
+            time.sleep(0.1)
+        port = int(runner.metrics("bank0")["ws_port"])
+        c = WsClient(port)
+        # subscribe to a synth destination account
+        txns = make_signed_txns(N, seed=6)
+        t0 = parse_txn(txns[0])
+        dst = t0.account_keys(txns[0])[1]
+        c.send_json({"jsonrpc": "2.0", "id": 1,
+                     "method": "accountSubscribe",
+                     "params": [b58_encode_32(dst)]})
+        assert isinstance(c.recv_json()["result"], int)
+        c.send_json({"jsonrpc": "2.0", "id": 2,
+                     "method": "slotSubscribe"})
+        assert isinstance(c.recv_json()["result"], int)
+        got_acct = got_slot = False
+        deadline = time.time() + 120
+        c.sock.settimeout(120)
+        while time.time() < deadline and not (got_acct and got_slot):
+            note = c.recv_json()
+            if note.get("method") == "accountNotification":
+                got_acct = True
+                assert note["params"]["result"]["value"]["lamports"] > 0
+            elif note.get("method") == "slotNotification":
+                got_slot = True
+        assert got_acct and got_slot
+        c.close()
+    finally:
+        runner.halt()
+        runner.close()
