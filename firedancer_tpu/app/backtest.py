@@ -1,29 +1,34 @@
-"""Backtest: deterministic ledger replay against a state fingerprint
-(ref: src/discof/backtest/fd_backtest_tile.c — replay recorded ledger
-segments through the runtime and assert bank hashes; CI tier 8 of
-SURVEY §4).
+"""Backtest: deterministic ledger replay asserting BANK HASHES
+(ref: src/discof/backtest/fd_backtest_tile.c:317 — replay recorded
+ledger segments through the runtime and assert each slot's bank hash;
+CI tier 8 of SURVEY §4).
 
 Ledger file = checkpoint frame stream (utils/checkpt.py):
   frame 0   genesis funk checkpoint (nested, bytes)
   frame i   one block: u64 slot | u32 txn_cnt | (u32 len | payload)*
-  last      expected final state fingerprint (8 bytes) — written by
-            `record`, asserted by `replay`
+            | bank_hash 32 — the recorder's per-slot state commitment
+            (flamenco/bank_hash.py lattice chain), asserted slot by
+            slot on replay
+  last      expected final state fingerprint (8 bytes)
 
 Replay executes every block through the host TxnExecutor in a funk
-fork published per block (the bank discipline), recomputes the
-fingerprint, and reports sec/slot — the reference's benchmark.yml
-regression metric.
+fork published per block (the bank discipline), recomputes each
+slot's bank hash AND the final fingerprint, and reports sec/slot —
+the reference's benchmark.yml regression metric.
 
 CLI:  python -m firedancer_tpu.app.backtest replay <ledger>
 """
 from __future__ import annotations
 
+import hashlib
 import io
 import struct
 import sys
 import time
 
+from ..flamenco.bank_hash import BankHasher, lthash_of_root
 from ..funk.funk import Funk
+from ..svm.accdb import Account
 from ..svm import AccDb, TxnExecutor
 from ..svm.programs import OK
 from ..tiles.snapshot import state_fingerprint
@@ -32,14 +37,17 @@ from ..utils.checkpt import (
 )
 
 
-def pack_block(slot: int, payloads: list[bytes]) -> bytes:
+def pack_block(slot: int, payloads: list[bytes],
+               bank_hash: bytes = b"") -> bytes:
     out = struct.pack("<QI", slot, len(payloads))
     for p in payloads:
         out += struct.pack("<I", len(p)) + p
-    return bytes(out)
+    return bytes(out) + bank_hash
 
 
 def unpack_block(b: bytes):
+    """-> (slot, payloads, bank_hash|b"") — the trailing 32 bytes, if
+    present, are the recorded per-slot commitment."""
     slot, cnt = struct.unpack_from("<QI", b, 0)
     off = 12
     payloads = []
@@ -48,7 +56,8 @@ def unpack_block(b: bytes):
         off += 4
         payloads.append(b[off:off + ln])
         off += ln
-    return slot, payloads
+    bank_hash = b[off:off + 32] if len(b) - off == 32 else b""
+    return slot, payloads, bank_hash
 
 
 def record(genesis: Funk, blocks: list[tuple[int, list[bytes]]],
@@ -61,9 +70,12 @@ def record(genesis: Funk, blocks: list[tuple[int, list[bytes]]],
     w.frame(gbuf.getvalue())
     funk = funk_restore(Funk, io.BytesIO(gbuf.getvalue()))
     ex = TxnExecutor(AccDb(funk))
+    hasher = BankHasher(lthash_of_root(funk))
+    parent = hashlib.sha256(b"genesis" + hasher.checksum()).digest()
     for slot, payloads in blocks:
-        w.frame(pack_block(slot, payloads))
-        _exec_block(funk, ex, slot, payloads)
+        _, parent = _exec_block(funk, ex, slot, payloads, hasher,
+                                parent)
+        w.frame(pack_block(slot, payloads, parent))
     fingerprint = state_fingerprint(funk)
     w.frame(fingerprint.to_bytes(8, "little"))
     w.fini()
@@ -71,14 +83,30 @@ def record(genesis: Funk, blocks: list[tuple[int, list[bytes]]],
 
 
 def _exec_block(funk: Funk, ex: TxnExecutor, slot: int,
-                payloads: list[bytes]) -> int:
+                payloads: list[bytes], hasher: BankHasher,
+                parent: bytes,
+                raw_block: bytes | None = None) -> tuple[int, bytes]:
+    """Execute + publish one block; -> (ok_count, bank_hash). The
+    DELTA scan is shared with the replay tile
+    (BankHasher.apply_txn_delta); the chain INPUTS (parent seed,
+    sig-count heuristic, blockhash = frame sha256) are backtest-local,
+    so backtest hashes gate ledger determinism, not cross-component
+    equality."""
     xid = ("block", slot)
     funk.txn_prepare(None, xid)
     ok = 0
+    sigs = 0
     for p in payloads:
         ok += ex.execute(xid, p).status == OK
+        sigs += max(1, p[0] if p else 1)      # compact-u16 first byte
+    hasher.apply_txn_delta(funk, xid)
     funk.txn_publish(xid)
-    return ok
+    # blockhash over the block's serialized bytes; replay passes the
+    # frame it already holds instead of re-packing
+    blockhash = hashlib.sha256(
+        raw_block if raw_block is not None
+        else pack_block(slot, payloads)).digest()
+    return ok, hasher.bank_hash(parent, sigs, blockhash)
 
 
 def replay(fp, verbose: bool = False) -> dict:
@@ -88,13 +116,23 @@ def replay(fp, verbose: bool = False) -> dict:
     genesis_blob = next(frames)
     funk = funk_restore(Funk, io.BytesIO(genesis_blob))
     ex = TxnExecutor(AccDb(funk))
+    hasher = BankHasher(lthash_of_root(funk))
+    parent = hashlib.sha256(b"genesis" + hasher.checksum()).digest()
     blocks = txns = executed = 0
     t0 = time.perf_counter()
     last = None
     for frame in frames:
         if last is not None:
-            slot, payloads = unpack_block(last)
-            executed += _exec_block(funk, ex, slot, payloads)
+            slot, payloads, want_hash = unpack_block(last)
+            raw = last[:-32] if want_hash else last
+            ok, got_hash = _exec_block(funk, ex, slot, payloads,
+                                       hasher, parent, raw_block=raw)
+            executed += ok
+            parent = got_hash
+            if want_hash and got_hash != want_hash:
+                raise AssertionError(
+                    f"bank hash diverged at slot {slot}: "
+                    f"{got_hash.hex()[:16]} != {want_hash.hex()[:16]}")
             blocks += 1
             txns += len(payloads)
         last = frame

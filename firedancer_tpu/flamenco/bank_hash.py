@@ -67,6 +67,20 @@ class BankHasher:
         self.acc = (np.zeros(1024, np.uint16) if acc is None
                     else acc.astype(np.uint16))
 
+    def apply_txn_delta(self, funk, xid):
+        """Fold one in-preparation funk txn's account changes into the
+        lattice (old = parent-visible values). THE shared delta scan —
+        the replay tile and the backtest recorder both use it, so two
+        consumers hashing identical ledgers cannot drift."""
+        from ..svm.accdb import Account
+        recs = funk.txn_recs(xid)
+        old_items = [(key, v) for key in recs
+                     if isinstance(v := funk.rec_query(None, key),
+                                   Account)]
+        new_items = [(key, v) for key, v in recs.items()
+                     if isinstance(v, Account)]
+        self.apply_delta(old_items, new_items)
+
     def apply_delta(self, old_items, new_items):
         """old/new: [(pubkey, Account|None)] for every record the slot
         modified (old = parent-visible value)."""

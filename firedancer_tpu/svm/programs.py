@@ -50,11 +50,53 @@ MAX_CPI_DEPTH = 4                  # instruction stack height limit
 MAX_SEEDS = 16                     # PDA seed count limit (Solana)
 MAX_SEED_LEN = 32
 
-# system instruction discriminants (u32 LE bincode)
+# system instruction discriminants (u32 LE bincode, the Agave enum)
 SYS_CREATE_ACCOUNT = 0
 SYS_ASSIGN = 1
 SYS_TRANSFER = 2
+SYS_CREATE_WITH_SEED = 3
+SYS_ADVANCE_NONCE = 4
+SYS_WITHDRAW_NONCE = 5
+SYS_INIT_NONCE = 6
+SYS_AUTHORIZE_NONCE = 7
 SYS_ALLOCATE = 8
+SYS_ALLOCATE_WITH_SEED = 9
+SYS_ASSIGN_WITH_SEED = 10
+SYS_TRANSFER_WITH_SEED = 11
+
+NONCE_STATE_SZ = 80           # u32 version | u32 state | authority 32
+                              # | durable nonce 32 | fee/sig u64
+
+
+def create_with_seed(base: bytes, seed: bytes, owner: bytes) -> bytes:
+    """Pubkey::create_with_seed — sha256(base || seed || owner); seed-
+    derived addresses are NOT PDAs (no off-curve requirement),
+    ref fd_system_program.c:389."""
+    return hashlib.sha256(base + seed + owner).digest()
+
+
+def _read_seed_str(data: bytes, off: int):
+    """bincode String: u64 length + utf8 bytes; -> (seed, next_off)."""
+    if off + 8 > len(data):
+        raise ValueError("truncated seed")
+    n, = struct.unpack_from("<Q", data, off)
+    if n > 32 or off + 8 + n > len(data):     # MAX_SEED_LEN
+        raise ValueError("seed too long")
+    return data[off + 8:off + 8 + n], off + 8 + n
+
+
+def _nonce_state(authority: bytes, durable: bytes,
+                 fee_per_sig: int = 5000) -> bytes:
+    return struct.pack("<II", 1, 1) + authority + durable         + struct.pack("<Q", fee_per_sig)
+
+
+def _parse_nonce(data: bytes):
+    if len(data) < NONCE_STATE_SZ:
+        raise ValueError("short nonce state")
+    ver, state = struct.unpack_from("<II", data, 0)
+    if ver != 1 or state != 1:
+        raise ValueError("nonce not initialized")
+    return data[8:40], data[40:72]            # authority, durable
 
 # status codes (fd_executor error flavor)
 OK = "ok"
@@ -276,6 +318,169 @@ def _exec_system(ic: InstrCtx) -> str:
         if acct.owner != SYSTEM_PROGRAM_ID:
             return ERR_INVALID_OWNER
         acct.owner = data[4:36]
+        return OK
+
+    if disc == SYS_CREATE_WITH_SEED:
+        # disc | base 32 | seed str | lamports u64 | space u64 | owner
+        try:
+            base = data[4:36]
+            seed, off = _read_seed_str(data, 36)
+            lamports, space = struct.unpack_from("<QQ", data, off)
+            owner = data[off + 16:off + 48]
+        except (ValueError, struct.error):
+            return ERR_BAD_IX_DATA
+        if ic.n < 2 or len(owner) != 32:
+            return ERR_BAD_IX_DATA
+        if ic.key(1) != create_with_seed(base, seed, owner):
+            return ERR_INVALID_OWNER          # address mismatch
+        # base must sign (it authorizes the derived address)
+        if not ic.is_signer(0) or base not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0) or not ic.is_writable(1):
+            return ERR_NOT_WRITABLE
+        to = ic.account(1)
+        if to.lamports or to.data or to.owner != SYSTEM_PROGRAM_ID:
+            return ERR_ALREADY_IN_USE
+        if space > MAX_PERMITTED_DATA_LENGTH:
+            return ERR_SPACE
+        src = ic.account(0)
+        if src.owner != SYSTEM_PROGRAM_ID or src.data:
+            return ERR_INVALID_OWNER
+        if lamports > src.lamports:
+            return ERR_INSUFFICIENT
+        to.data = bytes(space)
+        to.owner = owner
+        src.lamports -= lamports
+        to.lamports += lamports
+        return OK
+
+    if disc in (SYS_ALLOCATE_WITH_SEED, SYS_ASSIGN_WITH_SEED):
+        try:
+            base = data[4:36]
+            seed, off = _read_seed_str(data, 36)
+            if disc == SYS_ALLOCATE_WITH_SEED:
+                space, = struct.unpack_from("<Q", data, off)
+                owner = data[off + 8:off + 40]
+            else:
+                space = None
+                owner = data[off:off + 32]
+        except (ValueError, struct.error):
+            return ERR_BAD_IX_DATA
+        if ic.n < 1 or len(owner) != 32:
+            return ERR_BAD_IX_DATA
+        if ic.key(0) != create_with_seed(base, seed, owner):
+            return ERR_INVALID_OWNER
+        if base not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        acct = ic.account(0)
+        if acct.owner != SYSTEM_PROGRAM_ID:
+            return ERR_INVALID_OWNER
+        if disc == SYS_ALLOCATE_WITH_SEED:
+            if acct.data:
+                return ERR_HAS_DATA
+            if space > MAX_PERMITTED_DATA_LENGTH:
+                return ERR_SPACE
+            acct.data = bytes(space)
+        acct.owner = owner
+        return OK
+
+    if disc == SYS_TRANSFER_WITH_SEED:
+        # disc | lamports u64 | from_seed str | from_owner 32;
+        # accounts [from(derived), base(signer), to]
+        try:
+            amount, = struct.unpack_from("<Q", data, 4)
+            seed, off = _read_seed_str(data, 12)
+            from_owner = data[off:off + 32]
+        except (ValueError, struct.error):
+            return ERR_BAD_IX_DATA
+        if ic.n < 3 or len(from_owner) != 32:
+            return ERR_BAD_IX_DATA
+        if ic.key(0) != create_with_seed(ic.key(1), seed, from_owner):
+            return ERR_INVALID_OWNER
+        if not ic.is_signer(1):
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0) or not ic.is_writable(2):
+            return ERR_NOT_WRITABLE
+        src = ic.account(0)
+        if src.owner != SYSTEM_PROGRAM_ID or src.data:
+            return ERR_INVALID_OWNER
+        if amount > src.lamports:
+            return ERR_INSUFFICIENT
+        src.lamports -= amount
+        ic.account(2).lamports += amount
+        return OK
+
+    if disc == SYS_INIT_NONCE:
+        if len(data) < 36 or ic.n < 1:
+            return ERR_BAD_IX_DATA
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        acct = ic.account(0)
+        # the account must be PRE-ALLOCATED to exactly the nonce size
+        # (Agave's guard: allocation required the account's signature
+        # at CreateAccount time — without it, init+withdraw would
+        # drain any writable wallet that never signed)
+        if acct.owner != SYSTEM_PROGRAM_ID \
+                or len(acct.data) != NONCE_STATE_SZ \
+                or any(acct.data[:8]):
+            return ERR_INVALID_OWNER
+        durable = hashlib.sha256(
+            b"DURABLE_NONCE" + ic.key(0)
+            + ic.ctx.slot.to_bytes(8, "little")).digest()
+        acct.data = _nonce_state(data[4:36], durable)
+        return OK
+
+    if disc in (SYS_ADVANCE_NONCE, SYS_AUTHORIZE_NONCE):
+        if ic.n < 1:
+            return ERR_BAD_IX_DATA
+        acct = ic.account(0)
+        if acct.owner != SYSTEM_PROGRAM_ID:
+            return ERR_INVALID_OWNER
+        try:
+            authority, durable = _parse_nonce(acct.data)
+        except ValueError:
+            return ERR_INVALID_OWNER
+        if authority not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        if disc == SYS_ADVANCE_NONCE:
+            # derived from (key, slot) — the SAME formula as init, so
+            # advancing twice in one slot yields an unchanged value
+            # and FAILS (Agave: advance on an unmoved blockhash fails)
+            new = hashlib.sha256(
+                b"DURABLE_NONCE" + ic.key(0)
+                + ic.ctx.slot.to_bytes(8, "little")).digest()
+            if new == durable:
+                return ERR_BAD_IX_DATA        # nonce must move
+            acct.data = _nonce_state(authority, new)
+        else:
+            if len(data) < 36:
+                return ERR_BAD_IX_DATA
+            acct.data = _nonce_state(data[4:36], durable)
+        return OK
+
+    if disc == SYS_WITHDRAW_NONCE:
+        if len(data) < 12 or ic.n < 2:
+            return ERR_BAD_IX_DATA
+        lamports = _u64(data, 4)
+        acct = ic.account(0)
+        if acct.owner != SYSTEM_PROGRAM_ID:
+            return ERR_INVALID_OWNER
+        try:
+            authority, _durable = _parse_nonce(acct.data)
+        except ValueError:
+            return ERR_INVALID_OWNER
+        if authority not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0) or not ic.is_writable(1):
+            return ERR_NOT_WRITABLE
+        if lamports > acct.lamports:
+            return ERR_INSUFFICIENT
+        acct.lamports -= lamports
+        ic.account(1).lamports += lamports
         return OK
 
     if disc == SYS_ALLOCATE:

@@ -204,14 +204,7 @@ class ReplayCore:
                     self.metrics["exec_fail"] += 1
         self._slot_sigs = sum(p.sig_cnt for p in parsed
                               if p is not None)
-        # accounts-delta lattice update: old values from the parent
-        # view, new from the slot's pending writes — one batched
-        # device lthash per side (flamenco/bank_hash.py)
-        recs = self.funk.txn_recs(xid)
-        old_items = [(key, v) for key in recs
-                     if isinstance(v := self.funk.rec_query(None, key),
-                                   Account)]
-        new_items = [(key, v) for key, v in recs.items()
-                     if isinstance(v, Account)]
-        self.hasher.apply_delta(old_items, new_items)
+        # accounts-delta lattice update (shared scan:
+        # BankHasher.apply_txn_delta — one batched device lthash/side)
+        self.hasher.apply_txn_delta(self.funk, xid)
         self.funk.txn_publish(xid)

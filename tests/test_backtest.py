@@ -69,3 +69,47 @@ def test_replay_detects_divergence():
     raw[len(raw) // 2] ^= 1
     with pytest.raises(Exception):
         replay(io.BytesIO(bytes(raw)))
+
+
+def test_replay_detects_bank_hash_divergence(tmp_path):
+    """Tampering one recorded txn byte trips the PER-SLOT bank-hash
+    assert (not just the final fingerprint) — the reference backtest's
+    bank-hash gate (fd_backtest_tile.c:317)."""
+    import io
+
+    from firedancer_tpu.app.backtest import record, replay
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.svm.accdb import Account
+    from firedancer_tpu.tiles.synth import make_signed_txns, synth_signer_seed
+    from firedancer_tpu.utils.checkpt import CheckptReader, CheckptWriter
+    from firedancer_tpu.utils.ed25519_ref import keypair
+
+    genesis = Funk()
+    for i in range(16):
+        genesis.rec_write(None, keypair(synth_signer_seed(i))[-1],
+                          Account(lamports=1 << 40))
+    txns = make_signed_txns(8, seed=3)
+    blocks = [(0, txns[:4]), (1, txns[4:])]
+    buf = io.BytesIO()
+    record(genesis, blocks, buf)
+
+    # clean replay passes
+    buf.seek(0)
+    out = replay(buf)
+    assert out["blocks"] == 2
+
+    # tamper one byte of block 1's first txn amount; re-frame the
+    # stream (frames are integrity-checked, so rewrite cleanly)
+    buf.seek(0)
+    frames = list(CheckptReader(buf).frames())
+    blk = bytearray(frames[2])
+    blk[-40] ^= 1                       # inside the last txn payload
+    frames[2] = bytes(blk)
+    buf2 = io.BytesIO()
+    w = CheckptWriter(buf2)
+    for f in frames:
+        w.frame(f)
+    w.fini()
+    buf2.seek(0)
+    with pytest.raises(AssertionError, match="slot 1"):
+        replay(buf2)

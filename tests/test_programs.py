@@ -219,3 +219,126 @@ def test_log_collector_truncates(env):
     n = len(lc)
     lc.append("more")                            # dropped after marker
     assert len(lc) == n
+
+
+def _seed_ix(disc, *parts):
+    out = struct.pack("<I", disc)
+    for p in parts:
+        if isinstance(p, tuple) and p[0] == "str":
+            out += struct.pack("<Q", len(p[1])) + p[1]
+        elif isinstance(p, bytes):
+            out += p
+        else:
+            out += struct.pack("<Q", p)
+    return out
+
+
+def test_create_account_with_seed(env):
+    from firedancer_tpu.svm.programs import (
+        SYS_CREATE_WITH_SEED, create_with_seed,
+    )
+    funk, db, ex = env
+    owner = k(9)
+    derived = create_with_seed(k(1), b"vault", owner)
+    ix = _seed_ix(SYS_CREATE_WITH_SEED, k(1), ("str", b"vault"),
+                  5_000, 16, owner)
+    txn = make_txn([k(1)], [derived, SYSTEM_PROGRAM_ID],
+                   [(2, [0, 1], ix)], n_ro_unsigned=1)
+    r = ex.execute("blk", txn)
+    assert r.status == OK, r.status
+    a = db.peek("blk", derived)
+    assert a.lamports == 5_000 and a.owner == owner \
+        and len(a.data) == 16
+    # wrong derived address refused
+    ix_bad = _seed_ix(SYS_CREATE_WITH_SEED, k(1), ("str", b"other"),
+                      5_000, 16, owner)
+    txn = make_txn([k(1)], [derived, SYSTEM_PROGRAM_ID],
+                   [(2, [0, 1], ix_bad)], n_ro_unsigned=1)
+    assert ex.execute("blk", txn).status == ERR_INVALID_OWNER
+
+
+def test_transfer_with_seed(env):
+    from firedancer_tpu.svm.programs import (
+        SYS_CREATE_WITH_SEED, SYS_TRANSFER_WITH_SEED, create_with_seed,
+    )
+    funk, db, ex = env
+    derived = create_with_seed(k(1), b"w", SYSTEM_PROGRAM_ID)
+    ix = _seed_ix(SYS_CREATE_WITH_SEED, k(1), ("str", b"w"),
+                  9_000, 0, SYSTEM_PROGRAM_ID)
+    assert ex.execute("blk", make_txn(
+        [k(1)], [derived, SYSTEM_PROGRAM_ID],
+        [(2, [0, 1], ix)], n_ro_unsigned=1)).status == OK
+    # move funds out of the derived account with only BASE's signature
+    ixt = _seed_ix(SYS_TRANSFER_WITH_SEED, 2_500, ("str", b"w"),
+                   SYSTEM_PROGRAM_ID)
+    r = ex.execute("blk", make_txn(
+        [k(1)], [derived, k(5), SYSTEM_PROGRAM_ID],
+        [(3, [1, 0, 2], ixt)], n_ro_unsigned=1))
+    assert r.status == OK, r.status
+    assert db.lamports("blk", derived) == 6_500
+    assert db.lamports("blk", k(5)) == 2_500
+
+
+def test_nonce_lifecycle(env):
+    from firedancer_tpu.svm.programs import (
+        ERR_BAD_IX_DATA, NONCE_STATE_SZ, SYS_ADVANCE_NONCE,
+        SYS_AUTHORIZE_NONCE, SYS_INIT_NONCE, SYS_WITHDRAW_NONCE,
+        _parse_nonce,
+    )
+    funk, db, ex = env
+    funk.rec_write("blk", k(4), Account(lamports=20_000,
+                                        data=bytes(NONCE_STATE_SZ)))
+    ex.slot = 9
+    # init with k(1) as authority (account pre-allocated: the guard)
+    r = ex.execute("blk", make_txn(
+        [k(1), k(4)], [SYSTEM_PROGRAM_ID],
+        [(2, [1], struct.pack("<I", SYS_INIT_NONCE) + k(1))],
+        n_ro_unsigned=1))
+    assert r.status == OK, r.status
+    auth, d1 = _parse_nonce(db.peek("blk", k(4)).data)
+    assert auth == k(1)
+    # advance moves the durable nonce
+    ex.slot = 10
+    assert ex.execute("blk", make_txn(
+        [k(1), k(4)], [SYSTEM_PROGRAM_ID],
+        [(2, [1], struct.pack("<I", SYS_ADVANCE_NONCE))],
+        n_ro_unsigned=1)).status == OK
+    _, d2 = _parse_nonce(db.peek("blk", k(4)).data)
+    assert d2 != d1
+    # non-authority cannot advance
+    funk.rec_write("blk", k(7), Account(lamports=1 << 30))
+    r = ex.execute("blk", make_txn(
+        [k(7), k(4)], [SYSTEM_PROGRAM_ID],
+        [(2, [1], struct.pack("<I", SYS_ADVANCE_NONCE))],
+        n_ro_unsigned=1))
+    assert r.status == ERR_MISSING_SIG
+    # authorize a new authority, then withdraw with it
+    assert ex.execute("blk", make_txn(
+        [k(1), k(4)], [SYSTEM_PROGRAM_ID],
+        [(2, [1], struct.pack("<I", SYS_AUTHORIZE_NONCE) + k(7))],
+        n_ro_unsigned=1)).status == OK
+    r = ex.execute("blk", make_txn(
+        [k(7), k(4)], [k(8), SYSTEM_PROGRAM_ID],
+        [(3, [1, 2], struct.pack("<IQ", SYS_WITHDRAW_NONCE, 1_000))],
+        n_ro_unsigned=1))
+    assert r.status == OK, r.status
+    assert db.lamports("blk", k(8)) == 1_000
+    # an UNALLOCATED account refuses init (no signer -> no drain)
+    funk.rec_write("blk", k(9), Account(lamports=5_000))
+    from firedancer_tpu.svm.programs import ERR_INVALID_OWNER as EIO
+    r = ex.execute("blk", make_txn(
+        [k(1), k(9)], [SYSTEM_PROGRAM_ID],
+        [(2, [1], struct.pack("<I", SYS_INIT_NONCE) + k(1))],
+        n_ro_unsigned=1))
+    assert r.status == EIO
+    # same-slot double-advance refuses (nonce must move)
+    ex.slot = 20
+    assert ex.execute("blk", make_txn(
+        [k(7), k(4)], [SYSTEM_PROGRAM_ID],
+        [(2, [1], struct.pack("<I", SYS_ADVANCE_NONCE))],
+        n_ro_unsigned=1)).status == OK
+    r = ex.execute("blk", make_txn(
+        [k(7), k(4)], [SYSTEM_PROGRAM_ID],
+        [(2, [1], struct.pack("<I", SYS_ADVANCE_NONCE))],
+        n_ro_unsigned=1))
+    assert r.status == ERR_BAD_IX_DATA
