@@ -28,7 +28,6 @@ import time
 
 from ..flamenco.bank_hash import BankHasher, lthash_of_root
 from ..funk.funk import Funk
-from ..svm.accdb import Account
 from ..svm import AccDb, TxnExecutor
 from ..svm.programs import OK
 from ..tiles.snapshot import state_fingerprint
@@ -39,10 +38,17 @@ from ..utils.checkpt import (
 
 def pack_block(slot: int, payloads: list[bytes],
                bank_hash: bytes = b"") -> bytes:
+    """u64 slot | u32 cnt | (u32 len | payload)* | u8 has_hash |
+    [bank_hash 32] — the marker is EXPLICIT so a corrupted length
+    field fails parsing loudly instead of silently disabling the
+    per-slot gate."""
     out = struct.pack("<QI", slot, len(payloads))
     for p in payloads:
         out += struct.pack("<I", len(p)) + p
-    return bytes(out) + bank_hash
+    if bank_hash:
+        assert len(bank_hash) == 32
+        return bytes(out) + b"\x01" + bank_hash
+    return bytes(out) + b"\x00"
 
 
 def unpack_block(b: bytes):
@@ -56,7 +62,12 @@ def unpack_block(b: bytes):
         off += 4
         payloads.append(b[off:off + ln])
         off += ln
-    bank_hash = b[off:off + 32] if len(b) - off == 32 else b""
+    if off >= len(b) or b[off] not in (0, 1):
+        raise ValueError("corrupt block frame (bad hash marker)")
+    has = b[off]
+    bank_hash = b[off + 1:off + 33] if has else b""
+    if has and len(bank_hash) != 32:
+        raise ValueError("corrupt block frame (short bank hash)")
     return slot, payloads, bank_hash
 
 
@@ -73,9 +84,10 @@ def record(genesis: Funk, blocks: list[tuple[int, list[bytes]]],
     hasher = BankHasher(lthash_of_root(funk))
     parent = hashlib.sha256(b"genesis" + hasher.checksum()).digest()
     for slot, payloads in blocks:
+        raw = pack_block(slot, payloads)     # serialized ONCE
         _, parent = _exec_block(funk, ex, slot, payloads, hasher,
-                                parent)
-        w.frame(pack_block(slot, payloads, parent))
+                                parent, raw_block=raw)
+        w.frame(raw[:-1] + b"\x01" + parent)
     fingerprint = state_fingerprint(funk)
     w.frame(fingerprint.to_bytes(8, "little"))
     w.fini()
@@ -124,7 +136,7 @@ def replay(fp, verbose: bool = False) -> dict:
     for frame in frames:
         if last is not None:
             slot, payloads, want_hash = unpack_block(last)
-            raw = last[:-32] if want_hash else last
+            raw = (last[:-33] if want_hash else last[:-1]) + b"\x00"
             ok, got_hash = _exec_block(funk, ex, slot, payloads,
                                        hasher, parent, raw_block=raw)
             executed += ok

@@ -85,6 +85,18 @@ class RpcServer:
             elif method == "getAccountInfo":
                 result = {"context": {"slot": int(st.get("slot", 0))},
                           "value": self._account(st, params[0])}
+            elif method == "getVersion":
+                result = {"solana-core": "fdtpu-0.4",
+                          "feature-set": 0}
+            elif method == "getEpochInfo":
+                slot = int(st.get("slot", 0))
+                spe = int(st.get("slots_per_epoch", 432_000))
+                result = {"epoch": slot // spe,
+                          "slotIndex": slot % spe,
+                          "slotsInEpoch": spe,
+                          "absoluteSlot": slot,
+                          "transactionCount": int(
+                              st.get("txn_count", 0))}
             else:
                 return {"jsonrpc": "2.0", "id": rid,
                         "error": {"code": -32601,

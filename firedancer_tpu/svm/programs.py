@@ -472,7 +472,16 @@ def _exec_system(ic: InstrCtx) -> str:
         try:
             authority, _durable = _parse_nonce(acct.data)
         except ValueError:
-            return ERR_INVALID_OWNER
+            # UNINITIALIZED nonce-sized account: recoverable by the
+            # account's own signature (Agave's uninitialized-withdraw
+            # path — otherwise allocated-but-never-initialized funds
+            # would be stuck: Transfer refuses data-bearing sources)
+            if len(acct.data) == NONCE_STATE_SZ \
+                    and not any(acct.data) \
+                    and ic.key(0) in ic.signer_keys():
+                authority = ic.key(0)
+            else:
+                return ERR_INVALID_OWNER
         if authority not in ic.signer_keys():
             return ERR_MISSING_SIG
         if not ic.is_writable(0) or not ic.is_writable(1):

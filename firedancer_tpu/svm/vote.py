@@ -26,6 +26,10 @@ NO_ROOT = (1 << 64) - 1
 VOTE_IX_INITIALIZE = 0
 VOTE_IX_VOTE = 1
 VOTE_IX_WITHDRAW = 2
+VOTE_IX_AUTHORIZE = 3          # u32 disc | new_authority 32 | u32 kind
+VOTE_IX_UPDATE_COMMISSION = 4  # u32 disc | u8 commission
+AUTH_KIND_VOTER = 0
+AUTH_KIND_WITHDRAWER = 1
 
 _HDR = "<32s32s32sBQQQH"
 _HDR_SZ = struct.calcsize(_HDR)
@@ -171,6 +175,42 @@ def exec_vote(ic) -> str:
         if not ic.is_writable(0):
             return ERR_NOT_WRITABLE
         st.apply_vote(slots, ts)
+        acct.data = st.to_bytes()
+        return OK
+
+    if disc == VOTE_IX_AUTHORIZE:
+        if len(data) < 40:
+            return ERR_BAD_IX_DATA
+        new_auth = data[4:36]
+        kind = struct.unpack_from("<I", data, 36)[0]
+        # the CURRENT authority of that kind must sign (ref: vote
+        # program authorize — voter changes need the voter OR the
+        # withdrawer; withdrawer changes need the withdrawer)
+        signers = ic.signer_keys()
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        if kind == AUTH_KIND_VOTER:
+            if st.authorized_voter not in signers \
+                    and st.authorized_withdrawer not in signers:
+                return ERR_MISSING_SIG
+            st.authorized_voter = new_auth
+        elif kind == AUTH_KIND_WITHDRAWER:
+            if st.authorized_withdrawer not in signers:
+                return ERR_MISSING_SIG
+            st.authorized_withdrawer = new_auth
+        else:
+            return ERR_BAD_IX_DATA
+        acct.data = st.to_bytes()
+        return OK
+
+    if disc == VOTE_IX_UPDATE_COMMISSION:
+        if len(data) < 5:
+            return ERR_BAD_IX_DATA
+        if st.authorized_withdrawer not in ic.signer_keys():
+            return ERR_MISSING_SIG
+        if not ic.is_writable(0):
+            return ERR_NOT_WRITABLE
+        st.commission = data[4]
         acct.data = st.to_bytes()
         return OK
 

@@ -28,6 +28,10 @@ from firedancer_tpu.svm.stake import (
     STAKE_PROGRAM_ID, STATE_SZ, ix_deactivate, ix_delegate, ix_initialize,
 )
 from firedancer_tpu.svm.vote import VOTE_PROGRAM_ID, VoteState, ix_vote
+from firedancer_tpu.svm.programs import (
+    NONCE_STATE_SZ, SYS_ADVANCE_NONCE, SYS_CREATE_WITH_SEED,
+    SYS_INIT_NONCE, create_with_seed,
+)
 
 FEE = 5000
 
@@ -206,6 +210,48 @@ VECTORS = [
          instrs=[(2, [1], ix_initialize(A, A)),
                  (2, [1], ix_deactivate())], n_ro_unsigned=1,
          expect="invalid_account_owner", fee=FEE, post={B: 5_000}),
+
+    # --- seed derivation (fd_system_program.c:389-554) ---
+    dict(name="create_with_seed_ok",
+         pre={A: 100_000}, signers=[A],
+         extra=[create_with_seed(A, b"s1", k(9)), SYSTEM_PROGRAM_ID],
+         instrs=[(2, [0, 1],
+                  sys_ix(SYS_CREATE_WITH_SEED, A)
+                  + struct.pack("<Q", 2) + b"s1"
+                  + struct.pack("<QQ", 4_000, 8) + k(9))],
+         n_ro_unsigned=1, expect="ok", fee=FEE,
+         post={A: 100_000 - FEE - 4_000,
+               create_with_seed(A, b"s1", k(9)): 4_000}),
+    dict(name="create_with_seed_wrong_address",
+         pre={A: 100_000}, signers=[A],
+         extra=[B, SYSTEM_PROGRAM_ID],
+         instrs=[(2, [0, 1],
+                  sys_ix(SYS_CREATE_WITH_SEED, A)
+                  + struct.pack("<Q", 2) + b"s1"
+                  + struct.pack("<QQ", 4_000, 8) + k(9))],
+         n_ro_unsigned=1, expect="invalid_account_owner", fee=FEE,
+         post={B: 0}),
+
+    # --- durable nonces (fd_system_program nonce family) ---
+    dict(name="nonce_init_requires_allocation",
+         pre={A: 100_000, B: 50},
+         signers=[A, B], extra=[SYSTEM_PROGRAM_ID],
+         instrs=[(2, [1], sys_ix(SYS_INIT_NONCE, A))], n_ro_unsigned=1,
+         expect="invalid_account_owner", fee=2 * FEE, post={B: 50}),
+    dict(name="nonce_init_ok_on_allocated_account",
+         pre={A: 100_000,
+              B: {"lamports": 50, "data": bytes(NONCE_STATE_SZ)}},
+         signers=[A, B], extra=[SYSTEM_PROGRAM_ID],
+         instrs=[(2, [1], sys_ix(SYS_INIT_NONCE, A))], n_ro_unsigned=1,
+         expect="ok", fee=2 * FEE, post={B: 50}),
+    dict(name="nonce_advance_needs_authority",
+         pre={A: 100_000, EVIL: 100_000,
+              B: {"lamports": 50, "data": bytes(NONCE_STATE_SZ)}},
+         signers=[A, B], extra=[SYSTEM_PROGRAM_ID],
+         instrs=[(2, [1], sys_ix(SYS_INIT_NONCE, EVIL)),
+                 (2, [1], sys_ix(SYS_ADVANCE_NONCE))], n_ro_unsigned=1,
+         expect="missing_required_signature", fee=2 * FEE,
+         post={B: 50}),
 
     # --- dispatch (fd_executor.c program routing) ---
     dict(name="unknown_program_refused",

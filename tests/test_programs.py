@@ -342,3 +342,27 @@ def test_nonce_lifecycle(env):
         [(2, [1], struct.pack("<I", SYS_ADVANCE_NONCE))],
         n_ro_unsigned=1))
     assert r.status == ERR_BAD_IX_DATA
+
+
+def test_uninitialized_nonce_account_recoverable(env):
+    """An allocated-but-never-initialized nonce account can withdraw
+    with ITS OWN signature (no stuck funds), but never without it."""
+    from firedancer_tpu.svm.programs import (
+        NONCE_STATE_SZ, SYS_WITHDRAW_NONCE,
+    )
+    funk, db, ex = env
+    funk.rec_write("blk", k(4), Account(lamports=7_000,
+                                        data=bytes(NONCE_STATE_SZ)))
+    # without the account's signature: refused
+    r = ex.execute("blk", make_txn(
+        [k(1)], [k(4), k(8), SYSTEM_PROGRAM_ID],
+        [(3, [1, 2], struct.pack("<IQ", SYS_WITHDRAW_NONCE, 7_000))],
+        n_ro_unsigned=1))
+    assert r.status == ERR_INVALID_OWNER
+    # with it: recoverable
+    r = ex.execute("blk", make_txn(
+        [k(1), k(4)], [k(8), SYSTEM_PROGRAM_ID],
+        [(3, [1, 2], struct.pack("<IQ", SYS_WITHDRAW_NONCE, 7_000))],
+        n_ro_unsigned=1))
+    assert r.status == OK, r.status
+    assert db.lamports("blk", k(8)) == 7_000

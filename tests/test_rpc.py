@@ -54,3 +54,31 @@ def test_rpc_methods():
         assert "error" in err
     finally:
         srv.close()
+
+
+def test_get_version_and_epoch_info():
+    import json
+    import urllib.request
+
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.rpc import RpcServer
+    funk = Funk()
+    srv = RpcServer(lambda: {"funk": funk, "slot": 500_123,
+                             "txn_count": 42}, port=0)
+    try:
+        def call(method):
+            req = json.dumps({"jsonrpc": "2.0", "id": 1,
+                              "method": method}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/", data=req,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30) as r:
+                return json.loads(r.read())["result"]
+        v = call("getVersion")
+        assert "solana-core" in v
+        e = call("getEpochInfo")
+        assert e["epoch"] == 500_123 // 432_000
+        assert e["absoluteSlot"] == 500_123
+        assert e["transactionCount"] == 42
+    finally:
+        srv.close()

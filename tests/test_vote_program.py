@@ -132,3 +132,36 @@ def test_state_roundtrip():
     assert [v.slot for v in rt.tower.votes] == \
         [v.slot for v in st.tower.votes]
     assert rt.root_slot == 1 and rt.credits == 7 and rt.commission == 5
+
+
+def test_authorize_and_update_commission(env):
+    import struct as _s
+
+    from firedancer_tpu.svm.vote import (
+        AUTH_KIND_VOTER, AUTH_KIND_WITHDRAWER, VOTE_IX_AUTHORIZE,
+        VOTE_IX_UPDATE_COMMISSION,
+    )
+    funk, db, ex = env
+    assert _init(ex).status == OK          # withdrawer == VOTER
+    new_voter = k(0x51)
+    # the withdrawer authorizes a new voter
+    t = txn([PAYER, VOTER], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(3, bytes([2]), _s.pack("<I", VOTE_IX_AUTHORIZE)
+              + new_voter + _s.pack("<I", AUTH_KIND_VOTER))])
+    assert ex.execute("blk", t).status == OK
+    st = VoteState.from_bytes(db.peek("blk", VOTE_ACCT).data)
+    assert st.authorized_voter == new_voter
+    # a non-authority cannot flip the withdrawer
+    evil = k(0x66)
+    funk.rec_write("blk", evil, Account(lamports=1 << 30))
+    t = txn([evil], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(2, bytes([1]), _s.pack("<I", VOTE_IX_AUTHORIZE)
+              + evil + _s.pack("<I", AUTH_KIND_WITHDRAWER))])
+    assert ex.execute("blk", t).status == ERR_MISSING_SIG
+    # commission update needs the withdrawer
+    t = txn([PAYER, VOTER], [VOTE_ACCT, VOTE_PROGRAM_ID],
+            [(3, bytes([2]),
+              _s.pack("<I", VOTE_IX_UPDATE_COMMISSION) + bytes([42]))])
+    assert ex.execute("blk", t).status == OK
+    st = VoteState.from_bytes(db.peek("blk", VOTE_ACCT).data)
+    assert st.commission == 42
