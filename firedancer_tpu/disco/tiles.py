@@ -362,6 +362,13 @@ class BankAdapter:
     (the shm-resident accdb is a future component), so use one bank
     tile with exec="svm".
 
+    exec="general": the FULL host SVM per microblock — every txn runs
+    through TxnExecutor (system incl. seed/nonce, vote, stake, ALUT,
+    precompiles, deployed sBPF with CPI), staged through the conflict
+    DAG in wave order (serial fiction preserved); this is the real
+    execution stage, svm's wave path remains the device-batched
+    transfer fast lane.
+
     exec="stub": count txns and ack (ring-plumbing tests).
 
     args: exec, poh_link (optional out link name), done link = the
@@ -394,6 +401,7 @@ class BankAdapter:
         self.m = {k: 0 for k in self.METRICS}
         self.slot = 0                  # highest slot seen in microblocks
         self.fwd_payloads = bool(args.get("forward_payloads", False))
+        self.slots_per_epoch = int(args.get("slots_per_epoch", 432_000))
         if self.fwd_payloads and self.poh_out is not None:
             # fail at BOOT, not mid-flight: the poh frame re-wraps the
             # microblock txn section (micro hdr 20 -> poh hdr 42), so
@@ -404,7 +412,7 @@ class BankAdapter:
                 raise ValueError(
                     f"bank {ctx.tile_name}: forward_payloads needs "
                     f"poh link mtu >= {need}, got {have}")
-        if self.exec_mode == "svm":
+        if self.exec_mode in ("svm", "general"):
             _setup_jax()
             from ..funk.funk import Funk
             self.funk = Funk()
@@ -423,6 +431,16 @@ class BankAdapter:
                 for pub, bal in _synth_genesis(
                         int(args["genesis_synth"])).items():
                     self.funk.rec_write(None, pub, bal)
+            if self.exec_mode == "general":
+                from ..svm import AccDb, TxnExecutor
+                from ..svm.accdb import Account as _Acct
+                # the general executor needs TYPED genesis accounts
+                for key, val in list(self.funk.root_items().items()):
+                    if isinstance(val, int):
+                        self.funk.rec_write(None, key,
+                                            _Acct(lamports=val))
+                self.db = AccDb(self.funk)
+                self.executor = TxnExecutor(self.db)
             # optional JSON-RPC surface over this bank's state (the
             # rpc-tile seam; production would read a shared accdb,
             # ref src/discof/rpc/fd_rpc_tile.c)
@@ -445,6 +463,55 @@ class BankAdapter:
                 self.m["ws_port"] = self.ws.port
         self.seq = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+
+    def _parse_payloads(self, frame, txn_cnt):
+        """THE microblock frame walker (header 20, u16-framed
+        payloads): -> (payloads, parsed ParsedTxns, sha256 mixin over
+        first signatures). Both exec modes consume this, so the frame
+        format and mixin rule live in ONE place."""
+        import hashlib
+
+        from ..protocol.txn import parse_txn
+        payloads, parsed, sigs = [], [], []
+        off = 20
+        for _ in range(txn_cnt):
+            (ln,) = struct.unpack_from("<H", frame, off)
+            off += 2
+            p = bytes(frame[off:off + ln])
+            off += ln
+            try:
+                t = parse_txn(p)
+                sigs.append(t.signatures(p)[0])
+                payloads.append(p)
+                parsed.append(t)
+            except Exception:
+                self.m["exec_skip"] += 1
+        return payloads, parsed, hashlib.sha256(b"".join(sigs)).digest()
+
+    def _wave_order(self, payloads, parsed, xid):
+        """Conflict-DAG wave order over the microblock (pack already
+        guarantees intra-microblock non-conflict, but the DAG is the
+        execution contract — replay uses the identical staging).
+        Resolution runs at the SAME slot the executor will use, so the
+        two call sites can never disagree on table activeness."""
+        from ..replay.rdisp import ConflictDag
+        from ..svm.alut import AlutResolveError, resolve_loaded_keys
+        dag = ConflictDag()
+        for p, t in zip(payloads, parsed):
+            keys = t.account_keys(p)
+            flags = [t.is_writable(i) for i in range(t.acct_cnt)]
+            if t.version == 0 and t.aluts:
+                try:
+                    lk, lw = resolve_loaded_keys(
+                        self.db, xid, t, slot=self.executor.slot)
+                    keys, flags = keys + lk, flags + lw
+                except AlutResolveError:
+                    pass              # executor fails it cleanly
+            dag.add_txn([k for k, w in zip(keys, flags) if w],
+                        [k for k, w in zip(keys, flags) if not w])
+        for wave in dag.waves():
+            for i in wave:
+                yield payloads[i], parsed[i]
 
     def _parse_transfers(self, frame, txn_cnt):
         """Microblock frame -> (SystemTxn list — one per system-program
@@ -502,13 +569,57 @@ class BankAdapter:
             bank, txn_cnt, mb_id, slot = struct.unpack_from("<HHQQ",
                                                             frame, 0)
             self.slot = max(self.slot, slot)
-            if self.exec_mode == "svm" and self.ws is not None \
+            if self.exec_mode in ("svm", "general") \
+                    and self.ws is not None \
                     and self.slot != self._ws_last_slot:
                 self._ws_last_slot = self.slot
                 self.ws.publish_slot(self.slot)
             self.m["txns"] += txn_cnt
             self.m["microblocks"] += 1
-            if self.exec_mode == "svm" and txn_cnt:
+            if self.exec_mode == "general" and txn_cnt:
+                payloads, parsed, mixin = self._parse_payloads(
+                    frame, txn_cnt)
+                touched = set()
+                if payloads:
+                    # the Clock view executes at the microblock's slot
+                    self.executor.slot = self.slot
+                    self.executor.epoch = self.slot // self.slots_per_epoch
+                    new_xid = self._next_xid
+                    self._next_xid += 1
+                    self.funk.txn_prepare(None, new_xid)
+                    ok = fail = 0
+                    try:
+                        for p, t in self._wave_order(payloads, parsed,
+                                                     new_xid):
+                            res = self.executor.execute(new_xid, p)
+                            if res.status == "ok":
+                                ok += 1
+                                touched.update(
+                                    t.account_keys(p)[i]
+                                    for i in range(t.acct_cnt)
+                                    if t.is_writable(i))
+                            else:
+                                fail += 1
+                        self.funk.txn_publish(new_xid)
+                    except Exception:
+                        self.funk.txn_cancel(new_xid)
+                        raise
+                    self.m["transfers"] += ok
+                    self.m["exec_fail"] += fail
+                if self.ws is not None and self.ws.has_clients:
+                    for key in touched:
+                        self.ws.publish_account(
+                            key, self.funk.rec_query(None, key),
+                            self.slot)
+                if self.poh_out is not None:
+                    while self.poh_fseqs and \
+                            self.poh_out.credits(self.poh_fseqs) <= 0:
+                        time.sleep(20e-6)
+                    blob = frame[20:] if self.fwd_payloads else b""
+                    self.poh_out.publish(
+                        struct.pack("<QH", mb_id, txn_cnt) + mixin
+                        + blob, sig=mb_id)
+            elif self.exec_mode == "svm" and txn_cnt:
                 from ..svm.executor import STATUS_OK, execute_block
                 txns, mixin = self._parse_transfers(frame, txn_cnt)
                 if txns:

@@ -228,3 +228,43 @@ def test_leader_bank_serves_rpc(leader):
     pub = keypair(synth_signer_seed(0))[-1]
     bal = call("getBalance", [b58_encode_32(pub)])["result"]["value"]
     assert 0 < bal <= (1 << 44)
+
+
+@pytest.mark.slow
+def test_general_execution_bank():
+    """exec="general": the bank runs the FULL host SVM per microblock
+    (not just the transfer fast path) inside the live leader loop."""
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    genesis = {}
+    for i in range(16):
+        pub = keypair(synth_signer_seed(i))[-1]
+        genesis[pub.hex()] = 1 << 44
+    topo = (
+        Topology(f"gx{os.getpid()}", wksp_size=1 << 25)
+        .link("synth_verify", depth=128, mtu=1280)
+        .link("verify_pack", depth=128, mtu=1280)
+        .link("pack_bank0", depth=32, mtu=1 << 14)
+        .link("bank0_done", depth=32, mtu=64)
+        .tcache("verify_tc", depth=4096)
+        .tile("synth", "synth", outs=["synth_verify"], count=N_TXNS,
+              unique=N_TXNS, seed=6)
+        .tile("verify", "verify", ins=["synth_verify"],
+              outs=["verify_pack"], batch=16, tcache="verify_tc")
+        .tile("pack", "pack", ins=["verify_pack", "bank0_done"],
+              outs=["pack_bank0"], txn_in="verify_pack",
+              bank_links=["pack_bank0"], done_links=["bank0_done"],
+              slot_ms=200.0, max_txn_per_microblock=8)
+        .tile("bank0", "bank", ins=["pack_bank0"],
+              outs=["bank0_done"], exec="general", genesis=genesis)
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=540)
+        assert _wait(
+            lambda: runner.metrics("bank0")["transfers"] == N_TXNS,
+            timeout_s=180)
+        b = runner.metrics("bank0")
+        assert b["exec_fail"] == 0 and b["txns"] == N_TXNS
+    finally:
+        runner.halt()
+        runner.close()
