@@ -16,16 +16,19 @@ the lane dimension, limbs in sublanes), radix 2^13, same representation
 and bound discipline as ops/fe25519.py (see its module docstring for the
 carry analysis). The grid splits the batch into TB-lane programs.
 
-Two kernels:
-  * `_decompress_kernel` — RFC 8032 §5.1.3 point decompression with
-    failure masks; one (p-5)/8 power chain (addition-chain form:
-    254 squarings + 11 multiplies instead of scan square-and-multiply).
-  * `_dsm_encode_kernel` — the double scalar mul [S]B + [k](−A) with
-    4-bit windows (fixed-base: doubling-free precomputed affine tables,
-    7-mul mixed adds; variable-base: per-lane 16-entry table, 256
-    doublings in T-free 7-mul form where possible), followed by the
-    projective→affine encode (one inversion chain) to canonical y digits
-    + x parity.
+One fused kernel (`_verify_kernel`, r4 — previously decompress and
+dsm+encode were two dispatches with an HBM bounce of x/t between them):
+  * RFC 8032 §5.1.3 point decompression with failure masks; one
+    (p-5)/8 power chain (addition-chain form: 254 squarings + 11
+    multiplies instead of scan square-and-multiply);
+  * the double scalar mul [S]B + [k](−A) with 4-bit windows
+    (fixed-base: doubling-free precomputed affine tables, 7-mul mixed
+    adds; variable-base: per-lane 16-entry table, 256 doublings in
+    T-free 7-mul form where possible);
+  * the projective→affine encode (one inversion chain) compared
+    in-kernel against R's exact 255-bit digits + sign bit — digit
+    equality on canonical output is exactly byte equality of the
+    canonical encoding, so no byte packing leaves the chip.
 
 Glue `verify_batch` reproduces ops/ed25519.verify_batch semantics
 bit-for-bit (strict small-order rejection, S canonicality, cofactorless
@@ -86,14 +89,17 @@ _ONE = None
 
 
 def fadd(a, b):
-    # loose(≤9408) + loose < 2^14.3: one pass leaves limbs ≤ 8192+2 and
-    # limb0 ≤ 8192+2·608+2 = 9410 — still multiply-safe (9410²·20 < 2^31)
+    # Kernel-wide loose bound B = 10650: every fe value entering fmul has
+    # limbs in [0, B]. fadd: 2B < 2^15, one pass leaves limbs ≤ 8193 and
+    # limb0 ≤ 8191 + 2·608 = 9407 ≤ B. Multiply safety is 20·B² < 2^32
+    # with the wrap-tolerant reduction in _reduce39.
     return _carry(a + b, passes=1)
 
 
 def fsub(a, b):
-    # a + C − b with C ≡ 0 (mod p), per-limb 22752..65535: sum < 2^17;
-    # two passes restore the ≤9410 loose bound
+    # a + C − b with C ≡ 0 (mod p), per-limb 22752..65535 > B so the
+    # difference stays non-negative limb-wise; sum < 2^17; two passes
+    # leave limbs ≤ 8200, limb0 ≤ 8799 ≤ B
     return _carry(a + _const_col(fe.SUB_C) - b, passes=2)
 
 
@@ -106,14 +112,33 @@ def fmul_small2(a):
     return _carry(a * 2, passes=1)
 
 
+_HI_MASK = (1 << (32 - BITS)) - 1
+
+
 def _reduce39(c):
-    """(2*NL-1, TB) schoolbook coefficients (< 2^31) -> loose (NL, TB)."""
+    """(2*NL-1, TB) schoolbook coefficients -> loose (NL, TB).
+
+    Coefficients are sums of up to 20 limb products; with the kernel-wide
+    loose bound B = 10650 (see the invariant note on fmul) they reach
+    20·B² ≈ 2^31.08 — past int32 max but below 2^32, so the int32
+    accumulation wraps. Two's complement keeps the low bits exact:
+    `c & MASK` is already the true low 13 bits, and masking the
+    arithmetic shift to its low 19 bits recovers the true logical
+    `hi = c >> 13` (true hi < 2^19 because the true value < 2^32).
+
+    Two carry passes then restore the loose bound: input rows to the
+    carry are < 2^27.4 (hi ≤ 276903 from 20·B², row ≤ lo+hi ≤ 285094,
+    ×FOLD(608) + row ≤ 1.74e8); pass 1 leaves limbs ≤ 29389 and
+    limb0 ≤ 8191 + 608·21198 < 1.29e7; pass 2 leaves limb1 ≤ 9764,
+    limb0 ≤ 10015, others ≤ 8194 — all ≤ B, closing the invariant.
+    (tests/test_pallas_bounds.py walks these intervals mechanically.)
+    """
     lo = c & MASK
-    hi = c >> BITS
+    hi = (c >> BITS) & _HI_MASK
     z1 = jnp.zeros_like(lo[:1])
     c = (jnp.concatenate([lo, z1], axis=0)
          + jnp.concatenate([z1, hi], axis=0))          # (2*NL, TB)
-    return _carry(c[:NL] + c[NL:] * FOLD, passes=3)
+    return _carry(c[:NL] + c[NL:] * FOLD, passes=2)
 
 
 def fmul(a, b):
@@ -377,14 +402,27 @@ def _fb_entry(ymx_j, ypx_j, t2d_j, w):
 # kernels
 # ---------------------------------------------------------------------------
 
-def _decompress_kernel(y_ref, sign_ref, x_ref, t_ref, ok_ref):
-    """RFC 8032 §5.1.3 decompression. y_ref: exact 255-bit digits.
-    Outputs x (loose), t = x·y (loose), ok mask. y-canonicality (y<p) is
-    checked on the jnp side (digit compare, cheap)."""
+def _verify_kernel(y_ref, sign_ref, sw_ref, kw_ref, ry_ref, rsign_ref,
+                   fb_ymx_ref, fb_ypx_ref, fb_t2d_ref, ok_ref):
+    """Fused verify core: decompress(A) → R' = [S]B + [k](−A) → encode →
+    compare against R. y_ref/ry_ref: exact 255-bit digits of A.y / R.y;
+    sign/rsign: their sign bits. y-canonicality (y<p), S canonicality and
+    small-order rejection are checked on the jnp side (digit compares,
+    cheap); everything multiplicative lives here in VMEM.
+
+    Variable-base: per-lane 16-entry precomputed table of w·(−A), 64
+    msb-first windows of 4 T-free doublings + 1 full doubling + 1 8-mul
+    add. Fixed-base: doubling-free 7-mul mixed adds against the constant
+    affine tables. Encode: one inversion chain + canonicalization; the
+    final verdict is digit+sign equality with R (== canonical byte
+    equality) ANDed with the decompression mask.
+    """
     y = y_ref[:]
     sign = sign_ref[:]
     tb = y.shape[-1]
     one = pt_identity(tb)[1]
+
+    # --- decompress A (RFC 8032 §5.1.3) ---
     y2 = fsq(y)
     u = fsub(y2, one)
     v = fadd(fmul_const(y2, fe.D_LIMBS), one)
@@ -395,36 +433,19 @@ def _decompress_kernel(y_ref, sign_ref, x_ref, t_ref, ok_ref):
     root_ok = fis_zero(fsub(vx2, u))
     root_neg = fis_zero(fadd(vx2, u))
     x = jnp.where(root_neg, fmul_const(x, fe.SQRT_M1_LIMBS), x)
-    ok = root_ok | root_neg
+    dec_ok = root_ok | root_neg
     xc = fcanon(x)
     x_is_zero = jnp.all(xc == 0, axis=0, keepdims=True)
-    ok = ok & ~(x_is_zero & (sign == 1))
+    dec_ok = dec_ok & ~(x_is_zero & (sign == 1))
     flip = (xc[0:1] & 1) != sign
-    x = jnp.where(flip, fneg(x), x)
-    x_ref[:] = _carry(x, passes=1)
-    t_ref[:] = fmul(x, y)
-    ok_ref[:] = ok.astype(jnp.int32)
+    ax = jnp.where(flip, fneg(x), x)
+    ay = y
+    at = fmul(ax, ay)
 
-
-def _dsm_encode_kernel(sw_ref, kw_ref, ax_ref, ay_ref, at_ref,
-                       fb_ymx_ref, fb_ypx_ref, fb_t2d_ref,
-                       outy_ref, outsign_ref):
-    """R' = [S]B + [k](−A); outputs canonical y digits + x parity of R'.
-
-    Variable-base: per-lane 16-entry precomputed table of w·(−A), 64
-    msb-first windows of 4 T-free doublings + 1 full doubling + 1 8-mul
-    add. Fixed-base: doubling-free 7-mul mixed adds against the constant
-    affine tables. Encode: one inversion chain + canonicalization.
-    """
-    ax = ax_ref[:]
-    ay = ay_ref[:]
-    at = at_ref[:]
-    tb = ax.shape[-1]
-
+    # --- double scalar mul ---
     # −A (affine, z = 1)
     nx = fneg(ax)
     nt = fneg(at)
-    one = pt_identity(tb)[1]
     a_neg_pre = (fsub(ay, nx), fadd(ay, nx), fmul_const(nt, fe.D2_LIMBS))
 
     # build 16-entry variable-base table in precomputed projective form
@@ -454,13 +475,15 @@ def _dsm_encode_kernel(sw_ref, kw_ref, ax_ref, ay_ref, at_ref,
 
     vacc, facc = jax.lax.fori_loop(
         0, 64, window_step, (pt_identity(tb), pt_identity(tb)))
-    rx, ry, rz, _ = pt_add_full(vacc, facc)
+    rpx, rpy, rpz, _ = pt_add_full(vacc, facc)
 
-    zinv = finv(rz)
-    xc = fcanon(fmul(rx, zinv))
-    yc = fcanon(fmul(ry, zinv))
-    outy_ref[:] = yc
-    outsign_ref[:] = xc[0:1] & 1
+    # --- encode + compare with R in-kernel ---
+    zinv = finv(rpz)
+    xc2 = fcanon(fmul(rpx, zinv))
+    yc = fcanon(fmul(rpy, zinv))
+    match = jnp.all(yc == ry_ref[:], axis=0, keepdims=True)
+    match = match & ((xc2[0:1] & 1) == rsign_ref[:])
+    ok_ref[:] = (dec_ok & match).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -485,67 +508,31 @@ def _tab_spec():
 
 
 @functools.partial(jax.jit, static_argnames=("tb", "interpret"))
-def decompress_tpu(y_digits, sign, tb=DEFAULT_TB, interpret=False):
-    """y_digits (NL, B) exact digits; sign (1, B) int32. Returns
-    x (NL, B) loose, t (NL, B) loose, ok (1, B) int32."""
-    b = y_digits.shape[-1]
+def verify_tpu(y_a, sign_a, s_w, k_w, r_y, r_sign,
+               tb=DEFAULT_TB, interpret=False):
+    """Fused verify core. y_a/r_y (NL, B) exact digits; sign rows
+    (1, B) int32; s_w/k_w (64, B) int32 windows. Returns ok (1, B)."""
+    b = y_a.shape[-1]
     assert b % tb == 0, (b, tb)
-    grid = (b // tb,)
-    return pl.pallas_call(
-        _decompress_kernel,
-        grid=grid,
-        in_specs=[_fe_spec(tb), _row_spec(tb)],
-        out_specs=[_fe_spec(tb), _fe_spec(tb), _row_spec(tb)],
-        out_shape=[
-            jax.ShapeDtypeStruct((NL, b), jnp.int32),
-            jax.ShapeDtypeStruct((NL, b), jnp.int32),
-            jax.ShapeDtypeStruct((1, b), jnp.int32),
-        ],
-        interpret=interpret,
-    )(y_digits, sign)
-
-
-@functools.partial(jax.jit, static_argnames=("tb", "interpret"))
-def dsm_encode_tpu(s_w, k_w, ax, ay, at, tb=DEFAULT_TB, interpret=False):
-    """s_w/k_w (64, B) int32 windows; A affine (x, y, t) as (NL, B) each.
-    Returns (y_canonical_digits (NL, B), sign_row (1, B))."""
-    b = s_w.shape[-1]
-    assert b % tb == 0
     ymx, ypx, t2d = _fb_tables()
     grid = (b // tb,)
     return pl.pallas_call(
-        _dsm_encode_kernel,
+        _verify_kernel,
         grid=grid,
-        in_specs=[_win_spec(tb), _win_spec(tb),
-                  _fe_spec(tb), _fe_spec(tb), _fe_spec(tb),
+        in_specs=[_fe_spec(tb), _row_spec(tb),
+                  _win_spec(tb), _win_spec(tb),
+                  _fe_spec(tb), _row_spec(tb),
                   _tab_spec(), _tab_spec(), _tab_spec()],
-        out_specs=[_fe_spec(tb), _row_spec(tb)],
-        out_shape=[
-            jax.ShapeDtypeStruct((NL, b), jnp.int32),
-            jax.ShapeDtypeStruct((1, b), jnp.int32),
-        ],
+        out_specs=[_row_spec(tb)],
+        out_shape=[jax.ShapeDtypeStruct((1, b), jnp.int32)],
         interpret=interpret,
-    )(s_w, k_w, ax, ay, at, jnp.asarray(ymx), jnp.asarray(ypx),
-      jnp.asarray(t2d))
+    )(y_a, sign_a, s_w, k_w, r_y, r_sign,
+      jnp.asarray(ymx), jnp.asarray(ypx), jnp.asarray(t2d))[0]
 
 
 # ---------------------------------------------------------------------------
 # glue: full verify with pallas core
 # ---------------------------------------------------------------------------
-
-# 255-bit digit packing matrix (bytes handled on the jnp side)
-_PACK_BITS = None
-
-
-def _y_to_bytes(y_digits_t, sign_row):
-    """(NL, B) canonical digits + (1, B) sign -> (B, 32) uint8."""
-    y = jnp.moveaxis(y_digits_t, 0, -1)              # (B, NL)
-    bits = (y[..., jnp.asarray(fe._L2BIT_IDX)]
-            >> jnp.asarray(fe._L2BIT_SHIFT)) & 1
-    b = fe.bits_to_bytes(bits)                       # (B, 32)
-    sign = sign_row[0].astype(jnp.uint8)
-    return b.at[..., 31].set(b[..., 31] | (sign << 7))
-
 
 def _pad_to(x, b_pad, axis=0):
     pad = b_pad - x.shape[axis]
@@ -583,16 +570,17 @@ def verify_batch(sig, pub, msg, msg_len, tb=DEFAULT_TB, interpret=False):
 
     y_a = jnp.moveaxis(fe.frombytes(pub), 0, -1)          # (NL, B)
     sign_a = (pub[:, 31] >> 7).astype(jnp.int32)[None, :]
+    r_y = jnp.moveaxis(fe.frombytes(r_bytes), 0, -1)      # (NL, B)
+    r_sign = (r_bytes[:, 31] >> 7).astype(jnp.int32)[None, :]
 
     # pad batch to grid multiple
     y_a = _pad_to(y_a, b_pad, axis=1)
     sign_a = _pad_to(sign_a, b_pad, axis=1)
     s_w = _pad_to(s_w, b_pad, axis=1)
     k_w = _pad_to(k_w, b_pad, axis=1)
+    r_y = _pad_to(r_y, b_pad, axis=1)
+    r_sign = _pad_to(r_sign, b_pad, axis=1)
 
-    ax, at, dec_ok = decompress_tpu(y_a, sign_a, tb=tb, interpret=interpret)
-    yc, sgn = dsm_encode_tpu(s_w, k_w, ax, y_a, at, tb=tb,
-                             interpret=interpret)
-    rp_bytes = _y_to_bytes(yc[:, :bsz], sgn[:, :bsz])
-    match = jnp.all(rp_bytes == r_bytes, axis=-1)
-    return s_ok & a_ok & r_ok & match & (dec_ok[0, :bsz] == 1)
+    ok = verify_tpu(y_a, sign_a, s_w, k_w, r_y, r_sign,
+                    tb=tb, interpret=interpret)
+    return s_ok & a_ok & r_ok & (ok[0, :bsz] == 1)
