@@ -1,0 +1,231 @@
+"""notar confirmation thresholds, hfork detection, voter accessors
+(ref: src/choreo/notar/fd_notar.h, src/choreo/hfork/fd_hfork.h,
+src/choreo/voter/fd_voter.h)."""
+import struct
+
+import pytest
+
+from firedancer_tpu.choreo.notar import Notar
+from firedancer_tpu.choreo.hfork import HforkDetector
+from firedancer_tpu.choreo import voter as voter_mod
+from firedancer_tpu.flamenco import types as fdtypes
+
+
+def _v(i):
+    return bytes([i]) * 32
+
+
+def _bid(i):
+    return bytes([0xB0, i]) + bytes(30)
+
+
+# ---------------------------------------------------------------------------
+# notar
+# ---------------------------------------------------------------------------
+
+def test_notar_thresholds_in_order():
+    # 10 voters x 10 stake; thresholds: propagated >=1/3 (34), dup >52%
+    # (>52 -> 60), optimistic >=2/3 (>=67 -> 70)
+    n = Notar()
+    n.set_epoch_stakes({_v(i): 10 for i in range(10)})
+    n.on_block(5, 4, _bid(1))
+    kinds = []
+    for i in range(10):
+        for c in n.on_vote(_v(i), 5, _bid(1)):
+            kinds.append((c.kind, i))
+    assert kinds == [("propagated", 3),   # 4th voter -> 40 >= 33.3
+                     ("duplicate", 5),    # 6th voter -> 60 > 52
+                     ("optimistic", 6)]   # 7th voter -> 70 >= 66.7
+
+
+def test_notar_no_double_count():
+    n = Notar()
+    n.set_epoch_stakes({_v(0): 60, _v(1): 40})
+    # same voter voting twice contributes once
+    n.on_vote(_v(1), 3, _bid(0))
+    assert n.slots[3].stake == 40
+    n.on_vote(_v(1), 3, _bid(0))
+    assert n.slots[3].stake == 40
+    assert n.blocks[_bid(0)].stake == 40
+
+
+def test_notar_stake_counts_multiple_blocks_same_slot():
+    """Unlike ghost, a switching validator counts toward both block
+    versions of a slot (equivocation case)."""
+    n = Notar()
+    n.set_epoch_stakes({_v(i): 10 for i in range(10)})
+    for i in range(10):
+        n.on_vote(_v(i), 7, _bid(1))
+    for i in range(10):
+        n.on_vote(_v(i), 7, _bid(2))
+    assert n.blocks[_bid(1)].stake == 100
+    assert n.blocks[_bid(2)].stake == 100
+    # slot-level stake still counts each voter once
+    assert n.slots[7].stake == 100
+
+
+def test_notar_dup_confirm_remaps_block_id():
+    n = Notar()
+    n.set_epoch_stakes({_v(i): 10 for i in range(10)})
+    n.on_block(9, 8, _bid(1))            # we replayed version 1
+    assert n.slot_block_id[9] == _bid(1)
+    for i in range(7):
+        n.on_vote(_v(i), 9, _bid(2))     # cluster dup-confirms version 2
+    assert n.is_duplicate_confirmed(_bid(2))
+    assert n.slot_block_id[9] == _bid(2)
+
+
+def test_notar_late_replay_adopts_dup_confirmed_id():
+    """Cluster dup-confirms a version BEFORE we replay the slot: our
+    later on_block must adopt the confirmed id, not its own version."""
+    n = Notar()
+    n.set_epoch_stakes({_v(i): 10 for i in range(10)})
+    for i in range(7):
+        n.on_vote(_v(i), 9, _bid(2))
+    assert n.is_duplicate_confirmed(_bid(2))
+    n.on_block(9, 8, _bid(1))            # we replayed the other version
+    assert n.slot_block_id[9] == _bid(2)
+
+
+def test_notar_may_vote_requires_propagated_leader_slot():
+    n = Notar()
+    n.set_epoch_stakes({_v(i): 10 for i in range(10)})
+    n.on_block(10, 9, _bid(1), is_leader=True)
+    n.on_block(12, 10, _bid(2), prev_leader_slot=10)
+    assert n.may_vote(10)                # own leader block: always
+    assert not n.may_vote(12)            # leader slot 10 not propagated
+    for i in range(4):
+        n.on_vote(_v(i), 10, _bid(1))
+    assert n.is_propagated(10)
+    assert n.may_vote(12)
+
+
+def test_notar_publish_prunes():
+    n = Notar()
+    n.set_epoch_stakes({_v(0): 1})
+    n.on_vote(_v(0), 3, _bid(3))
+    n.on_vote(_v(0), 8, _bid(8))
+    n.publish(5)
+    assert 3 not in n.slots and _bid(3) not in n.blocks
+    assert 8 in n.slots and _bid(8) in n.blocks
+    assert n.on_vote(_v(0), 4, _bid(4)) == []   # below root: ignored
+
+
+# ---------------------------------------------------------------------------
+# hfork
+# ---------------------------------------------------------------------------
+
+def test_hfork_divergent_hash_alarm():
+    h = HforkDetector(total_stake=100)
+    h.on_our_result(_bid(1), b"\x11" * 32)
+    alerts = []
+    for i in range(10):
+        alerts += h.on_vote(_v(i), _bid(1), b"\x22" * 32, 10)
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a.reason == "divergent" and a.our_hash == b"\x11" * 32
+    assert a.cluster_hash == b"\x22" * 32 and a.stake > 52
+
+
+def test_hfork_agreement_no_alarm():
+    h = HforkDetector(total_stake=100)
+    h.on_our_result(_bid(1), b"\x11" * 32)
+    for i in range(10):
+        assert h.on_vote(_v(i), _bid(1), b"\x11" * 32, 10) == []
+
+
+def test_hfork_dead_block_alarm_and_late_our_result():
+    h = HforkDetector(total_stake=100)
+    # votes arrive before we know our own result
+    for i in range(10):
+        h.on_vote(_v(i), _bid(2), b"\x33" * 32, 10)
+    assert h.alerts == []
+    h.on_our_result(_bid(2), None)       # we marked it dead
+    assert [a.reason for a in h.alerts] == ["dead"]
+
+
+def test_hfork_self_vote_mismatch_immediate():
+    me = _v(42)
+    h = HforkDetector(total_stake=1000, identity=me)
+    h.on_our_result(_bid(3), b"\x44" * 32)
+    alerts = h.on_vote(me, _bid(3), b"\x55" * 32, 1)
+    assert [a.reason for a in alerts] == ["self"]
+
+
+def test_hfork_replay_plus_gossip_counts_once():
+    """The same (voter, block, hash) observation via two paths must not
+    double-count stake toward the 52% threshold."""
+    h = HforkDetector(total_stake=100)
+    h.on_our_result(_bid(1), b"\x11" * 32)
+    v = _v(3)                            # 27% voter, seen twice
+    assert h.on_vote(v, _bid(1), b"\x22" * 32, 27) == []
+    assert h.on_vote(v, _bid(1), b"\x22" * 32, 27) == []
+    assert h.weights[_bid(1)][b"\x22" * 32] == 27
+    assert h.alerts == []
+
+
+def test_hfork_ours_lru_bounded():
+    h = HforkDetector(total_stake=100, max_blocks=4)
+    for i in range(10):
+        h.on_our_result(_bid(i), bytes([i]) * 32)
+    assert len(h.ours) == 4
+    assert _bid(9) in h.ours and _bid(0) not in h.ours
+
+
+def test_hfork_ring_eviction_subtracts_stake():
+    h = HforkDetector(total_stake=100, max_live=2)
+    v = _v(7)
+    h.on_vote(v, _bid(1), b"\x11" * 32, 60)
+    h.on_vote(v, _bid(2), b"\x11" * 32, 60)
+    h.on_vote(v, _bid(3), b"\x11" * 32, 60)   # evicts the _bid(1) entry
+    assert _bid(1) not in h.weights or not h.weights[_bid(1)]
+    # stale weight can no longer trip an alarm
+    h.on_our_result(_bid(1), b"\x99" * 32)
+    assert h.alerts == []
+
+
+# ---------------------------------------------------------------------------
+# voter accessors
+# ---------------------------------------------------------------------------
+
+def test_voter_accessors_v2_match_full_decode():
+    votes = [(100, 5), (101, 4), (102, 3)]
+    data = fdtypes.encode_vote_state(
+        _v(1), _v(2), _v(3), commission=7, votes=votes, root_slot=99)
+    assert voter_mod.kind(data) == voter_mod.V2
+    assert voter_mod.node_pubkey(data) == _v(1)
+    assert voter_mod.last_vote_slot(data) == 102
+    assert voter_mod.root_slot(data) == 99
+    assert voter_mod.tower(data) == votes
+    full = fdtypes.decode_vote_state(data)
+    assert full["votes"] == votes and full["root_slot"] == 99
+
+
+def test_voter_accessors_v2_empty_tower():
+    data = fdtypes.encode_vote_state(
+        _v(1), _v(2), _v(3), commission=0, votes=[], root_slot=None)
+    assert voter_mod.last_vote_slot(data) is None
+    assert voter_mod.root_slot(data) is None
+    assert voter_mod.tower(data) == []
+
+
+def test_voter_accessors_v3_latency_stride():
+    """Hand-built V3 (current) prefix: 13-byte entries with the leading
+    latency byte (ref fd_voter.h votes_v3)."""
+    votes = [(7, 31), (8, 30)]
+    buf = struct.pack("<I", 2) + _v(9) + _v(8) + bytes([5])
+    buf += struct.pack("<Q", len(votes))
+    for slot, conf in votes:
+        buf += bytes([1]) + struct.pack("<QI", slot, conf)
+    buf += bytes([1]) + struct.pack("<Q", 6)      # root = Some(6)
+    assert voter_mod.kind(buf) == voter_mod.V3
+    assert voter_mod.last_vote_slot(buf) == 8
+    assert voter_mod.root_slot(buf) == 6
+    assert voter_mod.tower(buf) == votes
+
+
+def test_voter_rejects_garbage():
+    with pytest.raises(voter_mod.VoterError):
+        voter_mod.kind(b"\x07\x00\x00\x00" + bytes(80))
+    with pytest.raises(voter_mod.VoterError):
+        voter_mod.last_vote_slot(bytes(10))
