@@ -1,0 +1,200 @@
+"""solcap capture/diff — differential-debugging workflow
+(ref: src/flamenco/capture/fd_solcap_writer.h, fd_solcap_diff.c)."""
+import io
+import struct
+
+from firedancer_tpu.flamenco.solcap import (
+    CapturingExecutor, CapWriter, diff, main as solcap_main, read_records,
+)
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account
+from firedancer_tpu.svm.accdb import SYSTEM_PROGRAM_ID
+from firedancer_tpu.svm.programs import OK, SYS_TRANSFER, TxnExecutor
+
+
+def k(n):
+    return bytes([n]) * 32
+
+
+def transfer_txn(src, dst, lamports):
+    data = struct.pack("<IQ", SYS_TRANSFER, lamports)
+    msg = build_message([src], [dst, SYSTEM_PROGRAM_ID], b"\x11" * 32,
+                        [(2, bytes([0, 1]), data)])
+    return build_txn([bytes(64)], msg)
+
+
+def _run_ledger(amounts, fp):
+    """Execute one block of transfers under capture; capture -> fp."""
+    funk = Funk()
+    funk.rec_write(None, k(1), Account(lamports=1_000_000))
+    funk.txn_prepare(None, "blk")
+    w = CapWriter(fp)
+    cex = CapturingExecutor(TxnExecutor(AccDb(funk)), w)
+    w.slot(7, b"\xAA" * 32)
+    results = [cex.execute("blk", transfer_txn(k(1), k(2), a))
+               for a in amounts]
+    w.bank(b"\xBB" * 32)
+    w.fini()
+    return results
+
+
+def test_capture_roundtrip_and_contents():
+    fp = io.BytesIO()
+    res = _run_ledger([300, 250], fp)
+    assert all(r.status == OK for r in res)
+    fp.seek(0)
+    recs = list(read_records(fp))
+    kinds = [kd for kd, _ in recs]
+    assert kinds == ["slot", "txn", "txn", "bank"]
+    t0 = recs[1][1]
+    assert t0["status"] == OK and t0["index"] == 0
+    # pre/post for payer, dest, and the program account
+    assert t0["pre"][k(2)] is None            # dest did not exist yet
+    assert t0["post"][k(2)]["lamports"] == 300
+    assert t0["pre"][k(1)]["lamports"] == 1_000_000
+    delta = t0["pre"][k(1)]["lamports"] - t0["post"][k(1)]["lamports"]
+    assert delta == 300 + t0["fee"]
+
+
+def test_identical_ledgers_diff_clean():
+    fa, fb = io.BytesIO(), io.BytesIO()
+    _run_ledger([300, 250], fa)
+    _run_ledger([300, 250], fb)
+    fa.seek(0), fb.seek(0)
+    assert diff(fa, fb) is None
+
+
+def test_divergent_execution_pinpointed():
+    """One lamport of divergence in txn 1 must be reported at the
+    account level for txn index 1 — the fd_solcap_diff workflow."""
+    fa, fb = io.BytesIO(), io.BytesIO()
+    _run_ledger([300, 250], fa)
+    _run_ledger([300, 251], fb)
+    fa.seek(0), fb.seek(0)
+    d = diff(fa, fb)
+    assert d is not None and d["slot"] == 7
+    assert d["where"] in ("txn_payload", "account")
+    assert d["txn"] == 1
+
+
+def test_divergent_bank_hash_detected(tmp_path):
+    fa, fb = io.BytesIO(), io.BytesIO()
+    for fp, bh in ((fa, b"\xBB" * 32), (fb, b"\xCC" * 32)):
+        w = CapWriter(fp)
+        w.slot(9, b"\xAA" * 32)
+        w.bank(bh)
+        w.fini()
+    fa.seek(0), fb.seek(0)
+    d = diff(fa, fb)
+    assert d["where"] == "bank_hash" and d["slot"] == 9
+    # CLI round-trip: exit 1 + divergence line on stdout
+    pa, pb = tmp_path / "a.cap", tmp_path / "b.cap"
+    pa.write_bytes(fa.getvalue())
+    pb.write_bytes(fb.getvalue())
+    assert solcap_main(["diff", str(pa), str(pb)]) == 1
+    assert solcap_main(["dump", str(pa)]) == 0
+
+
+def test_v0_alut_txn_captures_looked_up_accounts():
+    """A v0 transfer whose destination exists only via a lookup table:
+    the capture must include the resolved key's pre/post state."""
+    from firedancer_tpu.protocol.txn import build_message as bm
+    from firedancer_tpu.svm.alut import (
+        ALUT_PROGRAM_ID, derive_table_address, ix_create, ix_extend,
+    )
+
+    funk = Funk()
+    funk.rec_write(None, k(1), Account(lamports=1 << 30))
+    funk.txn_prepare(None, "blk")
+    ex = TxnExecutor(AccDb(funk))
+    ex.slot = 100
+
+    def vtxn(extra, instrs, **kw):
+        msg = bm([k(1)], extra, b"\x11" * 32, instrs, **kw)
+        return build_txn([bytes(64)], msg)
+
+    table, bump = derive_table_address(k(1), 90)
+    assert ex.execute("blk", vtxn(
+        [table, ALUT_PROGRAM_ID],
+        [(2, bytes([1, 0]), ix_create(90, bump))],
+        n_ro_unsigned=1)).status == OK
+    looked_up = k(0x42)
+    assert ex.execute("blk", vtxn(
+        [table, ALUT_PROGRAM_ID],
+        [(2, bytes([1, 0]), ix_extend([looked_up]))],
+        n_ro_unsigned=1)).status == OK
+
+    fp = io.BytesIO()
+    w = CapWriter(fp)
+    cex = CapturingExecutor(ex, w)
+    w.slot(11, bytes(32))
+    t = vtxn([SYSTEM_PROGRAM_ID],
+             [(1, bytes([0, 2]), struct.pack("<IQ", SYS_TRANSFER, 999))],
+             n_ro_unsigned=1, version=0, aluts=[(table, bytes([0]), b"")])
+    assert cex.execute("blk", t).status == OK
+    w.bank(bytes(32))
+    w.fini()
+    fp.seek(0)
+    trec = [v for kd, v in read_records(fp) if kd == "txn"][0]
+    assert trec["pre"][looked_up] is None
+    assert trec["post"][looked_up]["lamports"] == 999
+
+
+def test_pre_state_divergence_reported_at_first_txn():
+    """A divergence that entered OUTSIDE txn execution (differing
+    snapshot state) and is overwritten identically by execution must
+    still be pinned to the first txn that saw it, phase=pre."""
+    caps = []
+    for initial in (1_000_000, 1_000_001):
+        funk = Funk()
+        funk.rec_write(None, k(1), Account(lamports=1_000_000))
+        funk.rec_write(None, k(2), Account(lamports=initial))
+        funk.txn_prepare(None, "blk")
+        fp = io.BytesIO()
+        w = CapWriter(fp)
+        cex = CapturingExecutor(TxnExecutor(AccDb(funk)), w)
+        w.slot(5, bytes(32))
+        # CreateAccount-less absolute overwrite isn't available via
+        # transfer, so make post identical by hand: drain k2 fully into
+        # k1 then refund a fixed amount — post lamports equal either way
+        # is NOT achievable with transfers alone; instead just touch k2
+        # read-only via a 0-lamport transfer INTO it, leaving pre
+        # divergent and post divergent too — the point is the report
+        # must carry phase="pre" for the earliest divergent view.
+        cex.execute("blk", transfer_txn(k(1), k(2), 0))
+        w.bank(bytes(32))
+        w.fini()
+        fp.seek(0)
+        caps.append(fp)
+    d = diff(*caps)
+    assert d["where"] == "account" and d["phase"] == "pre"
+    assert d["txn"] == 0 and d["pubkey"] == k(2).hex()
+
+
+def test_cli_missing_args_usage():
+    assert solcap_main(["diff", "only_one.cap"]) == 2
+    assert solcap_main(["dump"]) == 2
+    assert solcap_main([]) == 2
+
+
+def test_failed_txn_captured_with_rollback_state():
+    """A failing instruction rolls state back; the capture must show
+    post == pre except the fee debit (that is the differential signal
+    the reference's solcap exists to catch)."""
+    fp = io.BytesIO()
+    funk = Funk()
+    funk.rec_write(None, k(1), Account(lamports=10_000))
+    funk.txn_prepare(None, "blk")
+    w = CapWriter(fp)
+    cex = CapturingExecutor(TxnExecutor(AccDb(funk)), w)
+    w.slot(3, bytes(32))
+    r = cex.execute("blk", transfer_txn(k(1), k(2), 50_000))  # overdraft
+    w.bank(bytes(32))
+    w.fini()
+    assert r.status != OK
+    fp.seek(0)
+    trec = [v for kd, v in read_records(fp) if kd == "txn"][0]
+    assert trec["status"] != OK
+    assert trec["post"][k(2)] is None
+    assert trec["post"][k(1)]["lamports"] == 10_000 - trec["fee"]
