@@ -94,6 +94,10 @@ class CapWriter:
 
     def txn(self, index: int, payload: bytes, status: str, fee: int,
             pre: dict, post: dict):
+        """pre/post: pubkey -> ALREADY-ENCODED account record bytes
+        (`_enc_acct`). Callers must encode at snapshot time — holding
+        live accdb peek borrows across a write window would record
+        post-state as pre-state if accdb ever mutated in place."""
         body = struct.pack("<BI", _K_TXN, index)
         body += hashlib.sha256(payload).digest()
         sb = status.encode()
@@ -101,8 +105,8 @@ class CapWriter:
         keys = sorted(set(pre) | set(post))
         body += struct.pack("<H", len(keys))
         for k in keys:
-            body += _enc_acct(k, pre.get(k))
-            body += _enc_acct(k, post.get(k))
+            body += pre.get(k) or _enc_acct(k, None)
+            body += post.get(k) or _enc_acct(k, None)
         self._w.frame(body)
 
     def bank(self, bank_hash: bytes):
@@ -180,9 +184,12 @@ class CapturingExecutor:
 
     def execute(self, xid, payload: bytes):
         keys = self._keys(xid, payload)
-        pre = {k: self.ex.db.peek(xid, k) for k in keys}
+        # encode AT snapshot time: peek hands out borrows that must not
+        # be held across the execute() write window (svm/accdb.py
+        # borrow contract)
+        pre = {k: _enc_acct(k, self.ex.db.peek(xid, k)) for k in keys}
         res = self.ex.execute(xid, payload)
-        post = {k: self.ex.db.peek(xid, k) for k in keys}
+        post = {k: _enc_acct(k, self.ex.db.peek(xid, k)) for k in keys}
         self.writer.txn(self._idx, payload, res.status, res.fee,
                         pre, post)
         self._idx += 1

@@ -16,12 +16,15 @@ RFC-TRUE layers (interoperable as specified):
   * frames: PADDING PING ACK CRYPTO STREAM(all forms) MAX_* (ignored)
     HANDSHAKE_DONE CONNECTION_CLOSE
 
-DOCUMENTED DIVERGENCE (the interop blocker, tracked): the TLS 1.3
-handshake is replaced by a 2-flight random exchange inside CRYPTO
-frames — client sends 32 random bytes, server answers 32 — and the
-1-RTT keys derive from HKDF(initial_secret, client_random ||
-server_random, "fdtpu 1rtt"). Every OTHER byte on the wire follows the
-RFCs, so swapping in real TLS later changes only `_derive_1rtt`.
+The handshake is REAL TLS 1.3 (waltz/tls.py — RFC 8446 subset:
+x25519 + ed25519 CertificateVerify + AES-128-GCM, the same profile the
+reference's fd_tls implements): ClientHello rides the Initial level,
+the server flight (SH / EE / Certificate / CertificateVerify /
+Finished) spans Initial + Handshake packets, the client Finished
+returns at the Handshake level, and the 1-RTT packet keys are the TLS
+application traffic secrets run through the RFC 9001 §5.1 labels.
+Handshake packets use their own packet-number space per RFC 9000
+§12.3. (r3 shipped a documented stub here; r4 removed it.)
 
 Stream discipline (matches the reference's TPU contract): each
 client-initiated UNIDIRECTIONAL stream carries exactly one transaction;
@@ -30,13 +33,13 @@ hands the payload to the tile (fd_tpu_reasm semantics).
 """
 from __future__ import annotations
 
-import hashlib
-import hmac as hmac_mod
 import os
 import struct
 
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from . import tls as fdtls
 
 # RFC 9001 §5.2 (QUIC v1)
 INITIAL_SALT = bytes.fromhex("38762cf7f55934b34d179ae6a4c80cadccbb7f0a")
@@ -62,6 +65,12 @@ MAX_DATAGRAM = 1350
 
 class QuicError(ValueError):
     pass
+
+
+class _CallbackError(Exception):
+    """Carrier lifting an application on_txn exception OVER the
+    hostile-datagram catch in on_datagram — a bug in the consumer must
+    surface, not be miscounted as a bad packet."""
 
 
 # ---------------------------------------------------------------------------
@@ -94,30 +103,15 @@ def dec_varint(b: bytes, off: int) -> tuple[int, int]:
 
 
 # ---------------------------------------------------------------------------
-# HKDF (RFC 5869) + TLS 1.3 expand-label (RFC 8446 §7.1)
+# HKDF + TLS 1.3 expand-label — one implementation, in waltz/tls.py
+# (RFC 9001 uses the RFC 8446 KDF with an empty context)
 # ---------------------------------------------------------------------------
 
-def hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
-    return hmac_mod.new(salt, ikm, hashlib.sha256).digest()
-
-
-def hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
-    out = b""
-    t = b""
-    i = 1
-    while len(out) < length:
-        t = hmac_mod.new(prk, t + info + bytes([i]),
-                         hashlib.sha256).digest()
-        out += t
-        i += 1
-    return out[:length]
+hkdf_extract = fdtls.hkdf_extract
 
 
 def hkdf_expand_label(secret: bytes, label: bytes, length: int) -> bytes:
-    full = b"tls13 " + label
-    info = struct.pack(">H", length) + bytes([len(full)]) + full \
-        + bytes([0])
-    return hkdf_expand(secret, info, length)
+    return fdtls.hkdf_expand_label(secret, label, b"", length)
 
 
 class Keys:
@@ -145,13 +139,48 @@ def initial_keys(dcid: bytes) -> tuple[Keys, Keys, bytes]:
     return Keys(c), Keys(s), initial
 
 
-def derive_1rtt(initial_secret: bytes, client_rand: bytes,
-                server_rand: bytes) -> tuple[Keys, Keys]:
-    """The stubbed-TLS 1-RTT schedule (see module docstring)."""
-    prk = hkdf_extract(initial_secret, client_rand + server_rand)
-    c = hkdf_expand_label(prk, b"fdtpu c 1rtt", 32)
-    s = hkdf_expand_label(prk, b"fdtpu s 1rtt", 32)
-    return Keys(c), Keys(s)
+class CryptoBuf:
+    """Per-encryption-level in-order reassembly of the CRYPTO stream
+    (RFC 9000 §19.6: offsets, arbitrary re-fragmentation, overlapping
+    duplication — retransmits may re-slice already-consumed ranges)."""
+
+    MAX = 1 << 16
+
+    def __init__(self):
+        self.chunks: dict[int, bytes] = {}
+        self.next = 0
+
+    def add(self, offset: int, data: bytes):
+        if offset + len(data) > self.MAX:
+            raise QuicError("crypto stream exceeds cap")
+        if offset < self.next:                 # trim consumed prefix
+            data = data[self.next - offset:]
+            offset = self.next
+        if not data:
+            return
+        have = self.chunks.get(offset)
+        if have is None or len(data) > len(have):
+            self.chunks[offset] = data
+
+    def drain(self) -> bytes:
+        out = b""
+        while True:
+            c = self.chunks.pop(self.next, None)
+            if c is None:
+                # an overlapping chunk may start before `next` yet
+                # extend past it
+                for off in sorted(self.chunks):
+                    if off > self.next:
+                        break
+                    c2 = self.chunks.pop(off)
+                    if off + len(c2) > self.next:
+                        c = c2[self.next - off:]
+                        break
+                if c is None:
+                    break
+            out += c
+            self.next += len(c)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -221,6 +250,26 @@ def seal_short(keys: Keys, dcid: bytes, pn: int, payload: bytes) -> bytes:
     for i in range(len(pn_bytes)):
         pkt[pn_off + i] ^= mask[1 + i]
     return bytes(pkt)
+
+
+def long_header_len(pkt: bytes) -> int:
+    """Length of the first coalesced long-header packet WITHOUT
+    decrypting (the long header through the length field is cleartext)
+    — used to skip packets whose keys have been discarded (RFC 9001
+    §4.9.1)."""
+    off = 5
+    dlen = pkt[off]
+    off += 1 + dlen
+    slen = pkt[off]
+    off += 1 + slen
+    if (pkt[0] >> 4) & 0x03 == PT_INITIAL:
+        tok_len, off = dec_varint(pkt, off)
+        off += tok_len
+    length, off = dec_varint(pkt, off)
+    end = off + length
+    if end > len(pkt):
+        raise QuicError("truncated packet")
+    return end
 
 
 def open_long(keys: Keys, pkt: bytes) -> tuple[int, bytes, bytes, bytes,
@@ -430,21 +479,34 @@ class _Stream:
 
 class _Conn:
     def __init__(self, scid: bytes, ckeys: Keys, skeys: Keys,
-                 initial_secret: bytes, peer: tuple):
+                 initial_secret: bytes, peer: tuple,
+                 tls: "fdtls.TlsServer"):
         self.scid = scid                      # our CID (client's dcid)
         self.ckeys = ckeys                    # client Initial keys
         self.skeys = skeys                    # server Initial keys
         self.initial_secret = initial_secret
         self.peer = peer
+        self.tls = tls
+        self.cbuf = {fdtls.EL_INITIAL: CryptoBuf(),
+                     fdtls.EL_HANDSHAKE: CryptoBuf()}
+        self.chs: Keys | None = None          # client Handshake keys
+        self.shs: Keys | None = None          # server Handshake keys
         self.c1rtt: Keys | None = None
         self.s1rtt: Keys | None = None
         self.client_cid = b""
         self.streams: dict[int, _Stream] = {}
-        self.tx_pn = 0
+        self.tx_pn = 0                        # 1-RTT pn space
+        self.tx_pn_i = 0                      # Initial pn space
+        self.tx_pn_h = 0                      # Handshake pn space
         self.rx_largest = -1
         self.rx_window = 0               # bitmap of the last 64 pns
         self.done_streams = 0
         self.hs_response: bytes | None = None    # for Initial retransmit
+        self.done_sent = False
+        # RFC 9001 §4.9.1: Initial keys are dead once a packet protected
+        # with Handshake keys is processed; forged Initials (their keys
+        # derive from the public dcid) must not reach the TLS machine
+        self.initial_done = False
 
     def pn_fresh(self, pn: int) -> bool:
         """Anti-replay window (the RFC 9001 §9.2 duty): accept each
@@ -468,18 +530,29 @@ class _Conn:
 
 class QuicServer:
     """Single-socket TPU-ingest server: datagram in -> txn payloads out
-    (the fd_quic_tile ingest contract)."""
+    (the fd_quic_tile ingest contract). `identity_seed` is the ed25519
+    key behind the TLS certificate (ephemeral when omitted)."""
 
     def __init__(self, sock, on_txn, cid_len: int = 8,
-                 max_streams: int = 4096):
+                 max_streams: int = 4096,
+                 identity_seed: bytes | None = None):
         self.sock = sock
         self.on_txn = on_txn
         self.cid_len = cid_len
         self.max_streams = max_streams
+        self.identity_seed = identity_seed or os.urandom(32)
+        self._cert_cache: bytes | None = None
         self.conns: dict[bytes, _Conn] = {}
         self.metrics = {"pkts": 0, "bad_pkts": 0, "conns": 0,
                         "txns": 0, "streams": 0, "closed": 0,
                         "replayed": 0}
+
+    def _cert(self) -> bytes:
+        """The identity certificate, built once (a DER build + host
+        ed25519 sign per connection would be handshake-flood bait)."""
+        if self._cert_cache is None:
+            self._cert_cache = fdtls.make_cert(self.identity_seed)
+        return self._cert_cache
 
     # -- datagram ingest ----------------------------------------------------
 
@@ -489,52 +562,132 @@ class QuicServer:
             if data[0] & 0x80:
                 return self._on_long(data, addr)
             return self._on_short(data, addr)
-        except (QuicError, IndexError, struct.error):
+        except _CallbackError as e:
+            raise e.__cause__ from None        # consumer bug: surface
+        except (ValueError, IndexError, struct.error):
+            # ValueError covers QuicError + anything a hostile
+            # handshake can raise out of the TLS layer: one bad
+            # datagram must never kill the ingest tile
             self.metrics["bad_pkts"] += 1
             return 0
 
     def _on_long(self, data: bytes, addr) -> int:
+        """Handle a datagram of one or more coalesced long-header
+        packets (RFC 9000 §12.2 — standard clients coalesce
+        Initial(ACK) + Handshake(Finished) in one datagram)."""
         # peek dcid for key derivation (header is cleartext up to pn)
         dlen = data[5]
         dcid = data[6:6 + dlen]
+        ptype_peek = (data[0] >> 4) & 0x03
         conn = self.conns.get(dcid)
-        if conn is None:
-            ck, sk, isec = initial_keys(dcid)
-            ptype, _, scid, payload, _ = open_long(ck, data)
-            if ptype != PT_INITIAL:
+        created = conn is None
+        if created:
+            if ptype_peek != PT_INITIAL:
                 raise QuicError("first packet must be Initial")
+            ck, sk, isec = initial_keys(dcid)
             if len(self.conns) >= self.max_streams:
                 self.conns.pop(next(iter(self.conns)))
-            conn = _Conn(dcid, ck, sk, isec, addr)
-            conn.client_cid = scid
+            conn = _Conn(dcid, ck, sk, isec, addr,
+                         fdtls.TlsServer(self.identity_seed,
+                                         cert=self._cert()))
             self.conns[dcid] = conn
             self.metrics["conns"] += 1
-        else:
-            ptype, _, scid, payload, _ = open_long(conn.ckeys, data)
         handled = 0
-        for ft, f in parse_frames(payload):
-            if ft != FRAME_CRYPTO:
-                continue
-            if conn.c1rtt is None:
-                client_rand = f["data"][:32]
-                server_rand = os.urandom(32)
-                conn.c1rtt, conn.s1rtt = derive_1rtt(
-                    conn.initial_secret, client_rand, server_rand)
-                resp = (enc_ack_frame(0)
-                        + enc_crypto_frame(0, server_rand)
-                        + bytes([FRAME_HANDSHAKE_DONE]))
-                conn.hs_response = seal_long(
-                    conn.skeys, PT_INITIAL, conn.client_cid,
-                    conn.scid, conn.tx_pn, resp)
-                conn.tx_pn += 1
-                self.sock.sendto(conn.hs_response, addr)
-                handled += 1
-            elif conn.hs_response is not None:
-                # retransmitted Initial: the client lost our response
-                # — resend it (loss tolerance, RFC 9002 spirit)
-                self.sock.sendto(conn.hs_response, addr)
-                handled += 1
+        off = 0
+        opened = 0
+        initial_seen = False
+        while off < len(data) and data[off] & 0x80:
+            chunk = data[off:]
+            ptype_peek = (chunk[0] >> 4) & 0x03
+            if ptype_peek == PT_INITIAL:
+                if conn.initial_done:          # discarded keys: skip
+                    off += long_header_len(chunk)
+                    continue
+                keys, level = conn.ckeys, fdtls.EL_INITIAL
+            elif conn.chs is not None:
+                keys, level = conn.chs, fdtls.EL_HANDSHAKE
+            else:
+                raise QuicError("no handshake keys yet")
+            try:
+                ptype, _, scid, payload, consumed = open_long(keys,
+                                                              chunk)
+            except QuicError:
+                if opened:
+                    break          # trailing garbage after good pkts
+                if created:        # never-authenticated conn: drop it
+                    self.conns.pop(dcid, None)
+                raise
+            opened += 1
+            off += consumed
+            if ptype == PT_INITIAL:
+                conn.client_cid = scid
+                initial_seen = True
+            else:
+                conn.initial_done = True
+            fed = b""
+            for ft, f in parse_frames(payload):
+                if ft != FRAME_CRYPTO:
+                    continue
+                conn.cbuf[level].add(f["offset"], f["data"])
+                fed += conn.cbuf[level].drain()
+            if fed:
+                try:
+                    conn.tls.on_crypto(level, fed)
+                except fdtls.TlsError:
+                    self.conns.pop(dcid, None)
+                    raise QuicError("tls failure") from None
+                handled += self._pump_tls(conn, addr)
+                initial_seen = False
+        if not handled and initial_seen \
+                and conn.hs_response is not None:
+            # retransmitted Initial with no fresh CRYPTO: the client
+            # lost our flight — resend it (loss tolerance, RFC 9002)
+            self.sock.sendto(conn.hs_response, addr)
+            handled += 1
         return handled
+
+    def _pump_tls(self, conn: _Conn, addr) -> int:
+        """Flush TLS emissions as sealed packets; install keys as the
+        schedule advances. Server flight is coalesced into one
+        datagram (RFC 9001 §4.1 pattern)."""
+        out = b""
+        while conn.tls.emit:
+            lvl, hs_data = conn.tls.emit.pop(0)
+            if lvl == fdtls.EL_INITIAL:
+                payload = enc_ack_frame(0) + enc_crypto_frame(0, hs_data)
+                out += seal_long(conn.skeys, PT_INITIAL,
+                                 conn.client_cid, conn.scid,
+                                 conn.tx_pn_i, payload)
+                conn.tx_pn_i += 1
+                # SH emitted -> handshake secrets exist
+                conn.chs = Keys(conn.tls.sched.c_hs)
+                conn.shs = Keys(conn.tls.sched.s_hs)
+            else:
+                off = 0
+                while off < len(hs_data):
+                    chunk = hs_data[off:off + 1100]
+                    payload = enc_crypto_frame(off, chunk)
+                    out += seal_long(conn.shs, PT_HANDSHAKE,
+                                     conn.client_cid, conn.scid,
+                                     conn.tx_pn_h, payload)
+                    conn.tx_pn_h += 1
+                    off += len(chunk)
+                # server Finished emitted -> application secrets exist
+                conn.c1rtt = Keys(conn.tls.sched.c_ap)
+                conn.s1rtt = Keys(conn.tls.sched.s_ap)
+        sent = 0
+        if out:
+            conn.hs_response = out
+            self.sock.sendto(out, addr)
+            sent = 1
+        if conn.tls.complete and not conn.done_sent:
+            done = seal_short(conn.s1rtt, conn.client_cid, conn.tx_pn,
+                              bytes([FRAME_HANDSHAKE_DONE]))
+            conn.tx_pn += 1
+            self.sock.sendto(done, addr)
+            conn.done_sent = True
+            sent += 1
+        return sent
 
     def _on_short(self, data: bytes, addr) -> int:
         dcid = data[1:1 + self.cid_len]
@@ -560,7 +713,10 @@ class QuicServer:
                 txn = st.complete()
                 if txn is not None:
                     self.metrics["txns"] += 1
-                    self.on_txn(txn)
+                    try:
+                        self.on_txn(txn)
+                    except Exception as e:
+                        raise _CallbackError() from e
                     handled += 1
                     del conn.streams[f["stream"]]
                     conn.done_streams += 1
@@ -582,37 +738,97 @@ class QuicServer:
 # ---------------------------------------------------------------------------
 
 class QuicClient:
-    def __init__(self, sock, server_addr, cid_len: int = 8):
+    def __init__(self, sock, server_addr, cid_len: int = 8,
+                 expect_pub: bytes | None = None):
         self.sock = sock
         self.addr = server_addr
         self.scid = os.urandom(cid_len)       # our CID
         self.dcid = os.urandom(cid_len)       # server's CID for us
         self.ckeys, self.skeys, self.initial_secret = \
             initial_keys(self.dcid)
+        self.tls = fdtls.TlsClient(expect_pub=expect_pub)
+        self.cbuf = {fdtls.EL_INITIAL: CryptoBuf(),
+                     fdtls.EL_HANDSHAKE: CryptoBuf()}
+        self.chs: Keys | None = None
+        self.shs: Keys | None = None
         self.c1rtt: Keys | None = None
         self.s1rtt: Keys | None = None
         self.tx_pn = 0
+        self.tx_pn_i = 0
+        self.tx_pn_h = 0
         self.rx_largest = -1
         self.next_stream = 2                  # client-initiated uni: 2,6,..
+        self.server_pub: bytes | None = None
 
-    def handshake(self, timeout: float = 5.0):
-        client_rand = os.urandom(32)
-        hello = enc_crypto_frame(0, client_rand)
+    def handshake(self, timeout: float = 5.0, retries: int = 3):
+        self.tls.start()
+        _, ch = self.tls.emit.pop(0)
+        hello = enc_crypto_frame(0, ch)
         hello += bytes(max(0, 1162 - len(hello)))     # Initial padding
         pkt = seal_long(self.ckeys, PT_INITIAL, self.dcid, self.scid,
-                        self.tx_pn, hello)
-        self.tx_pn += 1
+                        self.tx_pn_i, hello)
+        self.tx_pn_i += 1
         self.sock.settimeout(timeout)
-        self.sock.sendto(pkt, self.addr)
-        data, _ = self.sock.recvfrom(2048)
-        ptype, _, _, payload, _ = open_long(self.skeys, data)
-        for ft, f in parse_frames(payload):
-            if ft == FRAME_CRYPTO:
-                server_rand = f["data"][:32]
-                self.c1rtt, self.s1rtt = derive_1rtt(
-                    self.initial_secret, client_rand, server_rand)
-        if self.c1rtt is None:
-            raise QuicError("handshake failed: no server CRYPTO")
+        for _ in range(retries):
+            self.sock.sendto(pkt, self.addr)
+            try:
+                while not self.tls.complete:
+                    data, _ = self.sock.recvfrom(4096)
+                    try:
+                        self._on_hs_datagram(data)
+                    except fdtls.TlsError:
+                        raise              # authentication failure
+                    except (ValueError, IndexError, struct.error):
+                        continue           # stray/garbage datagram
+                break
+            except TimeoutError:
+                continue
+        if not self.tls.complete:
+            raise QuicError("handshake failed")
+        self.server_pub = self.tls.server_pub
+
+    def _on_hs_datagram(self, data: bytes):
+        """Parse coalesced long-header packets, feed TLS, flush the
+        client Finished when it appears."""
+        off = 0
+        while off < len(data) and off + 1 < len(data) \
+                and data[off] & 0x80:
+            chunk = data[off:]
+            ptype_peek = (chunk[0] >> 4) & 0x03
+            if ptype_peek == PT_INITIAL:
+                if self.shs is not None:
+                    # Initial keys discarded (RFC 9001 §4.9.1): the
+                    # keys are public-derivable, so late/forged
+                    # Initials must not reach the TLS machine
+                    off += long_header_len(chunk)
+                    continue
+                keys, level = self.skeys, fdtls.EL_INITIAL
+            else:
+                if self.shs is None:
+                    break
+                keys, level = self.shs, fdtls.EL_HANDSHAKE
+            ptype, _, _, payload, consumed = open_long(keys, chunk)
+            off += consumed
+            fed = b""
+            for ft, f in parse_frames(payload):
+                if ft == FRAME_CRYPTO:
+                    self.cbuf[level].add(f["offset"], f["data"])
+                    fed += self.cbuf[level].drain()
+            if fed:
+                self.tls.on_crypto(level, fed)
+            if self.tls.sched.s_hs is not None and self.shs is None:
+                self.chs = Keys(self.tls.sched.c_hs)
+                self.shs = Keys(self.tls.sched.s_hs)
+        while self.tls.emit:
+            lvl, hs_data = self.tls.emit.pop(0)
+            pkt = seal_long(self.chs, PT_HANDSHAKE, self.dcid,
+                            self.scid, self.tx_pn_h,
+                            enc_crypto_frame(0, hs_data))
+            self.tx_pn_h += 1
+            self.sock.sendto(pkt, self.addr)
+        if self.tls.complete and self.c1rtt is None:
+            self.c1rtt = Keys(self.tls.sched.c_ap)
+            self.s1rtt = Keys(self.tls.sched.s_ap)
 
     def send_txn(self, payload: bytes):
         """One txn = one unidirectional stream with FIN (the TPU

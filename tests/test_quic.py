@@ -51,7 +51,7 @@ def test_long_packet_roundtrip():
 def test_short_packet_roundtrip():
     dcid = os.urandom(8)
     ck, sk, isec = quic.initial_keys(dcid)
-    c1, s1 = quic.derive_1rtt(isec, b"c" * 32, b"s" * 32)
+    c1 = quic.Keys(quic.hkdf_expand_label(isec, b"test c", 32))
     frame = quic.enc_stream_frame(2, 0, b"txn-bytes", True)
     pkt = quic.seal_short(c1, dcid, 7, frame)
     pn, payload = quic.open_short(c1, pkt, 8)
@@ -188,7 +188,7 @@ def test_packet_number_reconstruction():
     # round-trip through seal/open across the 16-bit boundary
     dcid = os.urandom(8)
     _, _, isec = quic.initial_keys(dcid)
-    c1, _ = quic.derive_1rtt(isec, b"c" * 32, b"s" * 32)
+    c1 = quic.Keys(quic.hkdf_expand_label(isec, b"test c", 32))
     # gaps stay under the 2-byte half-window (RFC A.3 recoverability)
     largest = -1
     for pn in (0, 1, 0xFFFF, 0x10000, 0x10001, 0x17FFF):
@@ -255,15 +255,18 @@ def test_handshake_response_retransmitted():
     cli_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     cli_sock.bind(("127.0.0.1", 0))
     client = quic.QuicClient(cli_sock, srv_sock.getsockname())
-    hello = quic.enc_crypto_frame(0, b"r" * 32) + bytes(1100)
+    client.tls.start()
+    _, ch = client.tls.emit.pop(0)
+    hello = quic.enc_crypto_frame(0, ch)
+    hello += bytes(max(0, 1162 - len(hello)))
     pkt = quic.seal_long(client.ckeys, quic.PT_INITIAL, client.dcid,
                          client.scid, 0, hello)
     server.on_datagram(pkt, cli_sock.getsockname())
     cli_sock.settimeout(5)
-    first, _ = cli_sock.recvfrom(2048)
+    first, _ = cli_sock.recvfrom(4096)
     # client "lost" it: retransmit the Initial; server resends verbatim
     server.on_datagram(pkt, cli_sock.getsockname())
-    second, _ = cli_sock.recvfrom(2048)
+    second, _ = cli_sock.recvfrom(4096)
     assert first == second
     srv_sock.close()
     cli_sock.close()
@@ -276,3 +279,262 @@ def _pump(server, sock):
         except OSError:
             return
         server.on_datagram(data, addr)
+
+
+def test_hostile_key_share_does_not_crash_server():
+    """A ClientHello carrying an all-zero (small-order) or wrong-length
+    x25519 key share must be counted bad, not raise out of
+    on_datagram (review r4: ValueError escaped the catch)."""
+    from firedancer_tpu.waltz import tls as fdtls
+    srv_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.setblocking(False)
+    server = quic.QuicServer(srv_sock, lambda t: None)
+    for evil_share in (bytes(32), b"\x01" * 7):
+        dcid, scid = os.urandom(8), os.urandom(8)
+        ck, _, _ = quic.initial_keys(dcid)
+        ch = fdtls.build_client_hello(os.urandom(32), evil_share, b"")
+        hello = quic.enc_crypto_frame(0, ch)
+        hello += bytes(max(0, 1162 - len(hello)))
+        pkt = quic.seal_long(ck, quic.PT_INITIAL, dcid, scid, 0, hello)
+        n = server.on_datagram(pkt, ("127.0.0.1", 1))
+        assert n == 0
+        assert dcid not in server.conns          # no half-open leak
+    assert server.metrics["bad_pkts"] == 2
+    srv_sock.close()
+
+
+def test_server_handles_coalesced_client_flight():
+    """Initial(ACK-ish padding) + Handshake(Finished) coalesced into
+    ONE datagram — the standard client second flight (RFC 9001 §4.1)
+    — must complete the handshake (review r4: server read only the
+    first packet)."""
+    srv_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.setblocking(False)
+    server = quic.QuicServer(srv_sock, lambda t: None)
+    cli_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    cli_sock.bind(("127.0.0.1", 0))
+    client = quic.QuicClient(cli_sock, srv_sock.getsockname())
+    client.tls.start()
+    _, ch = client.tls.emit.pop(0)
+    hello = quic.enc_crypto_frame(0, ch)
+    hello += bytes(max(0, 1162 - len(hello)))
+    pkt = quic.seal_long(client.ckeys, quic.PT_INITIAL, client.dcid,
+                         client.scid, 0, hello)
+    server.on_datagram(pkt, cli_sock.getsockname())
+    cli_sock.settimeout(5)
+    data, _ = cli_sock.recvfrom(4096)
+    client._on_hs_datagram_collect = []
+    # feed the server flight but intercept the client Finished
+    off = 0
+    while off < len(data) and data[off] & 0x80:
+        chunk = data[off:]
+        pt = (chunk[0] >> 4) & 0x03
+        keys = client.skeys if pt == quic.PT_INITIAL else client.shs
+        ptype, _, _, payload, consumed = quic.open_long(keys, chunk)
+        off += consumed
+        lvl = 0 if ptype == quic.PT_INITIAL else 1
+        for ft, f in quic.parse_frames(payload):
+            if ft == quic.FRAME_CRYPTO:
+                client.cbuf[lvl].add(f["offset"], f["data"])
+                client.tls.on_crypto(lvl, client.cbuf[lvl].drain())
+        if client.tls.sched.s_hs is not None and client.shs is None:
+            client.chs = quic.Keys(client.tls.sched.c_hs)
+            client.shs = quic.Keys(client.tls.sched.s_hs)
+    assert client.tls.complete
+    _, fin = client.tls.emit.pop(0)
+    # coalesce: Initial(PING) + Handshake(Finished) in one datagram
+    ini = quic.seal_long(client.ckeys, quic.PT_INITIAL, client.dcid,
+                         client.scid, 1, bytes([quic.FRAME_PING]))
+    hs = quic.seal_long(client.chs, quic.PT_HANDSHAKE, client.dcid,
+                        client.scid, 0, quic.enc_crypto_frame(0, fin))
+    conn = server.conns[client.dcid]
+    assert not conn.tls.complete
+    server.on_datagram(ini + hs, cli_sock.getsockname())
+    assert conn.tls.complete                    # Finished was read
+    srv_sock.close()
+    cli_sock.close()
+
+
+def test_cryptobuf_overlapping_refragmented_retransmit():
+    """RFC 9000 §19.6: a retransmit may re-slice consumed ranges; the
+    unseen tail must still be delivered (review r4: dropped)."""
+    buf = quic.CryptoBuf()
+    buf.add(0, b"a" * 50)
+    assert buf.drain() == b"a" * 50
+    buf.add(0, b"a" * 50 + b"b" * 50)           # re-fragmented [0,100)
+    assert buf.drain() == b"b" * 50
+    # overlapping duplicate entirely inside consumed range: ignored
+    buf.add(10, b"a" * 20)
+    assert buf.drain() == b""
+    # stored-chunk overlap: [110,130) buffered, then [100,140) arrives
+    buf.add(110, b"c" * 20)
+    buf.add(100, b"d" * 40)
+    assert buf.drain() == b"d" * 40
+
+
+def _handshaken_pair():
+    srv_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.setblocking(False)
+    got = []
+    server = quic.QuicServer(srv_sock, got.append)
+    cli_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    cli_sock.bind(("127.0.0.1", 0))
+    client = quic.QuicClient(cli_sock, srv_sock.getsockname())
+    import threading
+
+    def pump():
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                data, addr = srv_sock.recvfrom(4096)
+            except OSError:
+                time.sleep(0.005)
+                continue
+            server.on_datagram(data, addr)
+            if server.conns and next(
+                    iter(server.conns.values())).tls.complete:
+                return
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    client.handshake(timeout=10)
+    t.join(timeout=10)
+    return srv_sock, cli_sock, server, client, got
+
+
+def test_forged_initial_cannot_tear_down_established_conn():
+    """Initial keys derive from the public dcid; RFC 9001 §4.9.1
+    requires discarding them post-handshake. A forged Initial with
+    garbage CRYPTO must not evict the established conn (review r4)."""
+    srv_sock, cli_sock, server, client, got = _handshaken_pair()
+    conn = server.conns[client.dcid]
+    assert conn.tls.complete and conn.initial_done
+    # attacker: valid Initial protection for this dcid, junk CRYPTO
+    ck, _, _ = quic.initial_keys(client.dcid)
+    evil = quic.seal_long(ck, quic.PT_INITIAL, client.dcid,
+                          os.urandom(8), 9,
+                          quic.enc_crypto_frame(0, b"\x02" + b"\x00\x00\x04" + b"evil"))
+    server.on_datagram(evil, ("127.0.0.1", 9))
+    assert client.dcid in server.conns          # conn survived
+    # and 1-RTT txns still flow
+    client.send_txn(b"post-attack-txn")
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        try:
+            data, addr = srv_sock.recvfrom(4096)
+        except OSError:
+            time.sleep(0.005)
+            continue
+        server.on_datagram(data, addr)
+    assert got == [b"post-attack-txn"]
+    srv_sock.close()
+    cli_sock.close()
+
+
+def test_on_txn_exception_surfaces_not_swallowed():
+    """A consumer bug inside on_txn must propagate out of on_datagram,
+    not be miscounted as a hostile packet (review r4)."""
+    class Boom(ValueError):
+        pass
+
+    def bad_consumer(txn):
+        raise Boom("consumer bug")
+
+    srv_sock, cli_sock, server, client, _ = _handshaken_pair()
+    server.on_txn = bad_consumer
+    client.send_txn(b"txn")
+    deadline = time.time() + 5
+    raised = False
+    while time.time() < deadline and not raised:
+        try:
+            data, addr = srv_sock.recvfrom(4096)
+        except OSError:
+            time.sleep(0.005)
+            continue
+        try:
+            server.on_datagram(data, addr)
+        except Boom:
+            raised = True
+    assert raised
+    assert server.metrics["bad_pkts"] == 0
+    srv_sock.close()
+    cli_sock.close()
+
+
+def test_client_handshake_survives_stray_datagrams():
+    """Garbage datagrams racing the server flight must be ignored by
+    the client, not abort the handshake (review r4)."""
+    srv_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.setblocking(False)
+    server = quic.QuicServer(srv_sock, lambda t: None)
+    cli_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    cli_sock.bind(("127.0.0.1", 0))
+    client = quic.QuicClient(cli_sock, srv_sock.getsockname())
+    cli_addr = cli_sock.getsockname()
+    import threading
+
+    def pump():
+        stray = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sent_stray = False
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                data, addr = srv_sock.recvfrom(4096)
+            except OSError:
+                time.sleep(0.005)
+                continue
+            if not sent_stray:
+                # garbage beats the server flight to the client
+                stray.sendto(b"\xc0" + os.urandom(60), cli_addr)
+                stray.sendto(os.urandom(30), cli_addr)
+                sent_stray = True
+            server.on_datagram(data, addr)
+            if server.conns and next(
+                    iter(server.conns.values())).tls.complete:
+                break
+        stray.close()
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    client.handshake(timeout=10)
+    assert client.c1rtt is not None
+    srv_sock.close()
+    cli_sock.close()
+
+
+def test_server_requires_tpu_alpn():
+    """A ClientHello without the solana-tpu ALPN is refused (review
+    r4: ALPN was advertised but never enforced)."""
+    from firedancer_tpu.waltz import tls as fdtls
+    seed = os.urandom(32)
+    srv = fdtls.TlsServer(seed)
+    import struct as _s
+    # a CH built like ours but with the ALPN extension stripped
+    from firedancer_tpu.utils import x25519 as _x
+    ch = fdtls.build_client_hello(os.urandom(32),
+                                  _x.pubkey(os.urandom(32)), b"")
+    body = ch[4:]
+    # rebuild without ALPN: parse exts region and filter
+    off = 2 + 32
+    off += 1 + body[off]
+    cs_len = _s.unpack_from(">H", body, off)[0]
+    off += 2 + cs_len
+    off += 1 + body[off]
+    ext_len = _s.unpack_from(">H", body, off)[0]
+    head = body[:off]
+    exts = body[off + 2:off + 2 + ext_len]
+    keep = b""
+    eoff = 0
+    while eoff < len(exts):
+        et, ln = _s.unpack_from(">HH", exts, eoff)
+        if et != fdtls.EXT_ALPN:
+            keep += exts[eoff:eoff + 4 + ln]
+        eoff += 4 + ln
+    nb = head + _s.pack(">H", len(keep)) + keep
+    msg = bytes([fdtls.HT_CLIENT_HELLO]) + len(nb).to_bytes(3, "big") + nb
+    import pytest as _pt
+    with _pt.raises(fdtls.TlsError):
+        srv.on_crypto(fdtls.EL_INITIAL, msg)
+    assert srv.alert == "no_application_protocol"

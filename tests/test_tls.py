@@ -1,0 +1,220 @@
+"""TLS 1.3 handshake (waltz/tls.py) + X25519 (utils/x25519.py).
+
+External grounding, not just self-consistency: X25519 is pinned to the
+RFC 7748 vectors and differentially checked against the OpenSSL-backed
+`cryptography` implementation; the generated certificate must parse
+under `cryptography.x509` and its self-signature must verify under
+OpenSSL's Ed25519 — so the DER encoder, the key schedule's signing
+input, and the host ed25519 oracle are all witnessed by an independent
+stack. (Reference analog: src/waltz/tls/test_tls.c drives fd_tls
+against OpenSSL in test_tls_openssl.c.)
+"""
+import os
+
+import pytest
+
+from firedancer_tpu.utils import ed25519_ref, x25519
+from firedancer_tpu.waltz import tls
+
+
+# ---------------------------------------------------------------------------
+# x25519
+# ---------------------------------------------------------------------------
+
+def test_x25519_rfc7748_vectors():
+    out = x25519.scalarmult(
+        bytes.fromhex("a546e36bf0527c9d3b16154b82465edd"
+                      "62144c0ac1fc5a18506a2244ba449ac4"),
+        bytes.fromhex("e6db6867583030db3594c1a424b15f7c"
+                      "726624ec26b3353b10a903a6d0ab1c4c"))
+    assert out.hex() == ("c3da55379de9c6908e94ea4df28d084f"
+                         "32eccf03491c71f754b4075577a28552")
+    out = x25519.scalarmult(
+        bytes.fromhex("4b66e9d4d1b4673c5ad22691957d6af5"
+                      "c11b6421e0ea01d42ca4169e7918ba0d"),
+        bytes.fromhex("e5210f12786811d3f4b7959d0538ae2c"
+                      "31dbe7106fc03c3efc4cd549c715a493"))
+    assert out.hex() == ("95cbde9476e8907d7aade45cb4b873f8"
+                         "8b595a68799fa152e6f8f7647aac7957")
+
+
+def test_x25519_rfc7748_dh():
+    a = bytes.fromhex("77076d0a7318a57d3c16c17251b26645"
+                      "df4c2f87ebc0992ab177fba51db92c2a")
+    b = bytes.fromhex("5dab087e624a8a4b79e17f8b83800ee6"
+                      "6f3bb1292618b6fd1c2f8b27ff88e0eb")
+    assert x25519.pubkey(a).hex() == (
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a")
+    assert x25519.pubkey(b).hex() == (
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f")
+    shared = bytes.fromhex("4a5d9d5ba4ce2de1728e3bf480350f25"
+                           "e07e21c947d19e3376f09b3c1e161742")
+    assert x25519.shared(a, x25519.pubkey(b)) == shared
+    assert x25519.shared(b, x25519.pubkey(a)) == shared
+
+
+def test_x25519_differential_vs_openssl():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+    )
+    raw = serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    for _ in range(16):
+        k = os.urandom(32)
+        ours = x25519.pubkey(k)
+        theirs = X25519PrivateKey.from_private_bytes(k) \
+            .public_key().public_bytes(*raw)
+        assert ours == theirs
+
+
+def test_x25519_rejects_small_order():
+    with pytest.raises(ValueError):
+        x25519.shared(os.urandom(32), bytes(32))   # u=0 is small-order
+
+
+# ---------------------------------------------------------------------------
+# certificate
+# ---------------------------------------------------------------------------
+
+def test_cert_parses_and_verifies_under_openssl():
+    from cryptography import x509
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+    seed = os.urandom(32)
+    _, _, pub = ed25519_ref.keypair(seed)
+    der = tls.make_cert(seed)
+    cert = x509.load_der_x509_certificate(der)
+    got = cert.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+    assert got == pub
+    assert tls.cert_pubkey(der) == pub
+    # self-signature verifies under an independent ed25519
+    Ed25519PublicKey.from_public_bytes(pub).verify(
+        cert.signature, cert.tbs_certificate_bytes)
+
+
+# ---------------------------------------------------------------------------
+# handshake
+# ---------------------------------------------------------------------------
+
+def _drive(cli, srv):
+    cli.start()
+    while not (srv.complete and cli.complete):
+        progressed = False
+        while cli.emit:
+            lvl, data = cli.emit.pop(0)
+            srv.on_crypto(lvl, data)
+            progressed = True
+        while srv.emit:
+            lvl, data = srv.emit.pop(0)
+            cli.on_crypto(lvl, data)
+            progressed = True
+        assert progressed, "handshake stalled"
+
+
+def test_full_handshake_secrets_agree():
+    seed = os.urandom(32)
+    srv = tls.TlsServer(seed, quic_tp=b"\x05\x06")
+    cli = tls.TlsClient(quic_tp=b"\x07\x08")
+    _drive(cli, srv)
+    for name in ("c_hs", "s_hs", "c_ap", "s_ap", "master"):
+        assert getattr(srv.sched, name) == getattr(cli.sched, name)
+        assert getattr(srv.sched, name) is not None
+    # transport params crossed over
+    assert srv.peer_quic_tp == b"\x07\x08"
+    assert cli.peer_quic_tp == b"\x05\x06"
+    # client learned the server identity from the certificate
+    _, _, pub = ed25519_ref.keypair(seed)
+    assert cli.server_pub == pub
+
+
+def test_handshake_fragmented_delivery():
+    """CRYPTO data arriving one byte at a time still completes."""
+    seed = os.urandom(32)
+    srv = tls.TlsServer(seed)
+    cli = tls.TlsClient()
+    cli.start()
+    lvl, ch = cli.emit.pop(0)
+    for i in range(len(ch)):
+        srv.on_crypto(lvl, ch[i:i + 1])
+    while srv.emit:
+        lvl, data = srv.emit.pop(0)
+        for i in range(0, len(data), 7):
+            cli.on_crypto(lvl, data[i:i + 7])
+    while cli.emit:
+        lvl, data = cli.emit.pop(0)
+        srv.on_crypto(lvl, data)
+    assert srv.complete and cli.complete
+    assert srv.sched.c_ap == cli.sched.c_ap
+
+
+def test_client_rejects_wrong_identity():
+    seed = os.urandom(32)
+    srv = tls.TlsServer(seed)
+    cli = tls.TlsClient(expect_pub=os.urandom(32))
+    cli.start()
+    lvl, ch = cli.emit.pop(0)
+    srv.on_crypto(lvl, ch)
+    with pytest.raises(tls.TlsError, match="identity"):
+        for lvl, data in srv.emit:
+            cli.on_crypto(lvl, data)
+
+
+def test_client_rejects_forged_certificate_verify():
+    """A MITM swapping the certificate (but not re-signing) must fail
+    CertificateVerify."""
+    seed = os.urandom(32)
+    mitm_seed = os.urandom(32)
+    srv = tls.TlsServer(seed)
+    cli = tls.TlsClient()
+    cli.start()
+    lvl, ch = cli.emit.pop(0)
+    srv.on_crypto(lvl, ch)
+    (l1, sh), (l2, flight) = srv.emit
+    # splice the attacker's certificate into the server flight
+    msgs = list(tls.iter_messages(flight))
+    out = b""
+    for ht, body, raw in msgs:
+        if ht == tls.HT_CERTIFICATE:
+            out += tls.build_certificate(tls.make_cert(mitm_seed))
+        else:
+            out += raw
+    cli.on_crypto(l1, sh)
+    with pytest.raises(tls.TlsError):
+        cli.on_crypto(l2, out)
+
+
+def test_server_rejects_bad_client_finished():
+    seed = os.urandom(32)
+    srv = tls.TlsServer(seed)
+    cli = tls.TlsClient()
+    cli.start()
+    lvl, ch = cli.emit.pop(0)
+    srv.on_crypto(lvl, ch)
+    for lvl, data in srv.emit:
+        cli.on_crypto(lvl, data)
+    lvl, fin = cli.emit.pop(0)
+    bad = bytearray(fin)
+    bad[-1] ^= 1
+    with pytest.raises(tls.TlsError, match="Finished"):
+        srv.on_crypto(lvl, bytes(bad))
+    assert not srv.complete
+
+
+def test_server_rejects_no_common_cipher():
+    """A ClientHello without our suite/group is alerted, not served."""
+    seed = os.urandom(32)
+    srv = tls.TlsServer(seed)
+    # well-formed CH but offering only an RSA-era suite and no x25519
+    import struct
+    body = (struct.pack(">H", tls.LEGACY_VERSION) + os.urandom(32)
+            + bytes([0])
+            + struct.pack(">HH", 2, 0x002F)      # TLS_RSA_AES128_CBC
+            + bytes([1, 0]) + struct.pack(">H", 0))
+    msg = bytes([tls.HT_CLIENT_HELLO]) \
+        + len(body).to_bytes(3, "big") + body
+    with pytest.raises(tls.TlsError):
+        srv.on_crypto(tls.EL_INITIAL, msg)
+    assert srv.alert is not None
