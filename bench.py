@@ -123,7 +123,7 @@ def _child_bench():
     dt = time.perf_counter() - t0
 
     vps = batch * iters / dt
-    print(json.dumps({
+    out_rec = {
         "metric": "ed25519_verifies_per_sec",
         "value": round(vps, 1),
         "unit": "verifies/s/chip",
@@ -137,7 +137,41 @@ def _child_bench():
                                               -(-len(lat) * 99 // 100) - 1)]
                               * 1e3, 2),
         "compile_s": round(compile_s, 1),
-    }))
+    }
+
+    if on_tpu and os.environ.get("FDTPU_BENCH_SKIP_RLC") != "1":
+        # bulk pre-filter path: Pallas MSM RLC batch verification
+        # (cofactored semantics — ops/pallas_msm.py docstring). The
+        # hardware run doubles as the kernel's correctness gate: the
+        # all-valid batch must pass, and a forged lane must fail it.
+        try:
+            from firedancer_tpu.ops import pallas_msm as pmsm
+            zrng = np.random.default_rng(7)
+            z = jnp.asarray(zrng.integers(0, 256, (batch, 16),
+                                          dtype=np.uint8))
+            rfn = jax.jit(lambda s, p, m, l, zz:
+                          pmsm.rlc_verify_batch_tpu(s, p, m, l, zz))
+            t0 = time.perf_counter()
+            ok, pre = rfn(*args, z)
+            jax.block_until_ready((ok, pre))
+            rlc_compile_s = time.perf_counter() - t0
+            assert bool(ok) and bool(np.asarray(pre).all()), \
+                "rlc: valid batch failed"
+            bad_sig = np.array(sig)
+            bad_sig[3, :32] ^= 0xFF        # corrupt lane 3's R
+            ok2, pre2 = rfn(jnp.asarray(bad_sig), *args[1:], z)
+            assert not bool(ok2) and bool(np.asarray(pre2)[3]), \
+                "rlc: forged lane not caught by the batch equation"
+            t0 = time.perf_counter()
+            outs = [rfn(*args, z) for _ in range(iters)]
+            jax.block_until_ready(outs)
+            rdt = time.perf_counter() - t0
+            out_rec["rlc_bulk_vps"] = round(batch * iters / rdt, 1)
+            out_rec["rlc_compile_s"] = round(rlc_compile_s, 1)
+        except Exception as e:  # noqa: BLE001 — annotate, don't break
+            out_rec["rlc_error"] = f"{e!r}"[:200]
+
+    print(json.dumps(out_rec))
     sys.stdout.flush()
 
 

@@ -375,7 +375,8 @@ class BankAdapter:
     remaining out link."""
 
     METRICS = ["microblocks", "txns", "transfers", "exec_skip",
-               "exec_fail", "overruns", "rpc_port", "ws_port"]
+               "exec_fail", "overruns", "rpc_port", "ws_port",
+               "rewards_paid"]
     GAUGES = ["rpc_port", "ws_port"]
 
     def __init__(self, ctx, args):
@@ -400,6 +401,7 @@ class BankAdapter:
                                      ctx.tile_name)
         self.m = {k: 0 for k in self.METRICS}
         self.slot = 0                  # highest slot seen in microblocks
+        self._rewards_epoch = None     # lazily read from the marker
         self.fwd_payloads = bool(args.get("forward_payloads", False))
         self.slots_per_epoch = int(args.get("slots_per_epoch", 432_000))
         if self.fwd_payloads and self.poh_out is not None:
@@ -581,12 +583,37 @@ class BankAdapter:
                     frame, txn_cnt)
                 touched = set()
                 if payloads:
-                    # the Clock view executes at the microblock's slot
-                    self.executor.slot = self.slot
-                    self.executor.epoch = self.slot // self.slots_per_epoch
                     new_xid = self._next_xid
                     self._next_xid += 1
                     self.funk.txn_prepare(None, new_xid)
+                    # the Clock view executes at the microblock's slot;
+                    # sysvar accounts materialize into this fork
+                    self.executor.begin_slot(
+                        new_xid, self.slot,
+                        slots_per_epoch=self.slots_per_epoch)
+                    # epoch boundary: pay EVERY epoch crossed since the
+                    # persisted paid-through marker — covers quiet
+                    # epochs with no microblocks, and a restart from
+                    # snapshot resumes from the marker instead of
+                    # re-paying (flamenco/rewards.py)
+                    ep = self.slot // self.slots_per_epoch
+                    if ep > 0:
+                        from ..flamenco import rewards as _rw
+                        start = self._rewards_epoch
+                        if start is None:
+                            start = _rw.paid_through(self.funk, new_xid)
+                        if ep > start:
+                            import hashlib as _h
+                            for e in range(start, ep):
+                                s = _rw.distribute_epoch_rewards(
+                                    self.funk, new_xid, e, None,
+                                    self.slots_per_epoch,
+                                    _h.sha256(b"epoch-%d" % (e + 1))
+                                    .digest())
+                                self.m["rewards_paid"] += s["paid"]
+                            _rw.mark_paid_through(self.funk, new_xid,
+                                                  ep)
+                        self._rewards_epoch = ep
                     ok = fail = 0
                     try:
                         for p, t in self._wave_order(payloads, parsed,
@@ -1246,7 +1273,8 @@ class ReplayAdapter:
             out_fseqs=_single(ctx.out_fseqs, "out link", ctx.tile_name),
             genesis=genesis,
             hashes_per_tick=int(args.get("hashes_per_tick", 16)),
-            verify_poh=bool(args.get("verify_poh", True)))
+            verify_poh=bool(args.get("verify_poh", True)),
+            slots_per_epoch=int(args.get("slots_per_epoch", 432_000)))
         self.seq = 0
         self._ovr = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]

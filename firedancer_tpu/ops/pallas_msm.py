@@ -1,0 +1,376 @@
+"""Pallas TPU kernel for RLC batch verification (multi-scalar mul).
+
+This is the device port of ops/ed25519.rlc_verify_batch — the bulk
+pre-filter path (COFACTORED semantics; see that docstring and
+tests/test_rlc.py for the torsion scope analysis; the consensus verify
+tile keeps individual verification). The reference's batch entry point
+is fd_ed25519_verify_batch_single_msg (ref: src/ballet/ed25519/
+fd_ed25519_user.c:232); wiredancer's bulk offload is the tile-level
+precedent (ref: src/wiredancer/README.md:99-119).
+
+Checks   Σ_i z_i·( [S_i]B − [k_i]A_i − R_i ) == identity   as one MSM:
+
+  stage 1 (grid over TB-lane tiles):
+    decompress A_i and R_i; per-lane 16-entry tables of −A (projective)
+    and −R (precomputed form); for each of 64 4-bit windows select +
+    pair-add into a per-window per-lane contribution; then a
+    MERGE-FOLD tree reduces 64×TB points to 64 points at FULL lane
+    utilization: each step folds two windows' blocks into one full
+    block (one point-add per step instead of one per window per level
+    — the schedule that makes cross-lane reduction pay on a 128-lane
+    VPU, PERF.md "revised cost model"). Windows land packed in lanes
+    at base(j) = (TB/64)·bitrev6(j), which is EXACTLY the layout the
+    stage-2 halving tree consumes with uniform power-of-two roll
+    distances — no permutation anywhere.
+
+  stage 2 (single program):
+    sum tile blocks; fold in the fixed-base term per window
+    (W'_j = W_j + s_w[j]·B using the j=0 table row — Horner then
+    scales it by 16^j, so no doubling-free fb pass is needed); run the
+    shared Horner as 6 fold levels (roll by TB/2^l, 4·2^(l-1)
+    doublings = 252 total, shared across the WHOLE batch); identity
+    check on lane 0.
+
+Verdict semantics are identical to rlc_verify_batch: returns
+(batch_ok, lane_pre); a True batch under True lane_pre means every
+such lane verified under the cofactored equation whp.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import ed25519 as ed
+from . import fe25519 as fe
+from .pallas_ed import (
+    DEFAULT_TB,
+    _fb_tables,
+    _fb_entry,
+    _fe_spec,
+    _pad_to,
+    _row_spec,
+    _sel16,
+    _to_pre,
+    _win_spec,
+    fadd,
+    fcanon,
+    fis_zero,
+    fmul,
+    fmul_const,
+    fmul_small2,
+    fneg,
+    fpow_p58,
+    fsq,
+    fsub,
+    pt_add_full,
+    pt_add_pre,
+    pt_dbl_not,
+    pt_dbl_t,
+    pt_identity,
+    pt_madd_aff,
+)
+
+NL = fe.NLIMB
+
+
+def _bitrev6(j: int) -> int:
+    return int(f"{j:06b}"[::-1], 2)
+
+
+def _decompress_pt(y, sign, tb):
+    """RFC 8032 §5.1.3 in-kernel decompression (same math as
+    pallas_ed._verify_kernel's inline block). y: exact 255-bit digits;
+    returns (x, y, t, dec_ok) with z = 1 implied."""
+    one = pt_identity(tb)[1]
+    y2 = fsq(y)
+    u = fsub(y2, one)
+    v = fadd(fmul_const(y2, fe.D_LIMBS), one)
+    v3 = fmul(fsq(v), v)
+    v7 = fmul(fsq(v3), v)
+    x = fmul(fmul(u, v3), fpow_p58(fmul(u, v7)))
+    vx2 = fmul(v, fsq(x))
+    root_ok = fis_zero(fsub(vx2, u))
+    root_neg = fis_zero(fadd(vx2, u))
+    x = jnp.where(root_neg, fmul_const(x, fe.SQRT_M1_LIMBS), x)
+    dec_ok = root_ok | root_neg
+    xc = fcanon(x)
+    x_is_zero = jnp.all(xc == 0, axis=0, keepdims=True)
+    dec_ok = dec_ok & ~(x_is_zero & (sign == 1))
+    flip = (xc[0:1] & 1) != sign
+    x = jnp.where(flip, fneg(x), x)
+    return x, y, fmul(x, y), dec_ok
+
+
+def _neg_tables(x, y, t, tb):
+    """16-entry tables of w·(−P): projective list AND precomputed
+    list (for the pair-add's two operand roles)."""
+    one = pt_identity(tb)[1]
+    nx = fneg(x)
+    nt = fneg(t)
+    pre1 = (fsub(y, nx), fadd(y, nx), fmul_const(nt, fe.D2_LIMBS))
+    full = [pt_identity(tb), (nx, y, one, nt)]
+    for _ in range(14):
+        full.append(pt_madd_aff(full[-1], pre1))
+    id_pre = (one, one, fmul_small2(one), jnp.zeros_like(one))
+    pre = [id_pre] + [_to_pre(p) for p in full[1:]]
+    return full, pre
+
+
+def _roll_pt(p, shift):
+    return tuple(pltpu.roll(c, shift=shift, axis=1) for c in p)
+
+
+def _where_pt(m, a, b):
+    return tuple(jnp.where(m, ca, cb) for ca, cb in zip(a, b))
+
+
+def _lane_iota(tb):
+    return jax.lax.broadcasted_iota(jnp.int32, (1, tb), 1)
+
+
+def _msm_stage1_kernel(ya_ref, asign_ref, ry_ref, rsign_ref,
+                       zkw_ref, zw_ref, mask_ref,
+                       wx_ref, wy_ref, wz_ref, wt_ref, ok_ref,
+                       cx, cy, cz, ct):
+    tb = ya_ref.shape[-1]
+
+    ax, ay, at, a_ok = _decompress_pt(ya_ref[:], asign_ref[:], tb)
+    rx, ryy, rt, r_ok = _decompress_pt(ry_ref[:], rsign_ref[:], tb)
+    m = (mask_ref[:] != 0) & a_ok & r_ok
+    mi = m.astype(jnp.int32)
+
+    tab_a_full, _ = _neg_tables(ax, ay, at, tb)
+    _, tab_r_pre = _neg_tables(rx, ryy, rt, tb)
+
+    # per-window contributions -> scratch slots (window j at slot j)
+    def window(j, _):
+        wk = zkw_ref[pl.ds(j, 1), :] * mi          # (1, TB)
+        wz = zw_ref[pl.ds(j, 1), :] * mi
+        pa = _sel16(tab_a_full, wk)                # projective
+        pr = _sel16(tab_r_pre, wz)                 # precomputed
+        c = pt_add_pre(pa, pr)
+        cx[pl.ds(j, 1)] = c[0][None]
+        cy[pl.ds(j, 1)] = c[1][None]
+        cz[pl.ds(j, 1)] = c[2][None]
+        ct[pl.ds(j, 1)] = c[3][None]
+        return 0
+
+    jax.lax.fori_loop(0, 64, window, 0)
+
+    refs = (cx, cy, cz, ct)
+
+    def read(slot):
+        return tuple(r[pl.ds(slot, 1)][0] for r in refs)
+
+    def write(slot, p):
+        for r, c in zip(refs, p):
+            r[pl.ds(slot, 1)] = c[None]
+
+    # merge-fold: 6 levels; level l folds live width w -> w/2 and
+    # packs pairs of blocks, windows from the odd block landing at
+    # +w/2 (bit-reversal layout). One full-utilization point-add per
+    # merge: left/right operands assembled by roll+select.
+    iota = _lane_iota(tb)
+    w = tb
+    for lvl in range(6):
+        half = w // 2
+        first = (iota % w) < half
+        nblocks = 64 >> (lvl + 1)
+
+        def merge(mm, _, half=half, first=first, nblocks=nblocks):
+            a = read(2 * mm)
+            b = read(2 * mm + 1)
+            left = _where_pt(first, a, _roll_pt(b, half))
+            right = _where_pt(first, _roll_pt(a, -half), b)
+            write(mm, pt_add_full(left, right))
+            return 0
+
+        jax.lax.fori_loop(0, nblocks, merge, 0)
+        w = half
+
+    # slot 0 now holds all 64 windows at live width tb/64; finish with
+    # plain intra-block folds down to width 1
+    acc = read(0)
+    while w > 1:
+        acc = pt_add_full(acc, _roll_pt(acc, -(w // 2)))
+        w //= 2
+
+    wx_ref[:] = acc[0]
+    wy_ref[:] = acc[1]
+    wz_ref[:] = acc[2]
+    wt_ref[:] = acc[3]
+    ok_ref[:] = mi
+
+
+def _msm_stage2_kernel(wx_ref, wy_ref, wz_ref, wt_ref, sw_ref,
+                       fb_ymx_ref, fb_ypx_ref, fb_t2d_ref, ok_ref,
+                       *, grid_n: int, tb: int):
+    # sum tile blocks (garbage lanes stay within the loose bound — the
+    # interval analysis is data-independent)
+    acc = tuple(r[:, pl.ds(0, tb)] for r in
+                (wx_ref, wy_ref, wz_ref, wt_ref))
+    for g in range(1, grid_n):
+        blk = tuple(r[:, pl.ds(g * tb, tb)] for r in
+                    (wx_ref, wy_ref, wz_ref, wt_ref))
+        acc = pt_add_full(acc, blk)
+
+    # fixed-base fold-in: W'_j = W_j + s_w[j]·B (j=0 table row; the
+    # Horner scales it by 16^j)
+    fb = _fb_entry(fb_ymx_ref[0], fb_ypx_ref[0], fb_t2d_ref[0],
+                   sw_ref[:])
+    acc = pt_madd_aff(acc, fb)
+
+    # shared Horner: level l adds 16^(2^(l-1))·(odd part) into the
+    # even part, partners at roll distance tb/2^l
+    for lvl in range(1, 7):
+        dist = tb >> lvl
+        nd = 4 * (1 << (lvl - 1))
+        dbl = acc
+        for i in range(nd - 1):
+            dbl = pt_dbl_not(dbl)
+        dbl = pt_dbl_t(dbl)
+        acc = pt_add_full(acc, _roll_pt(dbl, -dist))
+
+    x, y, z, _ = acc
+    lane0 = _lane_iota(tb) == 0
+    x_zero = jnp.all(jnp.where(lane0, fcanon(x), 0) == 0)
+    yz_zero = jnp.all(jnp.where(lane0, fcanon(fsub(y, z)), 0) == 0)
+    ok = (x_zero & yz_zero).astype(jnp.int32)
+    ok_ref[:] = jnp.zeros((1, tb), jnp.int32) + ok
+
+
+def _scratch(tb):
+    return [pltpu.VMEM((64, NL, tb), jnp.int32) for _ in range(4)]
+
+
+@functools.partial(jax.jit, static_argnames=("tb", "interpret"))
+def msm_tpu(y_a, sign_a, r_y, r_sign, zk_w, z_w, mask, s_w_lanes,
+            tb=DEFAULT_TB, interpret=False):
+    """Stage-1 + stage-2 dispatch. All inputs lane-major (…, B) with B
+    a multiple of tb; s_w_lanes (1, tb) has s_sum's windows placed at
+    lanes (tb/64)·bitrev6(j). Returns (batch_ok (1, tb), lane_ok
+    (1, B))."""
+    b = y_a.shape[-1]
+    assert b % tb == 0 and tb >= 64, (b, tb)
+    grid_n = b // tb
+    ymx, ypx, t2d = _fb_tables()
+
+    wx, wy, wz, wt, ok = pl.pallas_call(
+        _msm_stage1_kernel,
+        grid=(grid_n,),
+        in_specs=[_fe_spec(tb), _row_spec(tb),
+                  _fe_spec(tb), _row_spec(tb),
+                  _win_spec(tb), _win_spec(tb), _row_spec(tb)],
+        out_specs=[_fe_spec(tb)] * 4 + [_row_spec(tb)],
+        out_shape=[jax.ShapeDtypeStruct((NL, b), jnp.int32)] * 4
+        + [jax.ShapeDtypeStruct((1, b), jnp.int32)],
+        scratch_shapes=_scratch(tb),
+        interpret=interpret,
+    )(y_a, sign_a, r_y, r_sign, zk_w, z_w, mask)
+
+    full_spec = [
+        pl.BlockSpec((NL, b), lambda: (0, 0), memory_space=pltpu.VMEM)
+    ] * 4
+    tab = pl.BlockSpec((1, 16, NL), lambda: (0, 0, 0),
+                       memory_space=pltpu.VMEM)
+    batch_ok = pl.pallas_call(
+        functools.partial(_msm_stage2_kernel, grid_n=grid_n, tb=tb),
+        in_specs=full_spec
+        + [pl.BlockSpec((1, tb), lambda: (0, 0),
+                        memory_space=pltpu.VMEM), tab, tab, tab],
+        out_specs=[pl.BlockSpec((1, tb), lambda: (0, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((1, tb), jnp.int32)],
+        interpret=interpret,
+    )(wx, wy, wz, wt, s_w_lanes,
+      jnp.asarray(ymx[:1]), jnp.asarray(ypx[:1]),
+      jnp.asarray(t2d[:1]))[0]
+    return batch_ok, ok
+
+
+def rlc_verify_batch_tpu(sig, pub, msg, msg_len, z_bytes,
+                         tb=DEFAULT_TB, interpret=False):
+    """Pallas equivalent of ops.ed25519.rlc_verify_batch (cofactored
+    batch semantics — see that docstring). sig (B,64), pub (B,32),
+    msg (B,L) u8, msg_len (B,) i32, z_bytes (B,16) u8 host random.
+    Returns (batch_ok scalar bool, lane_pre (B,) bool)."""
+    bsz = sig.shape[0]
+    b_pad = -(-bsz // tb) * tb
+    r_bytes = sig[:, :32]
+    s_bytes = sig[:, 32:]
+
+    s_digits, s_ok = ed.sc_from_bytes32(s_bytes)
+    host_pre = (s_ok
+                & fe.digits_lt(fe.frombytes(pub), fe.P_LIMBS)
+                & fe.digits_lt(fe.frombytes(r_bytes), fe.P_LIMBS)
+                & ~ed.is_small_order_encoding(pub)
+                & ~ed.is_small_order_encoding(r_bytes))
+
+    kmsg = jnp.concatenate([r_bytes, pub, msg], axis=-1)
+    from .pallas_sha import sha512 as sha512_pl
+    k_digits = ed.sc_reduce64(
+        sha512_pl(kmsg, msg_len + 64, interpret=interpret))
+
+    bits = fe.bytes_to_bits(z_bytes)                 # (B, 128)
+    b2l = np.zeros((128, NL), np.int32)
+    for i in range(128):
+        b2l[i, i // fe.BITS] = 1 << (i % fe.BITS)
+    z_digits = jnp.where(host_pre[:, None], bits @ jnp.asarray(b2l), 0)
+
+    zk = ed.sc_mul_mod_l(k_digits, z_digits)
+    zs = ed.sc_mul_mod_l(s_digits, z_digits)
+    s_sum = ed.sc_sum_mod_l(zs, axis=0)              # (20,)
+
+    zk_w = jnp.moveaxis(ed.sc_windows4(zk), 0, -1)   # (64, B)
+    z_w_raw = jnp.moveaxis(ed.sc_windows4(z_digits), 0, -1)
+    # z < 2^128 -> only the low 32 windows carry data; keep the padded
+    # (64, B) shape so the kernel's window loop stays uniform
+    z_w = jnp.where(jnp.arange(64)[:, None] < 32, z_w_raw, 0)
+
+    y_a = jnp.moveaxis(fe.frombytes(pub), 0, -1)
+    sign_a = (pub[:, 31] >> 7).astype(jnp.int32)[None, :]
+    r_y = jnp.moveaxis(fe.frombytes(r_bytes), 0, -1)
+    r_sign = (r_bytes[:, 31] >> 7).astype(jnp.int32)[None, :]
+    mask = host_pre.astype(jnp.int32)[None, :]
+
+    y_a = _pad_to(y_a, b_pad, axis=1)
+    sign_a = _pad_to(sign_a, b_pad, axis=1)
+    r_y = _pad_to(r_y, b_pad, axis=1)
+    r_sign = _pad_to(r_sign, b_pad, axis=1)
+    zk_w = _pad_to(zk_w, b_pad, axis=1)
+    z_w = _pad_to(z_w, b_pad, axis=1)
+    mask = _pad_to(mask, b_pad, axis=1)
+
+    # s_sum windows scattered to the packed-lane layout
+    sw64 = ed.sc_windows4(s_sum)                     # (64,)
+    stride = tb // 64
+    lanes = np.array([stride * _bitrev6(j) for j in range(64)])
+    s_w_lanes = jnp.zeros((1, tb), jnp.int32) \
+        .at[0, lanes].set(sw64.astype(jnp.int32))
+
+    batch_ok, lane_ok = msm_tpu(
+        y_a, sign_a, r_y, r_sign, zk_w, z_w, mask, s_w_lanes,
+        tb=tb, interpret=interpret)
+    return batch_ok[0, 0] == 1, lane_ok[0, :bsz] == 1
+
+
+def verify_batch_rlc_tpu(sig, pub, msg, msg_len, rng=None,
+                         tb=DEFAULT_TB, interpret=False):
+    """Cofactored-batch wrapper with individual fallback, the device
+    analog of ops.ed25519.verify_batch_rlc (same semantics note)."""
+    from .pallas_ed import verify_batch as verify_batch_pl
+    rng = rng or np.random.default_rng()
+    z = np.asarray(rng.integers(0, 256, (sig.shape[0], 16),
+                                dtype=np.uint8))
+    ok, lane_pre = rlc_verify_batch_tpu(sig, pub, msg, msg_len,
+                                        jnp.asarray(z), tb=tb,
+                                        interpret=interpret)
+    if bool(ok):
+        return np.asarray(lane_pre)
+    return np.asarray(verify_batch_pl(sig, pub, msg, msg_len, tb=tb,
+                                      interpret=interpret))

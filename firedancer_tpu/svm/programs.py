@@ -805,9 +805,11 @@ def _exec_bpf(ctx: TxnContext, ic: InstrCtx, program: Account,
         budget = max(0, ctx.cu_limit - ctx.cu_used)
     kw = {"compute_budget": budget, "heap_sz": ctx.heap_sz}
     # sysvars the VM exposes via get_*_sysvar syscalls (the reference's
-    # fd_sysvar_cache; Clock layout = the Solana 40-byte struct)
-    sysvars = {"clock": struct.pack(
-        "<QqQQq", ctx.slot, 0, ctx.epoch, ctx.epoch, 0)}
+    # fd_sysvar_cache): account bytes when the bank materialized them
+    # (svm/sysvars.py), synthesized from slot/epoch otherwise — the
+    # account view and the syscall view must agree byte-for-byte
+    from .sysvars import read_sysvar_cache
+    sysvars = read_sysvar_cache(ctx.db, ctx.xid, ctx.slot, ctx.epoch)
     if program.data[:4] == b"\x7fELF":
         from ..vm import elf
         try:
@@ -926,6 +928,22 @@ class TxnExecutor:
         self.fee_per_signature = fee_per_signature
         self.epoch = 0               # advanced by the bank at boundaries
         self.slot = 0
+
+    def begin_slot(self, xid, slot: int, epoch: int | None = None,
+                   slots_per_epoch: int = 432_000,
+                   blockhash: bytes | None = None):
+        """Slot-boundary duty (ref: fd_runtime block-prepare calling
+        the fd_sysvar_*_update family): advance the executor's clock
+        view and materialize the sysvar ACCOUNTS in this fork so
+        programs reading them as instruction accounts and via syscalls
+        see identical bytes."""
+        from .sysvars import update_sysvars
+        self.slot = slot
+        self.epoch = slot // slots_per_epoch if epoch is None else epoch
+        update_sysvars(self.db, xid, slot, self.epoch,
+                       slots_per_epoch=slots_per_epoch,
+                       blockhash=blockhash,
+                       lamports_per_sig=self.fee_per_signature)
 
     def execute(self, xid, payload: bytes) -> TxnResult:
         try:

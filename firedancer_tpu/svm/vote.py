@@ -12,7 +12,12 @@ SEMANTICS follow the reference).
 State layout (little-endian):
   node_pubkey 32 | authorized_voter 32 | authorized_withdrawer 32 |
   commission u8 | root_slot u64 (2^64-1 = none) | credits u64 |
-  last_ts u64 | vote_cnt u16 | votes: (slot u64 | conf u32)*
+  last_ts u64 | vote_cnt u16 | votes: (slot u64 | conf u32)* |
+  [optional trailer, r4:] ec_cnt u16 | (epoch u64 | credits u64 |
+  prev_credits u64)*  — the epoch-credits history Agave keeps on the
+  vote state (ref: fd_vote_program epoch_credits), consumed by the
+  epoch-rewards points calculation (flamenco/rewards.py). Absent on
+  pre-r4 blobs; from_bytes treats a missing trailer as empty history.
 """
 from __future__ import annotations
 
@@ -46,6 +51,9 @@ class VoteState:
         self.root_slot: int | None = None
         self.credits = 0
         self.last_ts = 0
+        # (epoch, cumulative credits at epoch end, cumulative at the
+        # previous epoch's end) — newest LAST, capped at 64 entries
+        self.epoch_credits: list[tuple[int, int, int]] = []
 
     # -- serialization ------------------------------------------------------
 
@@ -57,6 +65,9 @@ class VoteState:
             self.credits, self.last_ts, len(self.tower.votes))
         for v in self.tower.votes:
             out += struct.pack("<QI", v.slot, v.conf)
+        out += struct.pack("<H", len(self.epoch_credits))
+        for ep, cr, prev in self.epoch_credits:
+            out += struct.pack("<QQQ", ep, cr, prev)
         return out
 
     @classmethod
@@ -73,14 +84,37 @@ class VoteState:
             st.tower.votes.append(TowerVote(slot, conf))
             off += 12
         st.tower.root = st.root_slot
+        if off + 2 <= len(b):            # r4 epoch-credits trailer
+            (ec_cnt,) = struct.unpack_from("<H", b, off)
+            off += 2
+            for _ in range(ec_cnt):
+                ep, cr, prev = struct.unpack_from("<QQQ", b, off)
+                st.epoch_credits.append((ep, cr, prev))
+                off += 24
         return st
 
     # -- semantics ----------------------------------------------------------
 
-    def apply_vote(self, slots: list[int], timestamp: int = 0) -> int:
+    def _increment_credits(self, epoch: int):
+        """Agave vote_state::increment_credits: per-epoch history with
+        a 64-entry cap, cumulative + previous-cumulative per entry."""
+        if not self.epoch_credits:
+            self.epoch_credits.append((epoch, self.credits, self.credits))
+        elif self.epoch_credits[-1][0] != epoch:
+            _, cr, _ = self.epoch_credits[-1]
+            self.epoch_credits.append((epoch, cr, cr))
+            if len(self.epoch_credits) > 64:
+                self.epoch_credits.pop(0)
+        self.credits += 1
+        ep, _, prev = self.epoch_credits[-1]
+        self.epoch_credits[-1] = (ep, self.credits, prev)
+
+    def apply_vote(self, slots: list[int], timestamp: int = 0,
+                   epoch: int = 0) -> int:
         """Push new vote slots (ascending, > last voted); returns the
         number of newly-rooted slots (credits accrue per root —
-        ref: vote credits on root advance)."""
+        ref: vote credits on root advance; epoch feeds the
+        epoch-credits history the rewards calculation reads)."""
         rooted = 0
         last = self.tower.votes[-1].slot if self.tower.votes else -1
         for s in slots:
@@ -89,7 +123,7 @@ class VoteState:
             r = self.tower.vote(s)
             if r is not None:
                 self.root_slot = r
-                self.credits += 1
+                self._increment_credits(epoch)
                 rooted += 1
             last = s
         if timestamp > self.last_ts:
@@ -174,7 +208,7 @@ def exec_vote(ic) -> str:
             return ERR_MISSING_SIG
         if not ic.is_writable(0):
             return ERR_NOT_WRITABLE
-        st.apply_vote(slots, ts)
+        st.apply_vote(slots, ts, epoch=ic.ctx.epoch)
         acct.data = st.to_bytes()
         return OK
 

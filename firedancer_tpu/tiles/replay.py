@@ -36,7 +36,8 @@ from .tower import pack_block
 class ReplayCore:
     def __init__(self, out_ring=None, out_fseqs=None,
                  genesis: dict[bytes, int] | None = None,
-                 hashes_per_tick: int = 16, verify_poh: bool = True):
+                 hashes_per_tick: int = 16, verify_poh: bool = True,
+                 slots_per_epoch: int = 432_000):
         self.funk = Funk()
         self.db = AccDb(self.funk)
         for key, bal in (genesis or {}).items():
@@ -47,6 +48,10 @@ class ReplayCore:
         self.out_fseqs = out_fseqs
         self.hashes_per_tick = hashes_per_tick
         self.verify_poh = verify_poh
+        # MUST match the bank tile's setting: the epoch it derives
+        # flows into vote epoch-credits and the Clock sysvar account,
+        # which are bank-hash inputs (r4 review finding)
+        self.slots_per_epoch = slots_per_epoch
         from ..flamenco.bank_hash import BankHasher, lthash_of_root
         self.next_slot: int | None = None     # next slot to execute
         self.pending: dict[int, bytes] = {}   # completed, not yet run
@@ -190,6 +195,8 @@ class ReplayCore:
             dag.add_txn(writes, reads)
         xid = ("replay", slot)
         self.funk.txn_prepare(None, xid)
+        self.executor.begin_slot(xid, slot,
+                                 slots_per_epoch=self.slots_per_epoch)
         waves = dag.waves()
         self.metrics["waves"] += len(waves)
         for wave in waves:

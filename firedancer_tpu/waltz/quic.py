@@ -694,6 +694,10 @@ class QuicServer:
         conn = self.conns.get(dcid)
         if conn is None or conn.c1rtt is None:
             raise QuicError("no 1-RTT keys for connection")
+        if not conn.tls.complete:
+            # RFC 9001 §5.7: the server must not process 1-RTT data
+            # before the client Finished authenticates the handshake
+            raise QuicError("1-RTT before handshake completion")
         pn, payload = open_short(conn.c1rtt, data, self.cid_len,
                                  conn.rx_largest)
         if not conn.pn_fresh(pn):

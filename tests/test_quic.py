@@ -211,6 +211,18 @@ def test_replayed_datagram_rejected():
     threading.Thread(target=lambda: (time.sleep(0.05), _pump(server,
                      srv_sock)), daemon=True).start()
     client.handshake(timeout=10)
+    # pump until the client Finished lands — the 1-RTT gate
+    # (RFC 9001 §5.7) refuses stream data until then
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        conns = list(server.conns.values())
+        if conns and conns[0].tls.complete:
+            break
+        try:
+            d, a = srv_sock.recvfrom(4096)
+            server.on_datagram(d, a)
+        except OSError:
+            time.sleep(0.01)
     frame = quic.enc_stream_frame(2, 0, b"one-txn", True)
     pkt = quic.seal_short(client.c1rtt, client.dcid, client.tx_pn, frame)
     for _ in range(3):                      # replay the SAME datagram
@@ -538,3 +550,59 @@ def test_server_requires_tpu_alpn():
     with _pt.raises(fdtls.TlsError):
         srv.on_crypto(fdtls.EL_INITIAL, msg)
     assert srv.alert == "no_application_protocol"
+
+
+def test_server_rejects_1rtt_before_client_finished():
+    """RFC 9001 §5.7: stream data on a connection whose client never
+    sent Finished must be refused (review r4)."""
+    srv_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv_sock.bind(("127.0.0.1", 0))
+    srv_sock.setblocking(False)
+    got = []
+    server = quic.QuicServer(srv_sock, got.append)
+    cli_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    cli_sock.bind(("127.0.0.1", 0))
+    client = quic.QuicClient(cli_sock, srv_sock.getsockname())
+    client.tls.start()
+    _, ch = client.tls.emit.pop(0)
+    hello = quic.enc_crypto_frame(0, ch)
+    hello += bytes(max(0, 1162 - len(hello)))
+    pkt = quic.seal_long(client.ckeys, quic.PT_INITIAL, client.dcid,
+                         client.scid, 0, hello)
+    server.on_datagram(pkt, cli_sock.getsockname())
+    cli_sock.settimeout(5)
+    data, _ = cli_sock.recvfrom(4096)
+    # process the server flight BY HAND so the Finished is never sent
+    # (QuicClient._on_hs_datagram would flush it automatically)
+    off = 0
+    while off < len(data) and data[off] & 0x80:
+        chunk = data[off:]
+        pt = (chunk[0] >> 4) & 0x03
+        keys = client.skeys if pt == quic.PT_INITIAL else client.shs
+        ptype, _, _, payload, consumed = quic.open_long(keys, chunk)
+        off += consumed
+        lvl = 0 if ptype == quic.PT_INITIAL else 1
+        for ft, f in quic.parse_frames(payload):
+            if ft == quic.FRAME_CRYPTO:
+                client.cbuf[lvl].add(f["offset"], f["data"])
+                client.tls.on_crypto(lvl, client.cbuf[lvl].drain())
+        if client.tls.sched.s_hs is not None and client.shs is None:
+            client.chs = quic.Keys(client.tls.sched.c_hs)
+            client.shs = quic.Keys(client.tls.sched.s_hs)
+    assert client.tls.complete           # client side thinks it's done
+    client.tls.emit.clear()              # ...but WITHHOLD Finished
+    client.c1rtt = quic.Keys(client.tls.sched.c_ap)
+    client.s1rtt = quic.Keys(client.tls.sched.s_ap)
+    client.send_txn(b"premature")
+    deadline = time.time() + 2
+    while time.time() < deadline:
+        try:
+            d, a = srv_sock.recvfrom(4096)
+        except OSError:
+            time.sleep(0.01)
+            continue
+        server.on_datagram(d, a)
+    assert got == []                     # never ingested
+    assert server.metrics["bad_pkts"] >= 1
+    srv_sock.close()
+    cli_sock.close()

@@ -1,0 +1,95 @@
+"""Sysvar accounts: layouts, slot-boundary materialization, and the
+account-view == syscall-view invariant (ref: src/flamenco/runtime/
+sysvar/fd_sysvar_clock.c, fd_sysvar_cache.h)."""
+import struct
+
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.svm.accdb import AccDb, Account
+from firedancer_tpu.svm import sysvars as sv
+from firedancer_tpu.svm.programs import TxnExecutor
+from firedancer_tpu.utils.base58 import b58_encode_32
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    funk.txn_prepare(None, "blk")
+    db = AccDb(funk)
+    return funk, db
+
+
+def test_wellknown_addresses_roundtrip():
+    assert b58_encode_32(sv.CLOCK_ID) == \
+        "SysvarC1ock11111111111111111111111111111111"
+    assert b58_encode_32(sv.RENT_ID) == \
+        "SysvarRent111111111111111111111111111111111"
+    assert b58_encode_32(sv.SLOT_HASHES_ID) == \
+        "SysvarS1otHashes111111111111111111111111111"
+
+
+def test_layout_sizes_and_rent_pin():
+    assert len(sv.enc_clock(1, 2)) == 40
+    assert len(sv.enc_rent()) == 17
+    assert len(sv.enc_epoch_schedule(432_000)) == 33
+    # the well-known mainnet minimum for a 0-byte account
+    assert sv.rent_exempt_minimum(0) == 890_880
+
+
+def test_clock_roundtrip():
+    b = sv.enc_clock(777, 3, epoch_start_ts=-5, unix_ts=42)
+    d = sv.dec_clock(b)
+    assert d["slot"] == 777 and d["epoch"] == 3
+    assert d["epoch_start_timestamp"] == -5
+    assert d["unix_timestamp"] == 42
+    assert d["leader_schedule_epoch"] == 4
+
+
+def test_update_materializes_accounts(env):
+    funk, db = env
+    sv.update_sysvars(db, "blk", slot=10, epoch=0,
+                      blockhash=b"\xAB" * 32)
+    clock = db.peek("blk", sv.CLOCK_ID)
+    assert clock is not None
+    assert clock.owner == sv.SYSVAR_OWNER
+    assert sv.dec_clock(bytes(clock.data))["slot"] == 10
+    assert clock.lamports == sv.rent_exempt_minimum(len(clock.data))
+    sh = db.peek("blk", sv.SLOT_HASHES_ID)
+    assert sv.dec_slot_hashes(bytes(sh.data)) == [(9, b"\xAB" * 32)]
+
+
+def test_slot_hashes_accumulate_newest_first_capped(env):
+    funk, db = env
+    for s in range(1, 20):
+        sv.update_sysvars(db, "blk", slot=s, epoch=0,
+                          blockhash=bytes([s]) * 32)
+    got = sv.dec_slot_hashes(
+        bytes(db.peek("blk", sv.SLOT_HASHES_ID).data))
+    assert got[0] == (18, bytes([19]) * 32)
+    assert got[-1] == (0, bytes([1]) * 32)
+    assert len(got) == 19
+    # cap
+    entries = [(i, bytes(32)) for i in range(600)]
+    assert len(sv.dec_slot_hashes(sv.enc_slot_hashes(entries))) == 512
+
+
+def test_syscall_view_equals_account_view(env):
+    funk, db = env
+    ex = TxnExecutor(db)
+    ex.begin_slot("blk", slot=55, blockhash=b"\x01" * 32)
+    cache = sv.read_sysvar_cache(db, "blk", 0, 0)
+    clock_acct = bytes(db.peek("blk", sv.CLOCK_ID).data)
+    rent_acct = bytes(db.peek("blk", sv.RENT_ID).data)
+    assert cache["clock"] == clock_acct[:40]
+    assert cache["rent"] == rent_acct[:17]
+    assert ex.slot == 55 and ex.epoch == 0
+
+
+def test_syscall_view_falls_back_without_accounts(env):
+    funk, db = env
+    cache = sv.read_sysvar_cache(db, "blk", 9, 2)
+    assert sv.dec_clock(cache["clock"])["slot"] == 9
+    assert sv.dec_clock(cache["clock"])["epoch"] == 2
+    assert struct.unpack_from("<Q", cache["rent"], 0)[0] == \
+        sv.LAMPORTS_PER_BYTE_YEAR
