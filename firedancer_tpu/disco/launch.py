@@ -62,6 +62,18 @@ def tile_main(plan: dict, tile_name: str):
     from ..utils import log
     log.init(f"{plan['topology']}:{tile_name}")
     log.info("tile booting")
+    # publish this tile's pid + /proc starttime (the cswtch sampler
+    # validates the starttime so a stale pidfile from a dead run can't
+    # attribute a RECYCLED pid's counters to this tile; the reference
+    # gets pids from its private pid namespace)
+    pidfile = f"/dev/shm/fdtpu_{plan['topology']}.pid.{tile_name}"
+    try:
+        with open(f"/proc/{os.getpid()}/stat") as sf:
+            starttime = sf.read().rsplit(")", 1)[1].split()[19]
+        with open(pidfile, "w") as pf:
+            pf.write(f"{os.getpid()} {starttime}")
+    except OSError:
+        pidfile = None
     ctx = TileCtx(plan, tile_name)
     try:
         kind = plan["tiles"][tile_name]["kind"]
@@ -69,6 +81,11 @@ def tile_main(plan: dict, tile_name: str):
         Stem(ctx, adapter).run()
     finally:
         ctx.close()
+        if pidfile:
+            try:
+                os.unlink(pidfile)
+            except OSError:
+                pass
 
 
 def plan_path(topology_name: str) -> str:

@@ -1578,6 +1578,205 @@ class MetricAdapter:
         return {"port": self.port, "scrapes": self.scrapes}
 
 
+@register("cswtch")
+class CswtchAdapter:
+    """Context-switch sampler (ref: src/disco/cswtch/fd_cswtch_tile.c —
+    reads every tile's scheduling counters; a jump in INVOLUNTARY
+    switches means a tile lost its core). Tile pids come from the
+    per-tile pidfiles the launcher publishes; sampling reads
+    /proc/<pid>/status at the housekeeping cadence.
+
+    Metrics: aggregate voluntary/involuntary totals across the
+    topology plus the worst single-tile involuntary count."""
+
+    METRICS = ["vol", "invol", "tiles_sampled", "max_invol"]
+    GAUGES = ["vol", "invol", "tiles_sampled", "max_invol"]
+
+    def __init__(self, ctx, args):
+        self.ctx = ctx
+        self.topo = ctx.plan["topology"]
+        self.m = {k: 0 for k in self.METRICS}
+        self._last: dict[str, int] = {}
+
+    def _sample(self):
+        vol = invol = n = worst = 0
+        for tn in self.ctx.plan["tiles"]:
+            try:
+                with open(f"/dev/shm/fdtpu_{self.topo}.pid.{tn}") as f:
+                    parts = f.read().split()
+                pid = int(parts[0])
+                want_start = parts[1] if len(parts) > 1 else None
+                with open(f"/proc/{pid}/stat") as f:
+                    have_start = f.read().rsplit(")", 1)[1].split()[19]
+                if want_start is not None and have_start != want_start:
+                    continue            # recycled pid: stale pidfile
+                with open(f"/proc/{pid}/status") as f:
+                    st = f.read()
+            except (OSError, ValueError, IndexError):
+                continue
+            v = i = 0
+            for line in st.splitlines():
+                if line.startswith("voluntary_ctxt_switches"):
+                    v = int(line.split()[-1])
+                elif line.startswith("nonvoluntary_ctxt_switches"):
+                    i = int(line.split()[-1])
+            vol += v
+            invol += i
+            worst = max(worst, i)
+            n += 1
+            prev = self._last.get(tn, i)
+            if i - prev > 1000:
+                from ..utils import log
+                log.warn(f"cswtch: tile {tn} took {i - prev} "
+                         f"involuntary switches since last sample")
+            self._last[tn] = i
+        self.m.update(vol=vol, invol=invol, tiles_sampled=n,
+                      max_invol=worst)
+
+    def housekeeping(self):
+        self._sample()
+
+    def poll_once(self) -> int:
+        return 0
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("ipecho")
+class IpechoAdapter:
+    """Shred-version echo service (ref: src/discof/ipecho/ — a booting
+    node connects to an entrypoint to learn its OWN public address and
+    the cluster's shred version before joining gossip). TCP server on
+    a daemon thread; wire format: magic u32 | shred_version u16 |
+    observed peer ip 4B | observed peer port u16."""
+
+    METRICS = ["port", "queries"]
+    GAUGES = ["port"]
+    WIRE_MAGIC = 0xFD19E040
+
+    def __init__(self, ctx, args):
+        import socket
+        import threading
+        self.ctx = ctx
+        self.shred_version = int(args.get("shred_version", 0))
+        self.queries = 0
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((args.get("bind_addr", "127.0.0.1"),
+                        int(args.get("port", 0))))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self._halt = False
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        import socket
+        import struct as st
+        while not self._halt:
+            try:
+                conn, peer = self.sock.accept()
+            except OSError:
+                return
+            try:
+                ip = socket.inet_aton(peer[0])
+                conn.sendall(st.pack("<IH4sH", self.WIRE_MAGIC,
+                                     self.shred_version, ip, peer[1]))
+                self.queries += 1
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def poll_once(self) -> int:
+        return 0
+
+    def on_halt(self):
+        self._halt = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def metrics_items(self):
+        return {"port": self.port, "queries": self.queries}
+
+
+def ipecho_query(addr: tuple, timeout: float = 5.0):
+    """Client side: -> (shred_version, my_ip_str, my_port)."""
+    import socket
+    import struct as st
+    data = b""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        while len(data) < 12:            # TCP may split the reply
+            chunk = s.recv(12 - len(data))
+            if not chunk:
+                raise ValueError("short ipecho reply")
+            data += chunk
+    magic, sv, ip, port = st.unpack("<IH4sH", data)
+    if magic != IpechoAdapter.WIRE_MAGIC:
+        raise ValueError("bad ipecho magic")
+    return sv, socket.inet_ntoa(ip), port
+
+
+@register("pcap")
+class PcapAdapter:
+    """pcap replay tile (ref: src/disco/pcap/fd_pcap_replay_tile.c):
+    re-drives captured packet payloads into an out link, preserving
+    either full pacing (realtime=true scales inter-packet gaps) or
+    flat-out replay. args: path, realtime, loop (replay count)."""
+
+    METRICS = ["tx", "loops", "done", "backpressure"]
+    GAUGES = ["done"]
+
+    def __init__(self, ctx, args):
+        from ..utils.pcap import read_pcap
+        self.ctx = ctx
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.out_fseqs = _single(ctx.out_fseqs, "out link",
+                                 ctx.tile_name)
+        self.path = args["path"]
+        self.realtime = bool(args.get("realtime", False))
+        self.loops_want = int(args.get("loop", 1))
+        self.m = {k: 0 for k in self.METRICS}
+        self.pkts = []
+        with open(self.path, "rb") as f:
+            self.pkts = list(read_pcap(f))
+        self._idx = 0
+        self._t0 = None
+        self._ts0 = self.pkts[0][0] if self.pkts else 0
+
+    def poll_once(self) -> int:
+        import time as _t
+        if self.m["done"]:
+            return 0
+        if self._idx >= len(self.pkts):
+            self.m["loops"] += 1
+            if self.m["loops"] >= self.loops_want or not self.pkts:
+                self.m["done"] = 1       # empty capture: done, no loop
+                return 0
+            self._idx = 0
+            self._t0 = None
+        ts, data = self.pkts[self._idx]
+        if self.realtime:
+            if self._t0 is None:
+                self._t0 = _t.perf_counter()
+            due = self._t0 + (ts - self._ts0) / 1e6
+            if _t.perf_counter() < due:
+                return 0
+        if self.out_fseqs and self.out.credits(self.out_fseqs) <= 0:
+            self.m["backpressure"] += 1
+            return 0
+        self.out.publish(data, sig=self.m["tx"])
+        self.m["tx"] += 1
+        self._idx += 1
+        return 1
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
 @register("sink")
 class SinkAdapter:
     """Terminal consumer: counts frags (the reference's bencho TPS

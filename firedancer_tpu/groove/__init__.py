@@ -1,0 +1,1 @@
+from .groove import GrooveError, GrooveStore  # noqa: F401

@@ -26,6 +26,7 @@ import struct
 from ..pack.cost import (
     ED25519_SV_PROGRAM_ID as ED25519_PROGRAM_ID,
     KECCAK_SECP_PROGRAM_ID as SECP256K1_PROGRAM_ID,
+    SECP256R1_PROGRAM_ID,
 )
 
 THIS_IX = 0xFFFF          # u16 marker (ed25519 layout)
@@ -105,5 +106,40 @@ def exec_secp256k1_precompile(ic) -> str:
         q = recover(keccak256(msg), r, s, sig[64])
         if q is None or eth_address(q) != addr:
             ic.logs.append(f"secp256k1 precompile: sig {i} invalid")
+            return ERR_VM
+    return OK
+
+
+def exec_secp256r1_precompile(ic) -> str:
+    """SIMD-0075 P-256 precompile: same 14-byte offsets entry as the
+    ed25519 layout (u16 indexes, 0xFFFF = this instruction), 33-byte
+    SEC1 compressed pubkeys, 64-byte r‖s signatures with the low-s
+    rule (ref: src/ballet/secp256r1/)."""
+    from ..utils.secp256r1 import verify
+    from .programs import ERR_BAD_IX_DATA, ERR_VM, OK
+    data = ic.data
+    if len(data) < 2:
+        return ERR_BAD_IX_DATA
+    count = data[0]
+    # SIMD-0075: num_signatures MUST be 1..=8 (the reference rejects
+    # out-of-range counts; agreeing here is consensus-critical)
+    if count == 0 or count > 8:
+        return ERR_BAD_IX_DATA
+    need = 2 + 14 * count
+    if len(data) < need:
+        return ERR_BAD_IX_DATA
+    for i in range(count):
+        (sig_off, sig_ix, pub_off, pub_ix, msg_off, msg_sz,
+         msg_ix) = struct.unpack_from("<HHHHHHH", data, 2 + 14 * i)
+        sig = _slice(_instr_data(ic.ctx, sig_ix, data, THIS_IX),
+                     sig_off, 64)
+        pub = _slice(_instr_data(ic.ctx, pub_ix, data, THIS_IX),
+                     pub_off, 33)
+        msg = _slice(_instr_data(ic.ctx, msg_ix, data, THIS_IX),
+                     msg_off, msg_sz)
+        if sig is None or pub is None or msg is None:
+            return ERR_BAD_IX_DATA
+        if not verify(pub, msg, sig):
+            ic.logs.append(f"secp256r1 precompile: sig {i} invalid")
             return ERR_VM
     return OK

@@ -883,8 +883,9 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
     from .alut import ALUT_PROGRAM_ID, exec_alut
     from .loader import exec_upgradeable_loader, resolve_program_elf
     from .precompiles import (
-        ED25519_PROGRAM_ID, SECP256K1_PROGRAM_ID,
+        ED25519_PROGRAM_ID, SECP256K1_PROGRAM_ID, SECP256R1_PROGRAM_ID,
         exec_ed25519_precompile, exec_secp256k1_precompile,
+        exec_secp256r1_precompile,
     )
     from .stake import STAKE_PROGRAM_ID, exec_stake
     from .vote import VOTE_PROGRAM_ID, exec_vote
@@ -901,6 +902,8 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
         return exec_ed25519_precompile(ic)
     if pid == SECP256K1_PROGRAM_ID:
         return exec_secp256k1_precompile(ic)
+    if pid == SECP256R1_PROGRAM_ID:
+        return exec_secp256r1_precompile(ic)
     if pid == BPF_UPGRADEABLE_LOADER_ID:
         return exec_upgradeable_loader(ic)
     if pid == COMPUTE_BUDGET_PROGRAM_ID:

@@ -1,0 +1,52 @@
+"""pcap classic-format reader/writer (the tooling interchange format).
+
+The reference ships pcap capture/replay for deterministic re-driving
+of packet flows (ref: src/disco/pcap/fd_pcap_replay_tile.c,
+src/util/net pcap helpers). This is the byte-exact classic format
+(magic 0xa1b2c3d4, microsecond timestamps, LINKTYPE_USER0=147 so
+payloads are raw frames — no ethernet/ip synthesis needed for ring
+replay)."""
+from __future__ import annotations
+
+import struct
+
+MAGIC = 0xA1B2C3D4
+LINKTYPE_USER0 = 147
+
+_GHDR = "<IHHiIII"
+_PHDR = "<IIII"
+
+
+def write_pcap(fp, packets, linktype: int = LINKTYPE_USER0):
+    """packets: iterable of (ts_us, payload bytes)."""
+    fp.write(struct.pack(_GHDR, MAGIC, 2, 4, 0, 0, 1 << 16, linktype))
+    for ts_us, data in packets:
+        fp.write(struct.pack(_PHDR, ts_us // 1_000_000,
+                             ts_us % 1_000_000, len(data), len(data)))
+        fp.write(data)
+
+
+def read_pcap(fp):
+    """Yield (ts_us, payload). Raises ValueError on a bad magic;
+    tolerates swapped-endian files."""
+    g = fp.read(struct.calcsize(_GHDR))
+    if len(g) < struct.calcsize(_GHDR):
+        raise ValueError("truncated pcap global header")
+    magic = struct.unpack_from("<I", g, 0)[0]
+    if magic == MAGIC:
+        endian = "<"
+    elif magic == struct.unpack(">I", struct.pack("<I", MAGIC))[0]:
+        endian = ">"
+    else:
+        raise ValueError(f"bad pcap magic {magic:#x}")
+    phdr = endian + "IIII"
+    psz = struct.calcsize(phdr)
+    while True:
+        h = fp.read(psz)
+        if len(h) < psz:
+            return
+        sec, usec, incl, orig = struct.unpack(phdr, h)
+        data = fp.read(incl)
+        if len(data) < incl:
+            return                        # torn tail: stop cleanly
+        yield sec * 1_000_000 + usec, data

@@ -108,6 +108,7 @@ class Vm:
         self.syscalls = dict(syscalls or {})
         self.calls = dict(calls or {})
         self.log: list[str] = []
+        self.trace = None              # vm/trace.py Tracer, optional
 
     # -- memory -------------------------------------------------------------
 
@@ -169,6 +170,8 @@ class Vm:
                 self._cu += 1
                 if self._cu > self.compute_budget:
                     raise VmFault(ERR_BUDGET)
+                if self.trace is not None:
+                    self.trace.on_instr(self, pc, reg, self._cu)
                 i = pc * 8
                 op = self.text[i]
                 dst = self.text[i + 1] & 0x0F

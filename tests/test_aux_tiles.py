@@ -1,0 +1,152 @@
+"""Aux tiles: pcap codec + replay tile, ipecho service, cswtch
+sampler (ref: src/disco/pcap/fd_pcap_replay_tile.c,
+src/discof/ipecho/, src/disco/cswtch/fd_cswtch_tile.c)."""
+import io
+import os
+import struct
+import time
+
+import pytest
+
+from firedancer_tpu.utils.pcap import (LINKTYPE_USER0, read_pcap,
+                                       write_pcap)
+
+
+def test_pcap_roundtrip_and_endian_tolerance():
+    pkts = [(1_000_000 + i * 137, os.urandom(20 + i)) for i in range(9)]
+    buf = io.BytesIO()
+    write_pcap(buf, pkts)
+    buf.seek(0)
+    assert list(read_pcap(buf)) == pkts
+    # torn tail: truncated final packet is dropped, not an error
+    raw = buf.getvalue()
+    buf2 = io.BytesIO(raw[:-5])
+    assert list(read_pcap(buf2)) == pkts[:-1]
+    with pytest.raises(ValueError):
+        list(read_pcap(io.BytesIO(b"\x00" * 40)))
+
+
+def test_ipecho_service_roundtrip():
+    from firedancer_tpu.disco.tiles import IpechoAdapter, ipecho_query
+
+    class Ctx:
+        plan = {"topology": "t", "tiles": {}}
+        tile_name = "ipecho"
+        in_rings = {}
+        out_rings = {}
+        out_fseqs = {}
+
+    a = IpechoAdapter(Ctx(), {"shred_version": 5122})
+    try:
+        sv, ip, port = ipecho_query(("127.0.0.1", a.port))
+        assert sv == 5122
+        assert ip == "127.0.0.1" and port > 0
+        assert a.queries == 1
+    finally:
+        a.on_halt()
+
+
+def test_cswtch_samples_own_process(tmp_path):
+    from firedancer_tpu.disco.tiles import CswtchAdapter
+
+    class Ctx:
+        plan = {"topology": f"cs{os.getpid()}", "tiles": {"me": {}}}
+        tile_name = "cswtch"
+        in_rings = {}
+        out_rings = {}
+        out_fseqs = {}
+
+    topo = Ctx.plan["topology"]
+    with open(f"/dev/shm/fdtpu_{topo}.pid.me", "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        a = CswtchAdapter(Ctx(), {})
+        a.housekeeping()
+        m = a.metrics_items()
+        assert m["tiles_sampled"] == 1
+        assert m["vol"] > 0              # this process has switched
+        assert m["max_invol"] == m["invol"]
+    finally:
+        os.unlink(f"/dev/shm/fdtpu_{topo}.pid.me")
+
+
+def test_pcap_tile_replays_into_topology(tmp_path):
+    """pcap tile -> sink across real processes; payloads byte-exact
+    and in order."""
+    from firedancer_tpu.disco import Topology, TopologyRunner
+
+    pkts = [(i * 1000, bytes([i]) * (60 + i)) for i in range(1, 33)]
+    path = str(tmp_path / "cap.pcap")
+    with open(path, "wb") as f:
+        write_pcap(f, pkts)
+
+    topo = (
+        Topology(f"pc{os.getpid()}", wksp_size=1 << 22)
+        .link("replayed", depth=64, mtu=256)
+        .tile("pcap", "pcap", outs=["replayed"], path=path, loop=2)
+        .tile("sink", "sink", ins=["replayed"])
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=60)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if runner.metrics("sink")["rx"] >= 2 * len(pkts):
+                break
+            time.sleep(0.05)
+        assert runner.metrics("sink")["rx"] == 2 * len(pkts)
+        p = runner.metrics("pcap")
+        assert p["tx"] == 2 * len(pkts) and p["done"] == 1
+    finally:
+        runner.halt()
+        runner.close()
+
+
+def test_pcap_tile_empty_capture_is_done_not_crash(tmp_path):
+    from firedancer_tpu.disco.tiles import PcapAdapter
+
+    path = str(tmp_path / "empty.pcap")
+    with open(path, "wb") as f:
+        write_pcap(f, [])
+
+    class Ring:
+        def credits(self, fseqs):
+            return 1
+
+        def publish(self, *a, **kw):
+            raise AssertionError("nothing to publish")
+
+    class Ctx:
+        plan = {"topology": "t", "tiles": {},
+                "links": {"out": {"mtu": 256, "depth": 8}}}
+        tile_name = "pcap"
+        in_rings = {}
+        out_rings = {"out": Ring()}
+        out_fseqs = {"out": []}
+
+    a = PcapAdapter(Ctx(), {"path": path, "loop": 3})
+    for _ in range(5):
+        assert a.poll_once() == 0
+    assert a.metrics_items()["done"] == 1
+
+
+def test_cswtch_ignores_recycled_pid():
+    from firedancer_tpu.disco.tiles import CswtchAdapter
+
+    class Ctx:
+        plan = {"topology": f"cr{os.getpid()}", "tiles": {"ghost": {}}}
+        tile_name = "cswtch"
+        in_rings = {}
+        out_rings = {}
+        out_fseqs = {}
+
+    topo = Ctx.plan["topology"]
+    # stale pidfile: right pid, WRONG starttime
+    with open(f"/dev/shm/fdtpu_{topo}.pid.ghost", "w") as f:
+        f.write(f"{os.getpid()} 12345")
+    try:
+        a = CswtchAdapter(Ctx(), {})
+        a.housekeeping()
+        assert a.metrics_items()["tiles_sampled"] == 0
+    finally:
+        os.unlink(f"/dev/shm/fdtpu_{topo}.pid.ghost")

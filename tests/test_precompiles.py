@@ -111,3 +111,58 @@ def test_secp256k1_precompile():
     # truncated offsets refused, not crashed
     r3 = _exec(_txn(SECP256K1_PROGRAM_ID, bytes([3]) + bytes(5)))
     assert r3.status == "bad_instruction_data"
+
+
+def _p256_ix(sig, pub33, msg):
+    hdr_sz = 2 + 14
+    data = bytearray(bytes([1, 0]))
+    data += struct.pack("<HHHHHHH", hdr_sz, THIS_IX,
+                        hdr_sz + 64, THIS_IX,
+                        hdr_sz + 97, len(msg), THIS_IX)
+    data += sig + pub33 + msg
+    return bytes(data)
+
+
+def test_secp256r1_precompile():
+    """P-256 precompile (SIMD-0075): verify via an OpenSSL-made
+    signature, reject corrupt/high-s/truncated."""
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature)
+    from cryptography.hazmat.primitives import hashes, serialization
+    from firedancer_tpu.pack.cost import SECP256R1_PROGRAM_ID
+    from firedancer_tpu.utils import secp256r1 as r1
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    pub33 = key.public_key().public_bytes(
+        serialization.Encoding.X962,
+        serialization.PublicFormat.CompressedPoint)
+    msg = b"p256 precompile"
+    r, s = decode_dss_signature(key.sign(msg, ec.ECDSA(hashes.SHA256())))
+    if s > r1.N // 2:
+        s = r1.N - s
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    assert _exec(_txn(SECP256R1_PROGRAM_ID,
+                      _p256_ix(sig, pub33, msg))).status == OK
+    bad = bytearray(sig)
+    bad[5] ^= 1
+    assert _exec(_txn(SECP256R1_PROGRAM_ID,
+                      _p256_ix(bytes(bad), pub33, msg))).status == ERR_VM
+    # high-s rejected (strict verifier)
+    highs = r.to_bytes(32, "big") + (r1.N - s).to_bytes(32, "big")
+    assert _exec(_txn(SECP256R1_PROGRAM_ID,
+                      _p256_ix(highs, pub33, msg))).status == ERR_VM
+    # truncated refused, not crashed
+    assert _exec(_txn(SECP256R1_PROGRAM_ID,
+                      bytes([2, 0]) + bytes(6))).status == \
+        "bad_instruction_data"
+
+
+def test_secp256r1_count_cap():
+    """SIMD-0075: num_signatures must be 1..=8."""
+    from firedancer_tpu.pack.cost import SECP256R1_PROGRAM_ID
+    bad = bytes([9, 0]) + bytes(14 * 9)
+    assert _exec(_txn(SECP256R1_PROGRAM_ID, bad)).status == \
+        "bad_instruction_data"
+    assert _exec(_txn(SECP256R1_PROGRAM_ID, bytes([0, 0]))).status == \
+        "bad_instruction_data"

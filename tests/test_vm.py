@@ -248,3 +248,33 @@ def test_syscall_raising_becomes_typed_fault():
     vm = Vm(asm("call 0x99\nexit"), syscalls={0x99: boom})
     r = vm.run()
     assert r.error == ERR_ABORT
+
+
+def test_tracer_captures_instructions_and_disasm():
+    """vm/trace.py: per-instruction capture with mnemonics, bounded
+    ring (ref: src/flamenco/vm/fd_vm_trace.c, fd_vm_disasm.c)."""
+    from firedancer_tpu.vm.trace import Tracer, disasm
+    from firedancer_tpu.vm.asm import asm
+    prog = asm("""
+        mov64 r1, 7
+        mov64 r2, 5
+        add64 r1, r2
+        lsh64 r1, 1
+        exit
+    """)
+    vm = Vm(prog)
+    tr = Tracer(limit=3).attach(vm)
+    res = vm.run()
+    assert res.error == ERR_NONE and res.r0 == 0
+    assert tr.count == 5
+    assert len(tr.entries) == 3              # bounded ring kept newest
+    assert tr.entries[-1].text == "exit"
+    assert tr.entries[0].text == "add64 r1, r2"
+    # regs snapshot is pre-execution
+    assert tr.entries[0].regs[1] == 7 and tr.entries[0].regs[2] == 5
+    assert tr.entries[1].regs[1] == 12       # after the add
+    # disasm spot checks
+    assert disasm(asm("jeq r3, 9, +4")) == "jeq r3, 9, +4"
+    assert disasm(asm("ldxdw r2, [r1+8]")) == "ldxdw r2, [r1+8]"
+    assert disasm(asm("stxw [r10-4], r3")) == "stxw [r10-4], r3"
+    assert "format" and tr.format(2).count("\n") == 1
