@@ -8,6 +8,7 @@ the stem callbacks (poll_once / housekeeping / metrics_items / in_seqs).
 """
 from __future__ import annotations
 
+import json
 import os
 import struct
 import time
@@ -1576,6 +1577,281 @@ class MetricAdapter:
 
     def metrics_items(self):
         return {"port": self.port, "scrapes": self.scrapes}
+
+
+@register("netlnk")
+class NetlnkAdapter:
+    """Kernel route/neighbor table mirror (ref: src/disco/netlink/
+    fd_netlink_tile.c — publishes FIB4 + ARP into shared maps; here
+    waltz/nettables.py snapshots procfs at the housekeeping cadence
+    and the counts surface as metrics; see the module docstring for
+    why the sock-based net path only needs visibility)."""
+
+    METRICS = ["routes", "neighbors", "refreshes", "default_via"]
+    GAUGES = ["routes", "neighbors", "default_via"]
+
+    def __init__(self, ctx, args):
+        self.ctx = ctx
+        self.m = {k: 0 for k in self.METRICS}
+        self.fib = None
+        self.neigh = None
+        self.housekeeping()
+
+    def housekeeping(self):
+        from ..waltz.nettables import refresh_from_proc
+        self.fib, self.neigh = refresh_from_proc()
+        self.m["routes"] = len(self.fib)
+        self.m["neighbors"] = len(self.neigh)
+        self.m["refreshes"] += 1
+        # the DEFAULT route's gateway, not whatever more-specific
+        # route happens to cover a probe address
+        default = next((r for r in self.fib.routes
+                        if r.prefix_len == 0), None)
+        self.m["default_via"] = default.gw if default else 0
+
+    def poll_once(self) -> int:
+        return 0
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("vinyl")
+class VinylAdapter:
+    """vinyl DB service tile (ref: src/vinyl/fd_vinyl.h:13-29 — the
+    log-structured disk DB "run as a dedicated tile driven over tango
+    rings"; clients speak request/completion queues, rq/ and cq/, tile
+    src/discof/vinyl/fd_vinyl_tile.c). Request frame:
+
+        op u8 (1=PUT 2=GET 3=DEL) | req_id u64 | key 32 | val...
+
+    Completion frame:  req_id u64 | status u8 (0=ok 1=miss 2=err) |
+    val... (GET hits). The store is the crash-recovering append log in
+    vinyl/vinyl.py; durability boundary = the housekeeping fsync
+    (args: sync_every_hk) with opportunistic GC compaction.
+
+    args: path (log file), gc (run maybe_compact in housekeeping)."""
+
+    METRICS = ["puts", "gets", "hits", "dels", "errs", "records",
+               "backpressure", "overruns"]
+    GAUGES = ["records"]
+
+    OP_PUT, OP_GET, OP_DEL = 1, 2, 3
+    ST_OK, ST_MISS, ST_ERR = 0, 1, 2
+
+    def __init__(self, ctx, args):
+        from ..vinyl.vinyl import Vinyl
+        self.ctx = ctx
+        self.in_link = next(iter(ctx.in_rings))
+        self.ring = ctx.in_rings[self.in_link]
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.out_fseqs = _single(ctx.out_fseqs, "out link",
+                                 ctx.tile_name)
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+        out_link = next(ln for ln in ctx.out_rings)
+        self.out_mtu = ctx.plan["links"][out_link]["mtu"]
+        self.db = Vinyl(args["path"])
+        self.gc = bool(args.get("gc", True))
+        self.seq = 0
+        self.m = {k: 0 for k in self.METRICS}
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, 16, self.mtu)
+        self.m["overruns"] += ovr
+        for i in range(n):
+            frame = bytes(buf[i, :sizes[i]])
+            self._serve(frame)
+        self.m["records"] = len(self.db)
+        return n
+
+    def _serve(self, frame: bytes):
+        if len(frame) < 41:
+            self.m["errs"] += 1
+            return
+        op = frame[0]
+        req_id, = struct.unpack_from("<Q", frame, 1)
+        key = frame[9:41]
+        resp = struct.pack("<QB", req_id, self.ST_OK)
+        try:
+            if op == self.OP_PUT:
+                # a value a GET completion could not carry is refused
+                # at PUT time (the cq mtu bounds the protocol, not a
+                # crash in Ring.publish)
+                if 9 + len(frame) - 41 > self.out_mtu:
+                    resp = struct.pack("<QB", req_id, self.ST_ERR)
+                    self.m["errs"] += 1
+                else:
+                    self.db.put(key, frame[41:])
+                    self.m["puts"] += 1
+            elif op == self.OP_GET:
+                val = self.db.get(key)
+                self.m["gets"] += 1
+                if val is None:
+                    resp = struct.pack("<QB", req_id, self.ST_MISS)
+                elif 9 + len(val) > self.out_mtu:
+                    # legacy oversize record (written under a larger
+                    # cq mtu): typed error, not a tile crash
+                    resp = struct.pack("<QB", req_id, self.ST_ERR)
+                    self.m["errs"] += 1
+                else:
+                    self.m["hits"] += 1
+                    resp += val
+            elif op == self.OP_DEL:
+                self.db.delete(key)
+                self.m["dels"] += 1
+            else:
+                resp = struct.pack("<QB", req_id, self.ST_ERR)
+                self.m["errs"] += 1
+        except Exception:
+            resp = struct.pack("<QB", req_id, self.ST_ERR)
+            self.m["errs"] += 1
+        while self.out_fseqs and self.out.credits(self.out_fseqs) <= 0:
+            self.m["backpressure"] += 1
+            time.sleep(50e-6)        # completions must not be dropped
+        self.out.publish(resp, sig=req_id)
+
+    def housekeeping(self):
+        self.db.sync()
+        if self.gc:
+            self.db.maybe_compact()
+
+    def on_halt(self):
+        self.db.close()
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+_GUI_HTML = """<!doctype html><html><head><meta charset="utf-8">
+<title>firedancer-tpu</title><style>
+body{font-family:ui-monospace,monospace;background:#0b0e14;color:#d6d9e0;
+margin:24px}h1{font-size:16px;color:#7aa2f7}table{border-collapse:collapse;
+margin-top:12px}td,th{padding:3px 10px;border-bottom:1px solid #1f2430;
+text-align:left;font-size:12px}th{color:#7aa2f7}.RUN{color:#9ece6a}
+.BOOT{color:#e0af68}.HALT,.FAIL{color:#f7768e}#tps{font-size:28px;
+color:#9ece6a}small{color:#565f89}</style></head><body>
+<h1>firedancer-tpu <small id="topo"></small></h1>
+<div>TPS <span id="tps">-</span></div>
+<table id="t"><thead><tr><th>tile</th><th>kind</th><th>state</th>
+<th>hb age</th><th>work p99 &micro;s</th><th>metrics</th></tr></thead>
+<tbody></tbody></table>
+<script>
+async function tick(){
+ try{
+  const r=await fetch('summary.json');const s=await r.json();
+  document.getElementById('topo').textContent=s.topology;
+  document.getElementById('tps').textContent=s.tps.toFixed(0);
+  const tb=document.querySelector('#t tbody');tb.innerHTML='';
+  for(const [tn,row] of Object.entries(s.tiles)){
+   const ms=Object.entries(row.metrics).filter(([k,v])=>v)
+     .map(([k,v])=>k+'='+v).join(' ');
+   const w=row.latency.work||{};
+   tb.insertAdjacentHTML('beforeend',
+    `<tr><td>${tn}</td><td>${row.kind}</td>`+
+    `<td class="${row.state}">${row.state}</td>`+
+    `<td>${row.hb_age_ticks}</td>`+
+    `<td>${w.count?w.p99_us.toFixed(0):'-'}</td><td>${ms}</td></tr>`);
+  }
+ }catch(e){}
+ setTimeout(tick,1000);
+}
+tick();
+</script></body></html>"""
+
+
+@register("gui")
+class GuiAdapter:
+    """Live dashboard (ref: src/disco/gui/fd_gui.c + fd_gui_tile.c —
+    the reference serves a bundled frontend over HTTP+WebSocket; here
+    a self-contained page polls a JSON summary rendered straight from
+    the shm metrics + cnc regions, the same sources the monitor CLI
+    reads). TPS derives from the delta of a configured counter
+    (args: tps_tile/tps_metric, default sink.rx) sampled at the
+    housekeeping cadence.
+
+    args: port (0 = ephemeral, published as the "port" metric),
+    bind_addr, tps_tile, tps_metric."""
+
+    METRICS = ["port", "requests"]
+    GAUGES = ["port"]
+
+    def __init__(self, ctx, args):
+        import threading
+        import time as _t
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        from .monitor import snapshot
+        self.ctx = ctx
+        self.requests = 0
+        self.tps_tile = args.get("tps_tile", "sink")
+        self.tps_metric = args.get("tps_metric", "rx")
+        self._tps = 0.0
+        self._last = (None, 0.0)       # (count, t)
+        adapter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path in ("/", "/index.html"):
+                    body = _GUI_HTML.encode()
+                    ctype = "text/html"
+                elif self.path == "/summary.json":
+                    snap = snapshot(adapter.ctx.plan, adapter.ctx.wksp)
+                    body = json.dumps({
+                        "topology": adapter.ctx.plan["topology"],
+                        "tps": adapter._tps,
+                        "tiles": snap,
+                    }).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                adapter.requests += 1
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(
+            (args.get("bind_addr", "127.0.0.1"),
+             int(args.get("port", 0))), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+        self._time = _t
+
+    def housekeeping(self):
+        from .topo import read_metrics
+        tn = self.tps_tile
+        spec = self.ctx.plan["tiles"].get(tn)
+        if spec is None:
+            return
+        names = spec.get("metrics_names", [])
+        if self.tps_metric not in names:
+            return
+        vals = read_metrics(self.ctx.wksp, self.ctx.plan, tn)
+        cnt = int(vals[names.index(self.tps_metric)])
+        now = self._time.perf_counter()
+        last_cnt, last_t = self._last
+        if last_cnt is not None and now > last_t:
+            self._tps = max(0.0, (cnt - last_cnt) / (now - last_t))
+        self._last = (cnt, now)
+
+    def poll_once(self) -> int:
+        return 0
+
+    def on_halt(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def metrics_items(self):
+        return {"port": self.port, "requests": self.requests}
 
 
 @register("cswtch")

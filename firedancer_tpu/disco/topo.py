@@ -49,6 +49,9 @@ class LinkSpec:
     name: str
     depth: int
     mtu: int
+    external: bool = False        # producer/consumer outside the topo
+    #   (a client process drives the ring directly — the vinyl rq/cq
+    #   pattern, ref: fd_vinyl.h clients joining over rings)
 
 
 @dataclass
@@ -70,10 +73,11 @@ class Topology:
         self.tiles: dict[str, TileSpec] = {}
         self.tcaches: dict[str, int] = {}           # name -> depth
 
-    def link(self, name: str, depth: int = 128, mtu: int = 1280):
+    def link(self, name: str, depth: int = 128, mtu: int = 1280,
+             external: bool = False):
         if name in self.links:
             raise ValueError(f"duplicate link {name}")
-        self.links[name] = LinkSpec(name, depth, mtu)
+        self.links[name] = LinkSpec(name, depth, mtu, external)
         return self
 
     def tile(self, name: str, kind: str, ins=(), outs=(), **args):
@@ -110,10 +114,10 @@ class Topology:
                     raise ValueError(
                         f"tile {t.name}: unknown in link {i['link']}")
                 consumed.add(i["link"])
-        for ln in self.links:
-            if ln not in producers:
+        for ln, spec in self.links.items():
+            if ln not in producers and not spec.external:
                 raise ValueError(f"link {ln} has no producer")
-            if ln not in consumed:
+            if ln not in consumed and not spec.external:
                 raise ValueError(f"link {ln} has no consumer")
 
     def build(self, wksp_name: str | None = None) -> dict:

@@ -41,7 +41,9 @@ MIN_CLASS = 7               # 128 B
 MAX_CLASS = 24              # 16 MiB object ceiling
 VOLUME_SZ = 1 << 26         # 64 MiB volumes
 
-_HDR = "<IBBH32sI"          # magic, state, class, rsvd, key, data_len
+_HDR = "<IBBH32sIQ"         # magic, state, class, rsvd, key,
+#                             data_len, lsn (monotone write sequence —
+#                             the duplicate-LIVE tiebreak on recovery)
 _HDR_SZ = struct.calcsize(_HDR)
 _CRC_SZ = 4
 
@@ -85,8 +87,11 @@ class GrooveStore:
         self.vols: list[_Volume] = []
         self.meta: dict[bytes, tuple[int, int]] = {}   # key -> (vol, off)
         self.free: dict[int, list[tuple[int, int]]] = {}
+        self._lsn = 0
+        self._live_lsn: dict[bytes, int] = {}
         self.stats = {"puts": 0, "gets": 0, "deletes": 0,
-                      "reused": 0, "torn_reclaimed": 0}
+                      "reused": 0, "torn_reclaimed": 0,
+                      "dup_reconciled": 0}
         for name in sorted(os.listdir(directory)):
             if name.startswith("vol-") and name.endswith(".groove"):
                 self._scan(_Volume(os.path.join(directory, name),
@@ -99,7 +104,7 @@ class GrooveStore:
         self.vols.append(vol)
         off = 0
         while off + _HDR_SZ <= VOLUME_SZ:
-            magic, state, cls, _, key, dlen = struct.unpack_from(
+            magic, state, cls, _, key, dlen, lsn = struct.unpack_from(
                 _HDR, vol.mm, off)
             if magic != MAGIC:
                 break                         # frontier reached
@@ -117,7 +122,23 @@ class GrooveStore:
                 end = off + _HDR_SZ + dlen
                 crc, = struct.unpack_from("<I", vol.mm, end)
                 if zlib.crc32(vol.mm[off + _HDR_SZ:end]) == crc:
-                    self.meta[key] = (vid, off)
+                    self._lsn = max(self._lsn, lsn)
+                    prev = self.meta.get(key)
+                    if prev is not None:
+                        # crash window duplicate (put() died between
+                        # writing the new copy and killing the old):
+                        # higher lsn wins, the loser is tombstoned so
+                        # a later delete cannot be resurrected
+                        self.stats["dup_reconciled"] += 1
+                        if lsn > self._live_lsn[key]:
+                            self._kill(*prev)
+                            self.meta[key] = (vid, off)
+                            self._live_lsn[key] = lsn
+                        else:
+                            self._kill(vid, off)
+                    else:
+                        self.meta[key] = (vid, off)
+                        self._live_lsn[key] = lsn
                 else:                         # torn write: reclaim
                     self.stats["torn_reclaimed"] += 1
                     self.free.setdefault(cls, []).append((vid, off))
@@ -152,21 +173,25 @@ class GrooveStore:
 
     def put(self, key: bytes, data: bytes):
         """Insert or overwrite. Overwrite writes the new copy first,
-        then tombstones the old (crash between the two leaves the OLD
-        value live — never a torn new one)."""
+        then tombstones the old; a crash between the two leaves BOTH
+        live and the recovery scan keeps the higher-lsn copy (the new
+        one when its crc completed, otherwise the old) — never a torn
+        value, never a resurrectable duplicate."""
         if len(key) != 32:
             raise GrooveError("key must be 32 bytes")
         cls = _class_for(len(data))
         vid, off = self._alloc(cls)
         mm = self.vols[vid].mm
+        self._lsn += 1
         struct.pack_into(_HDR, mm, off, MAGIC, ST_LIVE, cls, 0, key,
-                         len(data))
+                         len(data), self._lsn)
         end = off + _HDR_SZ
         mm[end:end + len(data)] = data
         struct.pack_into("<I", mm, end + len(data),
                          zlib.crc32(data))
         old = self.meta.get(key)
         self.meta[key] = (vid, off)
+        self._live_lsn[key] = self._lsn
         if old is not None:
             self._kill(*old)
         self.stats["puts"] += 1
@@ -177,12 +202,13 @@ class GrooveStore:
             return None
         vid, off = loc
         mm = self.vols[vid].mm
-        _, _, _, _, _, dlen = struct.unpack_from(_HDR, mm, off)
+        dlen = struct.unpack_from(_HDR, mm, off)[5]
         self.stats["gets"] += 1
         return memoryview(mm)[off + _HDR_SZ:off + _HDR_SZ + dlen]
 
     def delete(self, key: bytes) -> bool:
         loc = self.meta.pop(key, None)
+        self._live_lsn.pop(key, None)
         if loc is None:
             return False
         self._kill(*loc)

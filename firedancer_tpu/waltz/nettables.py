@@ -1,0 +1,141 @@
+"""Kernel route (FIB4) + neighbor tables — the netlink mirror.
+
+The reference's netlink tile mirrors the kernel's routing and ARP
+tables into shared maps so the XDP net tile can route egress packets
+without syscalls (ref: src/waltz/ip/fd_fib4.h, src/waltz/neigh/,
+tile src/disco/netlink/fd_netlink_tile.c). This framework's net path
+uses kernel UDP sockets (the kernel routes for us), so the mirror's
+role here is route VISIBILITY — the netlnk tile samples these tables
+for the monitor/gui, and any future AF_XDP backend consumes the same
+structures.
+
+Source of truth is procfs rather than a netlink socket: /proc/net/route
+(hex little-endian IPv4 FIB) and /proc/net/arp — same kernel state,
+no binary protocol, refreshable at the housekeeping cadence.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass
+
+
+def _hex_le_ip(h: str) -> int:
+    """/proc/net/route encodes IPs as host-endian hex of the
+    network-order word; ntohl recovers the conventional big-endian
+    integer (192.168.0.0 appears as 0000A8C0)."""
+    return socket.ntohl(int(h, 16))
+
+
+def ip_str(ip: int) -> str:
+    return socket.inet_ntoa(struct.pack(">I", ip))
+
+
+@dataclass
+class Route:
+    dst: int
+    mask: int
+    gw: int          # 0 = directly connected
+    iface: str
+    metric: int
+    flags: int
+
+    @property
+    def prefix_len(self) -> int:
+        return bin(self.mask).count("1")
+
+
+def parse_routes(text: str) -> list[Route]:
+    """Parse /proc/net/route content."""
+    out = []
+    for line in text.splitlines()[1:]:
+        f = line.split()
+        if len(f) < 8:
+            continue
+        out.append(Route(dst=_hex_le_ip(f[1]), gw=_hex_le_ip(f[2]),
+                         flags=int(f[3], 16), metric=int(f[6]),
+                         mask=_hex_le_ip(f[7]), iface=f[0]))
+    return out
+
+
+def parse_neigh(text: str) -> dict[int, tuple[str, str]]:
+    """Parse /proc/net/arp -> {ip: (mac, device)}."""
+    out = {}
+    for line in text.splitlines()[1:]:
+        f = line.split()
+        if len(f) < 6:
+            continue
+        try:
+            ip = struct.unpack(
+                ">I", socket.inet_aton(f[0]))[0]
+        except OSError:
+            continue
+        out[ip] = (f[3], f[5])
+    return out
+
+
+class Fib4:
+    """Longest-prefix-match IPv4 forwarding table (fd_fib4 role).
+    Routes keep insertion from parse_routes; lookup prefers the
+    longest prefix, then the lowest metric."""
+
+    _ORDER = staticmethod(lambda x: (-x.prefix_len, x.metric))
+
+    def __init__(self, routes: list[Route] | None = None):
+        # bulk construction sorts once (a netlink refresh re-feeds the
+        # whole table every housekeeping tick)
+        self.routes: list[Route] = sorted(routes or [], key=self._ORDER)
+
+    def insert(self, r: Route):
+        self.routes.append(r)
+        # longest prefix first, then metric — lookup takes the first hit
+        self.routes.sort(key=self._ORDER)
+
+    def lookup(self, ip: int | str) -> Route | None:
+        if isinstance(ip, str):
+            ip = struct.unpack(">I", socket.inet_aton(ip))[0]
+        for r in self.routes:
+            if (ip & r.mask) == (r.dst & r.mask):
+                return r
+        return None
+
+    def next_hop(self, ip: int | str) -> tuple[str, int] | None:
+        """-> (iface, gateway-or-dst ip) — what egress needs."""
+        r = self.lookup(ip)
+        if r is None:
+            return None
+        if isinstance(ip, str):
+            ip = struct.unpack(">I", socket.inet_aton(ip))[0]
+        return (r.iface, r.gw if r.gw else ip)
+
+    def __len__(self):
+        return len(self.routes)
+
+
+class NeighTable:
+    def __init__(self, entries: dict | None = None):
+        self.entries = dict(entries or {})
+
+    def mac_of(self, ip: int | str) -> str | None:
+        if isinstance(ip, str):
+            ip = struct.unpack(">I", socket.inet_aton(ip))[0]
+        e = self.entries.get(ip)
+        return e[0] if e else None
+
+    def __len__(self):
+        return len(self.entries)
+
+
+def refresh_from_proc() -> tuple[Fib4, NeighTable]:
+    """Live kernel state (empty tables when procfs is unavailable)."""
+    try:
+        with open("/proc/net/route") as f:
+            fib = Fib4(parse_routes(f.read()))
+    except OSError:
+        fib = Fib4()
+    try:
+        with open("/proc/net/arp") as f:
+            neigh = NeighTable(parse_neigh(f.read()))
+    except OSError:
+        neigh = NeighTable()
+    return fib, neigh

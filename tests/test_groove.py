@@ -120,3 +120,29 @@ def test_corrupt_dlen_reclaimed_not_crash(tmp_path):
     assert g2.get(K(2)) is None
     assert g2.stats["torn_reclaimed"] == 1
     g2.close()
+
+
+def test_crash_window_duplicate_reconciled(tmp_path):
+    """Simulated crash inside put()'s overwrite window (new copy
+    written, old not yet tombstoned): recovery keeps the higher-lsn
+    copy and KILLS the loser so delete cannot be resurrected (r4
+    review)."""
+    g = GrooveStore(str(tmp_path))
+    g.put(K(1), b"v1-old")
+    old_loc = g.meta[K(1)]
+    g.put(K(1), b"v2-new")
+    # resurrect the old record as LIVE = the crash-window state
+    vid, off = old_loc
+    g.vols[vid].mm[off + 4] = 1          # ST_LIVE
+    g.flush()
+    g.close()
+
+    g2 = GrooveStore(str(tmp_path))
+    assert g2.stats["dup_reconciled"] == 1
+    assert bytes(g2.get(K(1))) == b"v2-new"      # higher lsn won
+    g2.delete(K(1))
+    g2.flush()
+    g2.close()
+    g3 = GrooveStore(str(tmp_path))
+    assert g3.get(K(1)) is None          # nothing resurrected
+    g3.close()

@@ -1,0 +1,131 @@
+"""vinyl service tile: DB driven over request/completion rings from
+another process, with durability across tile restarts
+(ref: src/vinyl/fd_vinyl.h:13-29, src/discof/vinyl/fd_vinyl_tile.c)."""
+import os
+import struct
+import time
+
+from firedancer_tpu.disco import Topology, TopologyRunner
+from firedancer_tpu.disco.tiles import VinylAdapter
+from firedancer_tpu.runtime import Ring, Workspace
+
+OP_PUT, OP_GET, OP_DEL = (VinylAdapter.OP_PUT, VinylAdapter.OP_GET,
+                          VinylAdapter.OP_DEL)
+ST_OK, ST_MISS = VinylAdapter.ST_OK, VinylAdapter.ST_MISS
+
+
+def _ring(plan, ln):
+    w = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                  create=False)
+    li = plan["links"][ln]
+    return Ring(w, li["ring_off"], li["depth"], li["arena_off"],
+                li["mtu"])
+
+
+def _req(op, req_id, key, val=b""):
+    return bytes([op]) + struct.pack("<Q", req_id) + key + val
+
+
+class _Client:
+    def __init__(self, plan):
+        self.rq = _ring(plan, "rq")
+        self.cq = _ring(plan, "cq")
+        self.seq = 0
+        self.mtu = plan["links"]["cq"]["mtu"]
+
+    def call(self, op, req_id, key, val=b"", timeout=15):
+        self.rq.publish(_req(op, req_id, key, val), sig=req_id)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            n, self.seq, buf, sizes, sigs, _ = self.cq.gather(
+                self.seq, 4, self.mtu)
+            for i in range(n):
+                frame = bytes(buf[i, :sizes[i]])
+                rid, st = struct.unpack_from("<QB", frame, 0)
+                if rid == req_id:
+                    return st, frame[9:]
+            time.sleep(0.005)
+        raise TimeoutError(f"no completion for req {req_id}")
+
+
+def _topo(name, path):
+    return (
+        Topology(name, wksp_size=1 << 22)
+        .link("rq", depth=64, mtu=1200, external=True)
+        .link("cq", depth=64, mtu=1200, external=True)
+        .tile("vinyl", "vinyl", ins=[("rq", False)], outs=["cq"],
+              path=path)
+    )
+
+
+def test_vinyl_tile_serves_and_persists(tmp_path):
+    path = str(tmp_path / "store.vinyl")
+    K1, K2 = b"\x01" * 32, b"\x02" * 32
+
+    plan = _topo(f"vy{os.getpid()}", path).build()
+    runner = TopologyRunner(plan).start()
+    try:
+        runner.wait_running(timeout_s=60)
+        c = _Client(plan)
+        assert c.call(OP_PUT, 1, K1, b"account-bytes-1")[0] == ST_OK
+        assert c.call(OP_PUT, 2, K2, b"x" * 900)[0] == ST_OK
+        st, val = c.call(OP_GET, 3, K1)
+        assert (st, val) == (ST_OK, b"account-bytes-1")
+        assert c.call(OP_GET, 4, b"\x09" * 32)[0] == ST_MISS
+        assert c.call(OP_DEL, 5, K1)[0] == ST_OK
+        assert c.call(OP_GET, 6, K1)[0] == ST_MISS
+        # metrics flush at the housekeeping cadence — poll
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            m = runner.metrics("vinyl")
+            if m["gets"] == 3:
+                break
+            time.sleep(0.02)
+        assert m["puts"] == 2 and m["gets"] == 3 and m["hits"] == 1
+        assert m["records"] >= 1
+    finally:
+        runner.halt()
+        runner.close()
+
+    # restart generation: the log recovers; K2 survives, K1 stays dead
+    plan2 = _topo(f"vy2{os.getpid()}", path).build()
+    runner2 = TopologyRunner(plan2).start()
+    try:
+        runner2.wait_running(timeout_s=60)
+        c2 = _Client(plan2)
+        st, val = c2.call(OP_GET, 10, K2)
+        assert (st, val) == (ST_OK, b"x" * 900)
+        assert c2.call(OP_GET, 11, K1)[0] == ST_MISS
+    finally:
+        runner2.halt()
+        runner2.close()
+
+
+def test_oversize_value_typed_error_not_crash(tmp_path):
+    """A PUT whose GET completion could not fit the cq mtu is refused
+    with ST_ERR; the tile survives (r4 review)."""
+    path = str(tmp_path / "store2.vinyl")
+    # cq mtu deliberately smaller than rq: a request can arrive whose
+    # completion could never be published
+    plan = (
+        Topology(f"vy3{os.getpid()}", wksp_size=1 << 22)
+        .link("rq", depth=64, mtu=1200, external=True)
+        .link("cq", depth=64, mtu=128, external=True)
+        .tile("vinyl", "vinyl", ins=[("rq", False)], outs=["cq"],
+              path=path)
+    ).build()
+    runner = TopologyRunner(plan).start()
+    try:
+        runner.wait_running(timeout_s=60)
+        c = _Client(plan)
+        big = b"z" * 500                     # fits rq, not cq
+        assert c.call(OP_PUT, 1, b"\x05" * 32, big)[0] == \
+            VinylAdapter.ST_ERR
+        # tile still serves
+        assert c.call(OP_PUT, 2, b"\x06" * 32, b"ok")[0] == ST_OK
+        st, val = c.call(OP_GET, 3, b"\x06" * 32)
+        assert (st, val) == (ST_OK, b"ok")
+        assert runner.metrics("vinyl")["errs"] == 1
+    finally:
+        runner.halt()
+        runner.close()
