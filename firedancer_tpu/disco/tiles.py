@@ -1642,13 +1642,14 @@ class VinylAdapter:
     def __init__(self, ctx, args):
         from ..vinyl.vinyl import Vinyl
         self.ctx = ctx
-        self.in_link = next(iter(ctx.in_rings))
+        self.in_link = _single({k: k for k in ctx.in_rings}, "in link",
+                               ctx.tile_name)
         self.ring = ctx.in_rings[self.in_link]
-        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
-        self.out_fseqs = _single(ctx.out_fseqs, "out link",
-                                 ctx.tile_name)
+        out_link = _single({k: k for k in ctx.out_rings}, "out link",
+                           ctx.tile_name)
+        self.out = ctx.out_rings[out_link]
+        self.out_fseqs = ctx.out_fseqs[out_link]
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
-        out_link = next(ln for ln in ctx.out_rings)
         self.out_mtu = ctx.plan["links"][out_link]["mtu"]
         self.db = Vinyl(args["path"])
         self.gc = bool(args.get("gc", True))
@@ -1668,6 +1669,12 @@ class VinylAdapter:
     def _serve(self, frame: bytes):
         if len(frame) < 41:
             self.m["errs"] += 1
+            if len(frame) >= 9:
+                # req_id parseable: answer ST_ERR so the client fails
+                # fast instead of burning its timeout (r4 review)
+                rid, = struct.unpack_from("<Q", frame, 1)
+                self.out.publish(struct.pack("<QB", rid, self.ST_ERR),
+                                 sig=rid)
             return
         op = frame[0]
         req_id, = struct.unpack_from("<Q", frame, 1)
@@ -1706,9 +1713,14 @@ class VinylAdapter:
         except Exception:
             resp = struct.pack("<QB", req_id, self.ST_ERR)
             self.m["errs"] += 1
+        # reliable (tile) consumers are credit-gated here; EXTERNAL
+        # clients have no fseq, so for them the cq is overrun-lossy
+        # like any unreliable link — the client's gather() sees the
+        # seq gap and must size cq depth >= its in-flight window
+        # (the _Client contract in tests/test_vinyl_tile.py)
         while self.out_fseqs and self.out.credits(self.out_fseqs) <= 0:
             self.m["backpressure"] += 1
-            time.sleep(50e-6)        # completions must not be dropped
+            time.sleep(50e-6)
         self.out.publish(resp, sig=req_id)
 
     def housekeeping(self):

@@ -1,0 +1,86 @@
+"""Feature gates — on-chain activation switches for consensus changes.
+
+The reference keeps a generated table of ~200 feature pubkeys and
+resolves each to its activation slot from the feature accounts at
+epoch boundaries (ref: src/flamenco/features/fd_features.h, generated
+fd_features table; runtime checks via FD_FEATURE_ACTIVE). Same model
+here: a feature is an account owned by the Feature program whose data
+is `u8 option-tag | u64 activated_at_slot`; a gate is active at slot S
+when its account says activated_at <= S.
+
+The named set below covers the gates this runtime actually branches
+on; unknown feature accounts are still readable through the generic
+API so fixtures can carry real mainnet feature pubkeys.
+"""
+from __future__ import annotations
+
+import struct
+
+from ..svm.accdb import Account
+from ..utils.base58 import b58_decode_32
+
+FEATURE_PROGRAM_ID = b58_decode_32(
+    "Feature111111111111111111111111111111111111")
+
+# named gates this runtime branches on (real mainnet feature ids)
+SECP256R1_PRECOMPILE = b58_decode_32(
+    "sr11RdZWgbHTHxSroPALe6zgaT5A1K9LcE4nfsZS4gi")
+PARTITIONED_EPOCH_REWARDS = b58_decode_32(
+    "9bn2vTJUsUcnpiZWbu2woSKtTGW3ErZC9ERv88SDqQjK")
+
+KNOWN = {
+    "secp256r1_precompile": SECP256R1_PRECOMPILE,
+    "partitioned_epoch_rewards": PARTITIONED_EPOCH_REWARDS,
+}
+
+
+def encode_feature(activated_at: int | None) -> bytes:
+    """Agave Feature bincode: Option<u64> activated_at."""
+    if activated_at is None:
+        return b"\x00"
+    return b"\x01" + struct.pack("<Q", activated_at)
+
+
+def decode_feature(data: bytes) -> int | None:
+    if not data or data[0] == 0:
+        return None
+    if len(data) < 9:
+        return None
+    return struct.unpack_from("<Q", data, 1)[0]
+
+
+def activate(funk, xid, feature_id: bytes, slot: int):
+    """Write the feature account as activated at `slot` (genesis/test
+    plumbing; on a live cluster activation lands via governance)."""
+    funk.rec_write(xid, feature_id, Account(
+        1, bytearray(encode_feature(slot)), FEATURE_PROGRAM_ID))
+
+
+def activation_slot(db, xid, feature_id: bytes) -> int | None:
+    acct = db.peek(xid, feature_id)
+    if acct is None or acct.owner != FEATURE_PROGRAM_ID:
+        return None
+    return decode_feature(bytes(acct.data))
+
+
+def is_active(db, xid, feature_id: bytes, slot: int) -> bool:
+    at = activation_slot(db, xid, feature_id)
+    return at is not None and at <= slot
+
+
+class FeatureSet:
+    """Slot-resolved snapshot of every named gate (the reference's
+    fd_features_t: resolved once per epoch boundary, read hot)."""
+
+    def __init__(self, db, xid, slot: int):
+        self.slot = slot
+        self.active = {
+            name: is_active(db, xid, fid, slot)
+            for name, fid in KNOWN.items()
+        }
+
+    def __getattr__(self, name: str) -> bool:
+        try:
+            return self.__dict__["active"][name]
+        except KeyError:
+            raise AttributeError(name) from None

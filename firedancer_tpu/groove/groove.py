@@ -33,7 +33,9 @@ import os
 import struct
 import zlib
 
-MAGIC = 0x67726F6F          # "groo"
+MAGIC = 0x67726F32          # "gro2" — v2 layout (lsn in the header)
+MAGIC_V1 = 0x67726F6F       # pre-lsn layout: refused loudly, never
+#                             silently misread as torn (r4 review)
 ST_LIVE = 1
 ST_DEAD = 2
 
@@ -106,6 +108,10 @@ class GrooveStore:
         while off + _HDR_SZ <= VOLUME_SZ:
             magic, state, cls, _, key, dlen, lsn = struct.unpack_from(
                 _HDR, vol.mm, off)
+            if magic == MAGIC_V1:
+                raise GrooveError(
+                    f"{vol.path}: v1 groove volume (pre-lsn layout) — "
+                    f"refusing to misread it; migrate or remove")
             if magic != MAGIC:
                 break                         # frontier reached
             if not MIN_CLASS <= cls <= MAX_CLASS:

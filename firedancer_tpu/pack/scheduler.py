@@ -149,13 +149,18 @@ class PackScheduler:
         self._out_w = [0] * bank_cnt
         self._out_r = [0] * bank_cnt
         self._out_txns: list[list[TxnMeta]] = [[] for _ in range(bank_cnt)]
+        # bundles: FIFO of ordered txn groups awaiting atomic placement
+        # (ref: fd_pack bundle support — a bundle is never reordered,
+        # never split, and outranks the regular pending pool)
+        self._bundles: list[list[TxnMeta]] = []
         # block accounting
         self.block_cost = 0
         self.block_vote_cost = 0
         self.block_microblocks = 0
         self._acct_write_cost: dict[bytes, int] = {}
         self.metrics = {"inserted": 0, "scheduled": 0, "microblocks": 0,
-                        "conflict_skip": 0, "limit_skip": 0}
+                        "conflict_skip": 0, "limit_skip": 0,
+                        "bundles": 0, "bundle_skip": 0}
 
     # -- insert -----------------------------------------------------------
 
@@ -180,6 +185,95 @@ class PackScheduler:
 
     def insert_payload(self, payload: bytes) -> int:
         return self.insert(meta_from_payload(payload))
+
+    MAX_BUNDLE_TXNS = 5            # the reference's bundle size cap
+
+    def insert_bundle(self, metas: list[TxnMeta]) -> int:
+        """Queue an ordered atomic group (ref: fd_pack bundles — the
+        Jito contract: executes in exactly this order, in one
+        microblock, whole or not at all; intra-bundle account
+        conflicts are expected and legal because the bank executes a
+        bundle serially). Returns the bundle's queue position."""
+        if not 1 <= len(metas) <= self.MAX_BUNDLE_TXNS:
+            raise ValueError(f"bundle size {len(metas)}")
+        # reject bundles that could NEVER schedule (limits end_block()
+        # cannot relax) — otherwise the FIFO head wedges forever and
+        # head-of-line-blocks every later bundle (r4 review)
+        g_cost = sum(m.cost for m in metas)
+        g_bytes = sum(2 + len(m.payload) for m in metas)
+        if g_cost > self.limits.max_cost_per_block:
+            raise ValueError(f"bundle cost {g_cost} can never fit a block")
+        if g_bytes > self.limits.max_data_bytes_per_microblock:
+            raise ValueError(f"bundle bytes {g_bytes} exceed a microblock")
+        g_acct: dict[bytes, int] = {}
+        for m in metas:
+            for k in m.writes:
+                g_acct[k] = g_acct.get(k, 0) + m.cost
+        for k, c in g_acct.items():
+            if c > self.limits.max_write_cost_per_acct:
+                raise ValueError("bundle exceeds per-account write cost")
+        for meta in metas:
+            meta.seq = self._seq
+            self._seq += 1
+            meta.w_mask = 0
+            meta.r_mask = 0
+            for k in meta.writes:
+                meta.w_mask |= 1 << self._bits.acquire(k)
+            for k in meta.reads:
+                meta.r_mask |= 1 << self._bits.acquire(k)
+        self._bundles.append(list(metas))
+        self.metrics["inserted"] += len(metas)
+        return len(self._bundles) - 1
+
+    def _try_bundle(self, bank: int, out_w: int,
+                    out_rw: int) -> list[TxnMeta] | None:
+        """Oldest bundle -> its own microblock when it fits, whole or
+        not at all. Conflicts are judged against OTHER banks only;
+        intra-bundle overlap is the point of a bundle."""
+        if not self._bundles:
+            return None
+        mb = self._bundles[0]
+        g_w = g_r = 0
+        g_cost = g_vote = 0
+        g_bytes = 0
+        g_acct: dict[bytes, int] = {}
+        for meta in mb:
+            g_w |= meta.w_mask
+            g_r |= meta.r_mask
+            g_cost += meta.cost
+            if meta.is_vote:
+                g_vote += meta.cost
+            for k in meta.writes:
+                g_acct[k] = g_acct.get(k, 0) + meta.cost
+            g_bytes += 2 + len(meta.payload)
+        if (g_w & out_rw) or (g_r & out_w):
+            self.metrics["bundle_skip"] += 1
+            return None
+        if self.block_cost + g_cost > self.limits.max_cost_per_block \
+                or self.block_vote_cost + g_vote \
+                > self.limits.max_vote_cost_per_block \
+                or g_bytes > self.limits.max_data_bytes_per_microblock:
+            self.metrics["bundle_skip"] += 1
+            return None
+        for k, c in g_acct.items():
+            if self._acct_write_cost.get(k, 0) + c \
+                    > self.limits.max_write_cost_per_acct:
+                self.metrics["bundle_skip"] += 1
+                return None
+        self._bundles.pop(0)
+        self._out_w[bank] = g_w
+        self._out_r[bank] = g_r
+        self._out_txns[bank] = mb
+        self.block_cost += g_cost
+        self.block_vote_cost += g_vote
+        self.block_microblocks += 1
+        for k, c in g_acct.items():
+            self._acct_write_cost[k] = \
+                self._acct_write_cost.get(k, 0) + c
+        self.metrics["scheduled"] += len(mb)
+        self.metrics["microblocks"] += 1
+        self.metrics["bundles"] += 1
+        return mb
 
     @property
     def pending_cnt(self) -> int:
@@ -220,6 +314,12 @@ class PackScheduler:
                 continue
             out_w |= self._out_w[b]
             out_rw |= self._out_w[b] | self._out_r[b]
+
+        # bundles outrank the pending pool and occupy a microblock
+        # exclusively (never mixed, never reordered, never split)
+        bundle = self._try_bundle(bank, out_w, out_rw)
+        if bundle is not None:
+            return bundle
 
         chosen: list[tuple[float, int, int]] = []
         skipped: list[tuple[float, int, int]] = []

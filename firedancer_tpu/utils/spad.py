@@ -1,0 +1,77 @@
+"""spad — stack-of-frames scratch allocator.
+
+Re-expression of the reference's per-tile scratch pads
+(ref: src/util/spad/fd_spad.h — push/pop frames over one region,
+allocations die with their frame; src/util/scratch/fd_scratch.h is
+the same discipline). Python tiles mostly lean on the GC, but the
+native-boundary paths (packing buffers for rings, staging device
+uploads) want exactly this: zero-fragmentation bump allocation with
+O(1) bulk free at frame pop, and a hard cap that surfaces runaway
+usage as an error instead of silent growth.
+"""
+from __future__ import annotations
+
+
+class SpadError(RuntimeError):
+    pass
+
+
+class Spad:
+    def __init__(self, size: int):
+        self.buf = bytearray(size)
+        self.size = size
+        self.cursor = 0
+        self._frames: list[int] = []
+        self.peak = 0                  # high-water mark (diagnostics)
+
+    # -- frames -------------------------------------------------------------
+
+    def frame_push(self):
+        self._frames.append(self.cursor)
+
+    def frame_pop(self):
+        if not self._frames:
+            raise SpadError("frame_pop with no frame")
+        self.cursor = self._frames.pop()
+
+    @property
+    def frame_depth(self) -> int:
+        return len(self._frames)
+
+    # -- alloc --------------------------------------------------------------
+
+    def alloc(self, sz: int, align: int = 8) -> memoryview:
+        """Bump-allocate sz bytes at the given power-of-two alignment;
+        the view dies with the enclosing frame (callers must not hold
+        it across frame_pop — same borrow discipline as accdb.peek)."""
+        if align < 1 or align & (align - 1):
+            raise SpadError(f"alignment {align} not a power of two")
+        start = (self.cursor + align - 1) & ~(align - 1)
+        end = start + sz
+        if end > self.size:
+            raise SpadError(
+                f"spad exhausted: want {sz} at {start}, cap {self.size}")
+        self.cursor = end
+        self.peak = max(self.peak, end)
+        return memoryview(self.buf)[start:end]
+
+    def in_use(self) -> int:
+        return self.cursor
+
+    def reset(self):
+        self.cursor = 0
+        self._frames.clear()
+
+
+def with_frame(spad: Spad):
+    """Context manager: `with with_frame(spad): ...` pops on exit even
+    on error (the reference's FD_SPAD_FRAME macro role)."""
+    class _F:
+        def __enter__(self):
+            spad.frame_push()
+            return spad
+
+        def __exit__(self, *exc):
+            spad.frame_pop()
+            return False
+    return _F()

@@ -59,16 +59,20 @@ def parse_routes(text: str) -> list[Route]:
 
 
 def parse_neigh(text: str) -> dict[int, tuple[str, str]]:
-    """Parse /proc/net/arp -> {ip: (mac, device)}."""
+    """Parse /proc/net/arp -> {ip: (mac, device)}. Only COMPLETE
+    entries (ATF_COM, flags 0x2) are kept — an in-progress entry's
+    all-zero MAC must read as unresolved, not as a destination."""
     out = {}
     for line in text.splitlines()[1:]:
         f = line.split()
         if len(f) < 6:
             continue
         try:
+            if not int(f[2], 16) & 0x2:       # ATF_COM
+                continue
             ip = struct.unpack(
                 ">I", socket.inet_aton(f[0]))[0]
-        except OSError:
+        except (OSError, ValueError):
             continue
         out[ip] = (f[3], f[5])
     return out

@@ -55,8 +55,11 @@ def test_metric_tiebreak_same_prefix():
 
 def test_parse_neigh():
     nt = NeighTable(parse_neigh(ARP_FIXTURE))
-    assert len(nt) == 2
+    # the incomplete (flags 0x0, zero-MAC) entry is filtered: an
+    # in-progress neighbor reads as unresolved
+    assert len(nt) == 1
     assert nt.mac_of("172.17.0.1") == "02:42:ac:11:00:01"
+    assert nt.mac_of("172.17.0.9") is None
     assert nt.mac_of("10.0.0.1") is None
 
 

@@ -190,3 +190,80 @@ def test_bitset_bit_reuse():
     s.insert(mk_meta([2]))        # same account -> same bit
     mb = s.schedule_microblock(0)
     assert len(mb) == 1           # second write-2 txn must conflict
+
+
+def _meta(payload_tag, writes=(), reads=(), reward=1000, cost=1000,
+          vote=False):
+    from firedancer_tpu.pack.scheduler import TxnMeta
+    return TxnMeta(payload=bytes([payload_tag]) * 40, txn=None,
+                   writes=tuple(writes), reads=tuple(reads), cost=cost,
+                   reward=reward, is_vote=vote)
+
+
+def test_bundle_atomic_ordered_exclusive():
+    """Bundles (ref: fd_pack bundle contract): never reordered, never
+    split, own microblock, outrank the pool, intra-bundle conflicts
+    legal."""
+    from firedancer_tpu.pack.scheduler import PackScheduler
+    s = PackScheduler(bank_cnt=2)
+    A, B = b"\xaa" * 32, b"\xbb" * 32
+    # a high-reward regular txn that would normally be scheduled first
+    s.insert(_meta(9, writes=[b"\xcc" * 32], reward=10**9))
+    # bundle with INTERNAL conflicts (all write A), ordered 1,2,3
+    bundle = [_meta(1, writes=[A]), _meta(2, writes=[A, B]),
+              _meta(3, writes=[A])]
+    s.insert_bundle(bundle)
+    mb = s.schedule_microblock(0)
+    # the bundle wins and is exclusive + in order
+    assert [m.payload[0] for m in mb] == [1, 2, 3]
+    assert s.metrics["bundles"] == 1
+    # other banks cannot touch the bundle's accounts while in flight
+    s.insert(_meta(7, writes=[B]))
+    mb2 = s.schedule_microblock(1)
+    assert [m.payload[0] for m in mb2] == [9]       # the regular txn
+    s.microblock_done(0)
+    s.microblock_done(1)
+    mb3 = s.schedule_microblock(1)
+    assert [m.payload[0] for m in mb3] == [7]
+
+
+def test_bundle_whole_or_not_at_all():
+    """A bundle that conflicts with an outstanding microblock is
+    deferred entirely — no partial placement."""
+    from firedancer_tpu.pack.scheduler import PackScheduler
+    s = PackScheduler(bank_cnt=2)
+    A = b"\xaa" * 32
+    s.insert(_meta(5, writes=[A]))
+    mb = s.schedule_microblock(0)
+    assert [m.payload[0] for m in mb] == [5]
+    s.insert_bundle([_meta(1, writes=[b"\x01" * 32]),
+                     _meta(2, writes=[A])])       # txn 2 conflicts
+    assert s.schedule_microblock(1) == []
+    assert s.metrics["bundle_skip"] >= 1
+    s.microblock_done(0)
+    mb2 = s.schedule_microblock(1)
+    assert [m.payload[0] for m in mb2] == [1, 2]  # now placed whole
+
+
+def test_bundle_size_cap():
+    import pytest as _pt
+    from firedancer_tpu.pack.scheduler import PackScheduler
+    s = PackScheduler()
+    with _pt.raises(ValueError):
+        s.insert_bundle([_meta(i) for i in range(6)])
+    with _pt.raises(ValueError):
+        s.insert_bundle([])
+
+
+def test_unschedulable_bundle_rejected_at_insert():
+    """Bundles whose limits can NEVER be met are refused up front —
+    they must not wedge the FIFO head (r4 review)."""
+    import pytest as _pt
+    from firedancer_tpu.pack.scheduler import PackScheduler
+    s = PackScheduler()
+    with _pt.raises(ValueError, match="cost"):
+        s.insert_bundle([_meta(i, cost=10_000_000, writes=[bytes([i]) * 32])
+                         for i in range(5)])
+    # a legal bundle inserted AFTER a rejection still schedules
+    s.insert_bundle([_meta(1, writes=[b"\x01" * 32])])
+    assert [m.payload[0] for m in s.schedule_microblock(0)] == [1]

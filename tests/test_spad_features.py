@@ -1,0 +1,67 @@
+"""spad frame allocator + feature gates (ref: src/util/spad/fd_spad.h,
+src/flamenco/features/fd_features.h)."""
+import pytest
+
+from firedancer_tpu.flamenco import features as ft
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.svm.accdb import AccDb
+from firedancer_tpu.utils.spad import Spad, SpadError, with_frame
+
+
+def test_spad_frames_and_alignment():
+    sp = Spad(1024)
+    sp.frame_push()
+    a = sp.alloc(10)
+    a[:] = b"\x11" * 10
+    b = sp.alloc(5, align=64)
+    b[:] = b"\x22" * 5
+    # alignment honored: the allocation started on a 64-byte boundary
+    assert (sp.cursor - 5) % 64 == 0
+    used_inner = sp.in_use()
+    sp.frame_push()
+    sp.alloc(100)
+    sp.frame_pop()
+    assert sp.in_use() == used_inner     # bulk free at pop
+    sp.frame_pop()
+    assert sp.in_use() == 0
+    assert sp.peak >= 100
+
+
+def test_spad_exhaustion_and_errors():
+    sp = Spad(64)
+    with pytest.raises(SpadError):
+        sp.alloc(100)
+    with pytest.raises(SpadError):
+        sp.alloc(8, align=3)
+    with pytest.raises(SpadError):
+        sp.frame_pop()
+
+
+def test_spad_with_frame_pops_on_error():
+    sp = Spad(256)
+    with pytest.raises(RuntimeError, match="boom"):
+        with with_frame(sp):
+            sp.alloc(64)
+            raise RuntimeError("boom")
+    assert sp.in_use() == 0 and sp.frame_depth == 0
+
+
+def test_feature_roundtrip_and_gating():
+    assert ft.decode_feature(ft.encode_feature(None)) is None
+    assert ft.decode_feature(ft.encode_feature(123)) == 123
+
+    funk = Funk()
+    funk.txn_prepare(None, "blk")
+    db = AccDb(funk)
+    fid = ft.SECP256R1_PRECOMPILE
+    assert not ft.is_active(db, "blk", fid, slot=50)
+    ft.activate(funk, "blk", fid, slot=100)
+    assert ft.activation_slot(db, "blk", fid) == 100
+    assert not ft.is_active(db, "blk", fid, slot=99)
+    assert ft.is_active(db, "blk", fid, slot=100)
+
+    fs = ft.FeatureSet(db, "blk", slot=200)
+    assert fs.secp256r1_precompile
+    assert not fs.partitioned_epoch_rewards
+    with pytest.raises(AttributeError):
+        fs.not_a_feature
