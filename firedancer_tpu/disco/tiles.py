@@ -232,7 +232,8 @@ class PackAdapter:
     fallback for poh-less topologies)."""
 
     METRICS = ["rx", "parse_fail", "inserted", "scheduled", "microblocks",
-               "completions", "blocks", "backpressure", "overruns"]
+               "completions", "blocks", "backpressure", "overruns",
+               "bundles", "bundle_rejects"]
 
     def __init__(self, ctx, args):
         from ..pack import PackScheduler, PackLimits
@@ -252,6 +253,7 @@ class PackAdapter:
                     args.get("max_txn_per_microblock", 31)),
                 max_data_bytes_per_microblock=mtu - 20))
         self.slot_in = args.get("slot_in")
+        self.bundle_in = args.get("bundle_in")
         self.slot_ms = float(args.get("slot_ms", 400.0))
         self._slot_t0 = time.monotonic()
         self.batch = int(args.get("batch", 64))
@@ -299,6 +301,32 @@ class PackAdapter:
                 self.m["parse_fail"] += 1
         self.m["rx"] += n
         total += n
+        # 2a) bundle ingest (ordered atomic groups from the bundle
+        # tile; wire: u8 count | count x (u16 len | payload))
+        if self.bundle_in:
+            ring = self.ctx.in_rings[self.bundle_in]
+            k, self.seqs[self.bundle_in], buf, sizes, sigs, ovr = \
+                ring.gather(self.seqs[self.bundle_in], 8,
+                            self.ctx.plan["links"][self.bundle_in]["mtu"])
+            self.m["overruns"] += ovr
+            for i in range(k):
+                frame = bytes(buf[i, :sizes[i]])
+                try:
+                    metas = []
+                    cnt = frame[0]
+                    off = 1
+                    for _ in range(cnt):
+                        (ln2,) = struct.unpack_from("<H", frame, off)
+                        off += 2
+                        metas.append(self._meta_from_payload(
+                            frame[off:off + ln2]))
+                        off += ln2
+                    self.sched.insert_bundle(metas)
+                    self.m["bundles"] += 1
+                    self.m["inserted"] += cnt
+                except Exception:
+                    self.m["bundle_rejects"] += 1
+            total += k
         # 2b) PoH slot boundaries (tick-count-driven, not wall clock)
         if self.slot_in:
             ring = self.ctx.in_rings[self.slot_in]
@@ -1579,6 +1607,214 @@ class MetricAdapter:
         return {"port": self.port, "scrapes": self.scrapes}
 
 
+@register("bundle")
+class BundleAdapter:
+    """Block-engine bundle ingest (ref: src/disco/bundle/
+    fd_bundle_tile.c — a gRPC client subscribing to the Jito block
+    engine and forwarding bundles to pack). Transport is the real
+    thing (waltz/h2.py + waltz/grpc.py over TCP); the SCHEMA is this
+    framework's own minimal proto (documented divergence: Jito's
+    .proto tree is not vendored): a SubscribeBundles response message
+    is `repeated bytes packets = 1` — one serialized txn per entry.
+
+    The gRPC stream runs on a daemon thread feeding a local queue; the
+    tile loop drains it into pack's bundle_in wire format
+    (u8 count | count x (u16 len | payload)). Reconnects with backoff.
+
+    args: engine ("host:port"), path, authority."""
+
+    METRICS = ["bundles", "txns", "reconnects", "errors",
+               "backpressure"]
+
+    def __init__(self, ctx, args):
+        import queue
+        import threading
+        self.ctx = ctx
+        host, _, port = args["engine"].rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.path = args.get("path",
+                             "/fdtpu.BlockEngine/SubscribeBundles")
+        self.authority = args.get("authority", "block-engine")
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.out_fseqs = _single(ctx.out_fseqs, "out link",
+                                 ctx.tile_name)
+        self.mtu = ctx.plan["links"][
+            next(iter(ctx.out_rings))]["mtu"]
+        self.q: "queue.Queue[list[bytes]]" = queue.Queue(maxsize=256)
+        self._head: list[bytes] | None = None   # backpressured bundle
+        self.m = {k: 0 for k in self.METRICS}
+        self._halt = False
+        self.thread = threading.Thread(target=self._stream_loop,
+                                       daemon=True)
+        self.thread.start()
+
+    def _stream_loop(self):
+        import time as _t
+        from ..waltz.grpc import GrpcClient, GrpcError, pb_decode
+        backoff = 0.2
+        while not self._halt:
+            try:
+                cli = GrpcClient(self.addr, timeout=5.0)
+                _, nxt = cli.open_server_stream(self.authority,
+                                                self.path, b"")
+                backoff = 0.2
+                while not self._halt:
+                    msg = nxt(timeout=5.0)
+                    if msg is None:
+                        break
+                    txns = [v for v in pb_decode(msg).get(1, [])
+                            if isinstance(v, bytes)]
+                    if len(txns) > 5:
+                        # a bundle is <=5 txns (pack.MAX_BUNDLE_TXNS);
+                        # an oversized message is remote garbage, not
+                        # a tile crash
+                        self.m["errors"] += 1
+                        continue
+                    if txns:
+                        self.q.put(txns, timeout=5.0)
+                cli.close()
+            except (OSError, GrpcError, Exception):  # noqa: BLE001
+                self.m["errors"] += 1
+            if not self._halt:
+                _t.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                self.m["reconnects"] += 1
+
+    def poll_once(self) -> int:
+        import queue
+        n = 0
+        while n < 8:
+            if self._head is not None:
+                txns = self._head       # retry the backpressured head
+            else:
+                try:
+                    txns = self.q.get_nowait()
+                except queue.Empty:
+                    break
+            frame = bytearray([len(txns)])
+            for t in txns:
+                frame += struct.pack("<H", len(t)) + t
+            if len(frame) > self.mtu:
+                self.m["errors"] += 1
+                self._head = None
+                continue
+            if self.out_fseqs and self.out.credits(self.out_fseqs) <= 0:
+                self.m["backpressure"] += 1
+                # hold the HEAD locally — re-putting into the queue
+                # would reorder behind later bundles (and a blocking
+                # put could deadlock against the stream thread)
+                self._head = txns
+                break
+            self.out.publish(bytes(frame), sig=self.m["bundles"])
+            self._head = None
+            self.m["bundles"] += 1
+            self.m["txns"] += len(txns)
+            n += 1
+        return n
+
+    def on_halt(self):
+        self._halt = True
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("plugin")
+class PluginAdapter:
+    """External-consumer event bridge (ref: src/disco/plugin/
+    fd_plugin_tile.c — forwards validator data out-of-process for the
+    GUI/Agave side; here an NDJSON stream over a unix socket, the
+    python-idiomatic out-of-process seam). Every consumed frag becomes
+    one event line {link, seq, sig, sz, data(hex, truncated)}; slow or
+    dead clients are dropped, never block the tile (the reference's
+    non-blocking plugin discipline).
+
+    args: sock_path (unix socket), data_hex_max (payload prefix)."""
+
+    METRICS = ["rx", "events", "clients", "dropped", "overruns"]
+    GAUGES = ["clients"]
+
+    def __init__(self, ctx, args):
+        import socket as _s
+        self.ctx = ctx
+        self.path = args["sock_path"]
+        self.hex_max = int(args.get("data_hex_max", 64))
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        self.srv = _s.socket(_s.AF_UNIX, _s.SOCK_STREAM)
+        self.srv.bind(self.path)
+        self.srv.listen(8)
+        self.srv.setblocking(False)
+        self.clients: list = []
+        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.mtus = {ln: ctx.plan["links"][ln]["mtu"]
+                     for ln in ctx.in_rings}
+        self.m = {k: 0 for k in self.METRICS}
+
+    def _accept(self):
+        while True:
+            try:
+                c, _ = self.srv.accept()
+            except OSError:
+                return
+            c.setblocking(False)
+            self.clients.append(c)
+
+    def _emit(self, obj):
+        if not self.clients:
+            return
+        line = (json.dumps(obj) + "\n").encode()
+        alive = []
+        for c in self.clients:
+            try:
+                c.sendall(line)
+                alive.append(c)
+            except BlockingIOError:
+                self.m["dropped"] += 1       # slow consumer: drop it
+                c.close()
+            except OSError:
+                c.close()
+        self.clients = alive
+        self.m["events"] += 1
+
+    def poll_once(self) -> int:
+        self._accept()
+        total = 0
+        for ln, ring in self.ctx.in_rings.items():
+            n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
+                self.seqs[ln], 16, self.mtus[ln])
+            self.m["overruns"] += ovr
+            for i in range(n):
+                frame = bytes(buf[i, :sizes[i]])
+                self.m["rx"] += 1
+                self._emit({"link": ln, "sig": int(sigs[i]),
+                            "sz": len(frame),
+                            "data": frame[:self.hex_max].hex()})
+            total += n
+        return total
+
+    def housekeeping(self):
+        self._accept()
+        self.m["clients"] = len(self.clients)
+
+    def in_seqs(self):
+        return self.seqs
+
+    def on_halt(self):
+        for c in self.clients:
+            c.close()
+        self.srv.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
 @register("netlnk")
 class NetlnkAdapter:
     """Kernel route/neighbor table mirror (ref: src/disco/netlink/
@@ -1671,10 +1907,11 @@ class VinylAdapter:
             self.m["errs"] += 1
             if len(frame) >= 9:
                 # req_id parseable: answer ST_ERR so the client fails
-                # fast instead of burning its timeout (r4 review)
+                # fast instead of burning its timeout (r4 review) —
+                # through the same credit gate as every completion
                 rid, = struct.unpack_from("<Q", frame, 1)
-                self.out.publish(struct.pack("<QB", rid, self.ST_ERR),
-                                 sig=rid)
+                self._publish_completion(
+                    struct.pack("<QB", rid, self.ST_ERR), rid)
             return
         op = frame[0]
         req_id, = struct.unpack_from("<Q", frame, 1)
@@ -1713,6 +1950,9 @@ class VinylAdapter:
         except Exception:
             resp = struct.pack("<QB", req_id, self.ST_ERR)
             self.m["errs"] += 1
+        self._publish_completion(resp, req_id)
+
+    def _publish_completion(self, resp: bytes, req_id: int):
         # reliable (tile) consumers are credit-gated here; EXTERNAL
         # clients have no fseq, so for them the cq is overrun-lossy
         # like any unreliable link — the client's gather() sees the

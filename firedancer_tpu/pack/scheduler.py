@@ -200,9 +200,13 @@ class PackScheduler:
         # cannot relax) — otherwise the FIFO head wedges forever and
         # head-of-line-blocks every later bundle (r4 review)
         g_cost = sum(m.cost for m in metas)
+        g_vote = sum(m.cost for m in metas if m.is_vote)
         g_bytes = sum(2 + len(m.payload) for m in metas)
         if g_cost > self.limits.max_cost_per_block:
             raise ValueError(f"bundle cost {g_cost} can never fit a block")
+        if g_vote > self.limits.max_vote_cost_per_block:
+            raise ValueError(
+                f"bundle vote cost {g_vote} can never fit a block")
         if g_bytes > self.limits.max_data_bytes_per_microblock:
             raise ValueError(f"bundle bytes {g_bytes} exceed a microblock")
         g_acct: dict[bytes, int] = {}

@@ -131,6 +131,88 @@ def sys_get_return_data(vm, r1, r2, r3, r4, r5):
     return len(data)
 
 
+CURVE_EDWARDS = 0
+CURVE_RISTRETTO = 1
+CURVE_OP_ADD = 0
+CURVE_OP_SUB = 1
+CURVE_OP_MUL = 2
+CU_CURVE_VALIDATE = 159        # Agave's curve25519 cost constants
+CU_CURVE_OP = 473
+
+
+def sys_curve_validate_point(vm, r1, r2, r3, r4, r5):
+    """sol_curve_validate_point(curve_id, point_addr) -> 0 valid /
+    1 invalid (ref: src/flamenco/vm/syscall/fd_vm_syscall_curve.c)."""
+    vm.charge(CU_CURVE_VALIDATE)
+    pt = vm.mem_read(r2, 32)
+    if r1 == CURVE_EDWARDS:
+        from ..utils.ed25519_ref import pt_decompress
+        return 0 if pt_decompress(pt) is not None else 1
+    if r1 == CURVE_RISTRETTO:
+        from ..utils.ristretto import validate
+        return 0 if validate(pt) else 1
+    return 1
+
+
+def sys_curve_group_op(vm, r1, r2, r3, r4, r5):
+    """sol_curve_group_op(curve_id, op, left_addr, right_addr,
+    result_addr): ADD/SUB point⊕point, MUL scalar·point; writes 32
+    bytes on success, returns 0/1 (the Agave ABI)."""
+    vm.charge(CU_CURVE_OP)
+    left = vm.mem_read(r3, 32)
+    right = vm.mem_read(r4, 32)
+    if r1 == CURVE_EDWARDS:
+        from ..utils.ed25519_ref import (L, pt_add, pt_compress,
+                                         pt_decompress, pt_mul)
+
+        def dec(b):
+            return pt_decompress(b)
+
+        def enc(p):
+            return pt_compress(p)
+
+        def neg(p):
+            x, y, z, t = p
+            from ..utils.ed25519_ref import P as _P
+            return ((-x) % _P, y, z, (-t) % _P)
+        add_, mul_ = pt_add, pt_mul
+    elif r1 == CURVE_RISTRETTO:
+        from ..utils.ed25519_ref import L
+        from ..utils import ristretto as rr
+
+        def dec(b):
+            return rr.decode(b)
+
+        def enc(p):
+            return rr.encode(p)
+
+        def neg(p):
+            x, y, z, t = p
+            return ((-x) % rr.P, y, z, (-t) % rr.P)
+        add_, mul_ = rr.add, rr.mul
+    else:
+        return 1
+    if r2 in (CURVE_OP_ADD, CURVE_OP_SUB):
+        a = dec(left)
+        b = dec(right)
+        if a is None or b is None:
+            return 1
+        if r2 == CURVE_OP_SUB:
+            b = neg(b)
+        vm.mem_write(r5, enc(add_(a, b)))
+        return 0
+    if r2 == CURVE_OP_MUL:
+        scalar = int.from_bytes(left, "little")
+        if scalar >= L:
+            return 1               # non-canonical scalar rejected
+        p = dec(right)
+        if p is None:
+            return 1
+        vm.mem_write(r5, enc(mul_(scalar, p)))
+        return 0
+    return 1
+
+
 DEFAULT_SYSCALLS = {
     syscall_id(b"abort"): sys_abort,
     syscall_id(b"sol_log_"): sys_log,
@@ -143,4 +225,6 @@ DEFAULT_SYSCALLS = {
     syscall_id(b"sol_get_rent_sysvar"): sys_get_rent_sysvar,
     syscall_id(b"sol_set_return_data"): sys_set_return_data,
     syscall_id(b"sol_get_return_data"): sys_get_return_data,
+    syscall_id(b"sol_curve_validate_point"): sys_curve_validate_point,
+    syscall_id(b"sol_curve_group_op"): sys_curve_group_op,
 }

@@ -1,0 +1,305 @@
+"""gRPC over HTTP/2 + a minimal protobuf wire codec.
+
+The reference builds a purpose-scoped gRPC client for the Jito
+block-engine connection (ref: src/waltz/grpc/fd_grpc_client.c, used by
+src/disco/bundle/fd_bundle_tile.c) with nanopb as the protobuf codec
+(src/ballet/nanopb/). Same scope here: unary and server-streaming
+calls over waltz/h2.py, the 5-byte gRPC message framing, grpc-status
+trailers, and a tag/varint protobuf codec for the small messages the
+bundle path needs. TLS is out of scope for this transport (the
+reference terminates its bundle TLS in openssl glue; our endpoints are
+in-cluster links).
+
+Socket-owning helpers (`GrpcClient.call_unary` / `open_stream`) drive
+the transport-agnostic h2.Conn over a blocking TCP socket — the same
+event-loop-owns-the-socket pattern the tiles use.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import time
+
+from . import h2
+
+GRPC_OK = 0
+
+
+class GrpcError(RuntimeError):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"grpc-status {status}: {message}")
+        self.status = status
+
+
+# -- protobuf wire codec (nanopb role) --------------------------------------
+
+def pb_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def pb_read_varint(data: bytes, off: int) -> tuple[int, int]:
+    v = shift = 0
+    while True:
+        if off >= len(data):
+            raise ValueError("truncated varint")
+        b = data[off]
+        off += 1
+        v |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            return v, off
+
+
+def pb_field(num: int, value) -> bytes:
+    """int -> varint field; bytes/str -> length-delimited field."""
+    if isinstance(value, int):
+        return pb_varint(num << 3 | 0) + pb_varint(value)
+    if isinstance(value, str):
+        value = value.encode()
+    return pb_varint(num << 3 | 2) + pb_varint(len(value)) + value
+
+
+def pb_decode(data: bytes) -> dict[int, list]:
+    """-> {field_num: [values]} (varints as int, bytes as bytes)."""
+    out: dict[int, list] = {}
+    off = 0
+    while off < len(data):
+        key, off = pb_read_varint(data, off)
+        num, wire = key >> 3, key & 7
+        if wire == 0:
+            v, off = pb_read_varint(data, off)
+        elif wire == 2:
+            n, off = pb_read_varint(data, off)
+            if off + n > len(data):
+                raise ValueError("truncated field")
+            v = data[off:off + n]
+            off += n
+        elif wire == 5:
+            v = struct.unpack_from("<I", data, off)[0]
+            off += 4
+        elif wire == 1:
+            v = struct.unpack_from("<Q", data, off)[0]
+            off += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        out.setdefault(num, []).append(v)
+    return out
+
+
+# -- gRPC message framing ----------------------------------------------------
+
+def grpc_frame(msg: bytes) -> bytes:
+    return b"\x00" + struct.pack(">I", len(msg)) + msg
+
+
+def grpc_unframe(buf: bytearray) -> bytes | None:
+    """Pop one complete message from buf, or None."""
+    if len(buf) < 5:
+        return None
+    if buf[0] != 0:
+        raise GrpcError(12, "compressed messages unsupported")
+    n = struct.unpack_from(">I", buf, 1)[0]
+    if len(buf) < 5 + n:
+        return None
+    msg = bytes(buf[5:5 + n])
+    del buf[:5 + n]
+    return msg
+
+
+def _req_headers(authority: str, path: str):
+    return [(b":method", b"POST"), (b":scheme", b"http"),
+            (b":path", path.encode()),
+            (b":authority", authority.encode()),
+            (b"content-type", b"application/grpc"),
+            (b"te", b"trailers")]
+
+
+def _grpc_status(st: h2.Stream) -> tuple[int, str]:
+    hdrs = st.trailers or st.headers
+    status, msg = None, ""
+    for k, v in hdrs:
+        if k == b"grpc-status":
+            status = int(v)
+        elif k == b"grpc-message":
+            msg = v.decode(errors="replace")
+    return (status if status is not None else 2), msg
+
+
+class GrpcClient:
+    """Blocking client over one TCP connection."""
+
+    def __init__(self, addr: tuple, timeout: float = 10.0):
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.sock.settimeout(0.05)
+        self.conn = h2.Conn(is_client=True)
+        self._flush()
+
+    def _flush(self):
+        out = self.conn.take_tx()
+        if out:
+            self.sock.sendall(out)
+
+    def _pump(self):
+        try:
+            data = self.sock.recv(65536)
+            if data:
+                self.conn.feed(data)
+        except TimeoutError:
+            pass
+        self._flush()
+
+    def call_unary(self, authority: str, path: str, request: bytes,
+                   timeout: float = 15.0) -> bytes:
+        st = self.conn.open_stream(_req_headers(authority, path))
+        self.conn.send_data(st, grpc_frame(request), end_stream=True)
+        self._flush()
+        buf = bytearray()
+        deadline = time.monotonic() + timeout
+        reply = None
+        while time.monotonic() < deadline:
+            self._pump()
+            buf += st.data
+            st.data.clear()
+            m = grpc_unframe(buf)
+            if m is not None and reply is None:
+                reply = m
+            if st.remote_closed:
+                break
+        if not st.remote_closed:
+            raise GrpcError(4, "deadline exceeded")
+        status, msg = _grpc_status(st)
+        if status != GRPC_OK:
+            raise GrpcError(status, msg)
+        if reply is None:
+            raise GrpcError(13, "no response message")
+        return reply
+
+    def open_server_stream(self, authority: str, path: str,
+                           request: bytes):
+        """Server-streaming call: returns (stream, next_msg) where
+        next_msg(timeout) yields messages or None at end."""
+        st = self.conn.open_stream(_req_headers(authority, path))
+        self.conn.send_data(st, grpc_frame(request), end_stream=True)
+        self._flush()
+        buf = bytearray()
+
+        def next_msg(timeout: float = 10.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                m = grpc_unframe(buf)
+                if m is not None:
+                    return m
+                if st.remote_closed:
+                    status, msg = _grpc_status(st)
+                    if status != GRPC_OK:
+                        raise GrpcError(status, msg)
+                    return None
+                self._pump()
+                buf.extend(st.data)
+                st.data.clear()
+            raise GrpcError(4, "deadline exceeded")
+
+        return st, next_msg
+
+    def close(self):
+        self.sock.close()
+
+
+class GrpcServer:
+    """Minimal single-threaded server: handlers {path: fn(request
+    bytes) -> response bytes | iterable of responses}. Serves until
+    closed; one client at a time (test/tooling scope, mirroring the
+    reference's client-only production posture)."""
+
+    def __init__(self, handlers: dict, bind=("127.0.0.1", 0)):
+        self.handlers = handlers
+        self.lsock = socket.socket()
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind(bind)
+        self.lsock.listen(4)
+        self.port = self.lsock.getsockname()[1]
+        self._halt = False
+        import threading
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while not self._halt:
+            try:
+                self.lsock.settimeout(0.2)
+                sock, _ = self.lsock.accept()
+            except OSError:
+                continue
+            try:
+                self._serve_conn(sock)
+            except (OSError, h2.H2Error):
+                pass
+            finally:
+                sock.close()
+
+    def _serve_conn(self, sock):
+        sock.settimeout(0.05)
+        conn = h2.Conn(is_client=False)
+        served: set[int] = set()
+        bufs: dict[int, bytearray] = {}
+        idle_deadline = time.monotonic() + 30
+        while not self._halt and time.monotonic() < idle_deadline:
+            try:
+                data = sock.recv(65536)
+                if not data:
+                    return
+                conn.feed(data)
+                idle_deadline = time.monotonic() + 30
+            except TimeoutError:
+                pass
+            for sid, st in list(conn.streams.items()):
+                if sid in served or not st.remote_closed:
+                    continue
+                served.add(sid)
+                bufs.setdefault(sid, bytearray()).extend(st.data)
+                st.data.clear()
+                self._answer(conn, st, bufs[sid])
+            out = conn.take_tx()
+            if out:
+                sock.sendall(out)
+
+    def _answer(self, conn, st, buf):
+        path = dict(st.headers).get(b":path", b"").decode()
+        handler = self.handlers.get(path)
+        rsp_hdrs = [(b":status", b"200"),
+                    (b"content-type", b"application/grpc")]
+        if handler is None:
+            conn.send_headers(st, rsp_hdrs)
+            conn.send_headers(st, [(b"grpc-status", b"12")],
+                              end_stream=True)
+            return
+        req = grpc_unframe(buf)
+        try:
+            result = handler(req if req is not None else b"")
+        except Exception as e:  # noqa: BLE001 — surface as grpc-status
+            conn.send_headers(st, rsp_hdrs)
+            conn.send_headers(
+                st, [(b"grpc-status", b"13"),
+                     (b"grpc-message", str(e).encode()[:200])],
+                end_stream=True)
+            return
+        conn.send_headers(st, rsp_hdrs)
+        if isinstance(result, bytes):
+            conn.send_data(st, grpc_frame(result))
+        else:
+            for msg in result:
+                conn.send_data(st, grpc_frame(msg))
+        conn.send_headers(st, [(b"grpc-status", b"0")],
+                          end_stream=True)
+
+    def close(self):
+        self._halt = True
+        self.lsock.close()

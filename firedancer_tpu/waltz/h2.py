@@ -1,0 +1,274 @@
+"""HTTP/2 (RFC 9113) — the framing layer under the gRPC client.
+
+Scope matches the reference's h2 (ref: src/waltz/h2/fd_h2.c — a
+purpose-built client core for the bundle tile's gRPC connection, plus
+enough server to test against itself). Implemented: the connection
+preface, SETTINGS negotiation (we force HEADER_TABLE_SIZE=0 so HPACK
+stays stateless — waltz/hpack.py), HEADERS/DATA/CONTINUATION,
+WINDOW_UPDATE flow control on both levels, PING, RST_STREAM, GOAWAY.
+No push (disabled via SETTINGS), no priorities (ignored as RFC 9113
+deprecates them).
+
+Transport-agnostic: Conn consumes bytes via feed() and emits bytes via
+take_tx() so it runs over any socket the caller owns (the tile pattern
+— the reference drives fd_h2 from its own event loop the same way).
+"""
+from __future__ import annotations
+
+import struct
+
+from . import hpack
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+FT_DATA = 0x0
+FT_HEADERS = 0x1
+FT_PRIORITY = 0x2
+FT_RST_STREAM = 0x3
+FT_SETTINGS = 0x4
+FT_PUSH_PROMISE = 0x5
+FT_PING = 0x6
+FT_GOAWAY = 0x7
+FT_WINDOW_UPDATE = 0x8
+FT_CONTINUATION = 0x9
+
+F_END_STREAM = 0x1
+F_END_HEADERS = 0x4
+F_PADDED = 0x8
+F_PRIORITY = 0x20
+F_ACK = 0x1
+
+S_HEADER_TABLE_SIZE = 0x1
+S_ENABLE_PUSH = 0x2
+S_MAX_CONCURRENT = 0x3
+S_INITIAL_WINDOW = 0x4
+S_MAX_FRAME_SIZE = 0x5
+
+DEFAULT_WINDOW = 65535
+MAX_FRAME = 16384
+
+
+class H2Error(ConnectionError):
+    pass
+
+
+def frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
+    return (len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+            + struct.pack(">I", stream_id & 0x7FFFFFFF) + payload)
+
+
+class Stream:
+    def __init__(self, sid: int):
+        self.sid = sid
+        self.headers: list = []
+        self.trailers: list = []
+        self.data = bytearray()
+        self.remote_closed = False
+        self.local_closed = False
+        self.reset: int | None = None
+        self.send_window = DEFAULT_WINDOW
+        self._hdr_done = False
+        self._pend = bytearray()      # data awaiting window credit
+        self._pend_end = False
+
+
+class Conn:
+    """One HTTP/2 connection endpoint (client or server half)."""
+
+    def __init__(self, is_client: bool):
+        self.is_client = is_client
+        self.streams: dict[int, Stream] = {}
+        self.next_sid = 1 if is_client else 2
+        self.send_window = DEFAULT_WINDOW
+        self.recv_window = DEFAULT_WINDOW
+        self.peer_initial_window = DEFAULT_WINDOW
+        self.peer_max_frame = MAX_FRAME
+        self._rx = bytearray()
+        self._tx = bytearray()
+        self._preface_seen = is_client       # server must SEE the
+        #                                      client preface; the
+        #                                      client receives none
+        self._settings_acked = False
+        self.goaway: int | None = None
+        self._cont_sid = None               # CONTINUATION accumulation
+        self._cont_buf = b""
+        self._cont_flags = 0
+        if is_client:
+            self._tx += PREFACE
+        self._tx += frame(FT_SETTINGS, 0, 0, struct.pack(
+            ">HIHIHI", S_HEADER_TABLE_SIZE, 0, S_ENABLE_PUSH, 0,
+            S_INITIAL_WINDOW, DEFAULT_WINDOW))
+
+    # -- byte plumbing ------------------------------------------------------
+
+    def take_tx(self) -> bytes:
+        self._pump_sends()
+        out = bytes(self._tx)
+        self._tx.clear()
+        return out
+
+    def feed(self, data: bytes):
+        self._rx += data
+        if not self._preface_seen:
+            if len(self._rx) < len(PREFACE):
+                return
+            if not self._rx.startswith(PREFACE):
+                raise H2Error("bad client preface")
+            del self._rx[:len(PREFACE)]
+            self._preface_seen = True
+        while True:
+            if len(self._rx) < 9:
+                return
+            ln = int.from_bytes(self._rx[:3], "big")
+            if len(self._rx) < 9 + ln:
+                return
+            ftype, flags = self._rx[3], self._rx[4]
+            sid = struct.unpack_from(">I", self._rx, 5)[0] & 0x7FFFFFFF
+            payload = bytes(self._rx[9:9 + ln])
+            del self._rx[:9 + ln]
+            self._on_frame(ftype, flags, sid, payload)
+
+    # -- frame handling -----------------------------------------------------
+
+    def _on_frame(self, ftype, flags, sid, payload):
+        if self._cont_sid is not None and ftype != FT_CONTINUATION:
+            raise H2Error("expected CONTINUATION")
+        if ftype == FT_SETTINGS:
+            if flags & F_ACK:
+                self._settings_acked = True
+                return
+            off = 0
+            while off + 6 <= len(payload):
+                k, v = struct.unpack_from(">HI", payload, off)
+                off += 6
+                if k == S_INITIAL_WINDOW:
+                    delta = v - self.peer_initial_window
+                    self.peer_initial_window = v
+                    for st in self.streams.values():
+                        st.send_window += delta
+                elif k == S_MAX_FRAME_SIZE:
+                    self.peer_max_frame = max(MAX_FRAME, min(v, 1 << 24))
+            self._tx += frame(FT_SETTINGS, F_ACK, 0, b"")
+        elif ftype == FT_PING:
+            if not flags & F_ACK:
+                self._tx += frame(FT_PING, F_ACK, 0, payload[:8])
+        elif ftype == FT_WINDOW_UPDATE:
+            inc = struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF
+            if sid == 0:
+                self.send_window += inc
+            elif sid in self.streams:
+                self.streams[sid].send_window += inc
+            self._pump_sends()
+        elif ftype == FT_GOAWAY:
+            self.goaway = struct.unpack_from(">I", payload, 4)[0]
+        elif ftype == FT_RST_STREAM:
+            st = self.streams.get(sid)
+            if st is not None:
+                st.reset = struct.unpack(">I", payload[:4])[0]
+                st.remote_closed = True
+        elif ftype == FT_HEADERS:
+            body = payload
+            if flags & F_PADDED:
+                pad = body[0]
+                body = body[1:len(body) - pad]
+            if flags & F_PRIORITY:
+                body = body[5:]
+            if flags & F_END_HEADERS:
+                self._on_headers(sid, body, flags)
+            else:
+                self._cont_sid = sid
+                self._cont_buf = body
+                self._cont_flags = flags
+        elif ftype == FT_CONTINUATION:
+            if sid != self._cont_sid:
+                raise H2Error("CONTINUATION stream mismatch")
+            self._cont_buf += payload
+            if flags & F_END_HEADERS:
+                csid, cbuf = self._cont_sid, self._cont_buf
+                cflags = self._cont_flags
+                self._cont_sid, self._cont_buf = None, b""
+                self._on_headers(csid, cbuf, cflags)
+        elif ftype == FT_DATA:
+            st = self.streams.get(sid)
+            if st is None:
+                return
+            body = payload
+            if flags & F_PADDED:
+                pad = body[0]
+                body = body[1:len(body) - pad]
+            st.data += body
+            # liberal flow control: replenish both windows immediately
+            if len(payload):
+                upd = struct.pack(">I", len(payload))
+                self._tx += frame(FT_WINDOW_UPDATE, 0, 0, upd)
+                self._tx += frame(FT_WINDOW_UPDATE, 0, sid, upd)
+            if flags & F_END_STREAM:
+                st.remote_closed = True
+        elif ftype == FT_PUSH_PROMISE:
+            raise H2Error("push disabled")
+        # PRIORITY and unknown frame types are ignored
+
+    def _on_headers(self, sid, block, flags):
+        st = self.streams.get(sid)
+        if st is None:
+            st = self.streams[sid] = Stream(sid)
+        hdrs = hpack.decode(block)
+        if st._hdr_done:
+            st.trailers = hdrs
+        else:
+            st.headers = hdrs
+            st._hdr_done = True
+        if flags & F_END_STREAM:
+            st.remote_closed = True
+
+    # -- sending ------------------------------------------------------------
+
+    def open_stream(self, headers: list[tuple[bytes, bytes]],
+                    end_stream: bool = False) -> Stream:
+        sid = self.next_sid
+        self.next_sid += 2
+        st = self.streams[sid] = Stream(sid)
+        st.send_window = self.peer_initial_window
+        flags = F_END_HEADERS | (F_END_STREAM if end_stream else 0)
+        self._tx += frame(FT_HEADERS, flags, sid, hpack.encode(headers))
+        st.local_closed = end_stream
+        return st
+
+    def send_headers(self, st: Stream, headers, end_stream=False):
+        flags = F_END_HEADERS | (F_END_STREAM if end_stream else 0)
+        self._tx += frame(FT_HEADERS, flags, st.sid,
+                          hpack.encode(headers))
+        st.local_closed = st.local_closed or end_stream
+
+    def send_data(self, st: Stream, data: bytes, end_stream=False):
+        """Queue data; frames go out only as the peer's stream and
+        connection windows allow (RFC 9113 §5.2 — a compliant peer
+        treats window overshoot as FLOW_CONTROL_ERROR)."""
+        st._pend += data
+        st._pend_end = st._pend_end or end_stream
+        st.local_closed = st.local_closed or end_stream
+        self._pump_sends()
+
+    def _pump_sends(self):
+        maxf = min(self.peer_max_frame, MAX_FRAME)
+        for st in self.streams.values():
+            while st._pend or (st._pend_end and not st._pend):
+                allow = min(len(st._pend), st.send_window,
+                            self.send_window, maxf)
+                if st._pend and allow <= 0:
+                    break                    # wait for WINDOW_UPDATE
+                chunk = bytes(st._pend[:allow])
+                del st._pend[:allow]
+                last = not st._pend
+                flags = F_END_STREAM if (st._pend_end and last) else 0
+                self._tx += frame(FT_DATA, flags, st.sid, chunk)
+                st.send_window -= len(chunk)
+                self.send_window -= len(chunk)
+                if last:
+                    st._pend_end = False     # END_STREAM emitted
+                    break
+
+    def rst(self, st: Stream, code: int = 0x8):
+        self._tx += frame(FT_RST_STREAM, 0, st.sid,
+                          struct.pack(">I", code))
+        st.local_closed = st.remote_closed = True

@@ -1,0 +1,282 @@
+"""HPACK (RFC 7541) — header compression for HTTP/2.
+
+Scope matches the reference's h2 layer needs (ref: src/waltz/h2/
+fd_hpack.c — the gRPC client path): full STATIC table, integer and
+(decode-only) Huffman string forms, and a zero-dynamic-table
+discipline: we advertise SETTINGS_HEADER_TABLE_SIZE=0, so a compliant
+peer never references dynamic entries, and our encoder emits only
+static-table references and literals-without-indexing. That keeps both
+directions stateless — the property that makes the codec safe to
+restart mid-connection (and ~200 lines instead of 2000).
+"""
+from __future__ import annotations
+
+STATIC = [
+    (b":authority", b""), (b":method", b"GET"), (b":method", b"POST"),
+    (b":path", b"/"), (b":path", b"/index.html"), (b":scheme", b"http"),
+    (b":scheme", b"https"), (b":status", b"200"), (b":status", b"204"),
+    (b":status", b"206"), (b":status", b"304"), (b":status", b"400"),
+    (b":status", b"404"), (b":status", b"500"), (b"accept-charset", b""),
+    (b"accept-encoding", b"gzip, deflate"), (b"accept-language", b""),
+    (b"accept-ranges", b""), (b"accept", b""), (b"access-control-allow-origin", b""),
+    (b"age", b""), (b"allow", b""), (b"authorization", b""),
+    (b"cache-control", b""), (b"content-disposition", b""),
+    (b"content-encoding", b""), (b"content-language", b""),
+    (b"content-length", b""), (b"content-location", b""),
+    (b"content-range", b""), (b"content-type", b""), (b"cookie", b""),
+    (b"date", b""), (b"etag", b""), (b"expect", b""), (b"expires", b""),
+    (b"from", b""), (b"host", b""), (b"if-match", b""),
+    (b"if-modified-since", b""), (b"if-none-match", b""),
+    (b"if-range", b""), (b"if-unmodified-since", b""),
+    (b"last-modified", b""), (b"link", b""), (b"location", b""),
+    (b"max-forwards", b""), (b"proxy-authenticate", b""),
+    (b"proxy-authorization", b""), (b"range", b""), (b"referer", b""),
+    (b"refresh", b""), (b"retry-after", b""), (b"server", b""),
+    (b"set-cookie", b""), (b"strict-transport-security", b""),
+    (b"transfer-encoding", b""), (b"user-agent", b""), (b"vary", b""),
+    (b"via", b""), (b"www-authenticate", b""),
+]
+
+_BY_PAIR = {pair: i + 1 for i, pair in enumerate(STATIC)}
+_BY_NAME = {}
+for _i, (_n, _v) in enumerate(STATIC):
+    _BY_NAME.setdefault(_n, _i + 1)
+
+
+class HpackError(ValueError):
+    pass
+
+
+def enc_int(value: int, prefix_bits: int, flags: int = 0) -> bytes:
+    limit = (1 << prefix_bits) - 1
+    if value < limit:
+        return bytes([flags | value])
+    out = bytearray([flags | limit])
+    value -= limit
+    while value >= 0x80:
+        out.append((value & 0x7F) | 0x80)
+        value >>= 7
+    out.append(value)
+    return bytes(out)
+
+
+def dec_int(data: bytes, off: int, prefix_bits: int) -> tuple[int, int]:
+    limit = (1 << prefix_bits) - 1
+    if off >= len(data):
+        raise HpackError("truncated integer")
+    v = data[off] & limit
+    off += 1
+    if v < limit:
+        return v, off
+    shift = 0
+    while True:
+        if off >= len(data):
+            raise HpackError("truncated integer continuation")
+        b = data[off]
+        off += 1
+        v += (b & 0x7F) << shift
+        shift += 7
+        if shift > 35:
+            raise HpackError("integer too large")
+        if not b & 0x80:
+            return v, off
+
+
+# -- Huffman decode (RFC 7541 appendix B) — decode-only ---------------------
+# table as (code, bits, sym); built into a nested dict walker lazily
+
+_HUFF = None
+
+
+def _huff_table():
+    global _HUFF
+    if _HUFF is not None:
+        return _HUFF
+    # (bits, code) per symbol 0..255 + EOS, RFC 7541 Appendix B
+    codes = _HUFF_CODES
+    root: dict = {}
+    for sym, (code, bits) in enumerate(codes):
+        node = root
+        for i in range(bits - 1, -1, -1):
+            bit = (code >> i) & 1
+            if i == 0:
+                node[bit] = sym
+            else:
+                node = node.setdefault(bit, {})
+                if not isinstance(node, dict):
+                    raise AssertionError("huffman table corrupt")
+    _HUFF = root
+    return root
+
+
+def huff_decode(data: bytes) -> bytes:
+    root = _huff_table()
+    out = bytearray()
+    node = root
+    pad = 0
+    pad_ones = True
+    for byte in data:
+        for i in range(7, -1, -1):
+            bit = (byte >> i) & 1
+            nxt = node[bit] if bit in node else None
+            if nxt is None:
+                raise HpackError("bad huffman code")
+            if isinstance(nxt, int):
+                if nxt == 256:
+                    raise HpackError("EOS in huffman data")
+                out.append(nxt)
+                node = root
+                pad = 0
+                pad_ones = True
+            else:
+                node = nxt
+                pad += 1
+                pad_ones = pad_ones and bit == 1
+    if pad > 7:
+        raise HpackError("huffman padding too long")
+    if pad and not pad_ones:
+        # RFC 7541 §5.2: padding MUST be the EOS prefix (all ones)
+        raise HpackError("huffman padding not EOS prefix")
+    return bytes(out)
+
+
+def enc_str(s: bytes) -> bytes:
+    return enc_int(len(s), 7) + s          # always raw (never huffman)
+
+
+def dec_str(data: bytes, off: int) -> tuple[bytes, int]:
+    if off >= len(data):
+        raise HpackError("truncated string")
+    huff = bool(data[off] & 0x80)
+    n, off = dec_int(data, off, 7)
+    if off + n > len(data):
+        raise HpackError("truncated string body")
+    raw = data[off:off + n]
+    return (huff_decode(raw) if huff else raw), off + n
+
+
+def encode(headers: list[tuple[bytes, bytes]]) -> bytes:
+    """Static refs + literals WITHOUT indexing (stateless)."""
+    out = bytearray()
+    for name, value in headers:
+        idx = _BY_PAIR.get((name, value))
+        if idx is not None:
+            out += enc_int(idx, 7, 0x80)          # indexed field
+            continue
+        nidx = _BY_NAME.get(name)
+        if nidx is not None:
+            out += enc_int(nidx, 4, 0x00)         # literal, indexed name
+        else:
+            out += bytes([0x00]) + enc_str(name)
+        out += enc_str(value)
+    return bytes(out)
+
+
+def decode(data: bytes) -> list[tuple[bytes, bytes]]:
+    """Decode a header block. Dynamic-table references are a protocol
+    error under our SETTINGS_HEADER_TABLE_SIZE=0 announcement."""
+    out = []
+    off = 0
+    while off < len(data):
+        b = data[off]
+        if b & 0x80:                               # indexed
+            idx, off = dec_int(data, off, 7)
+            if not 1 <= idx <= len(STATIC):
+                raise HpackError(f"dynamic/invalid index {idx}")
+            out.append(STATIC[idx - 1])
+        elif (b & 0xE0) == 0x20:                   # table size update
+            size, off = dec_int(data, off, 5)
+            if size != 0:
+                raise HpackError("dynamic table not permitted")
+        else:
+            if b & 0x40:
+                prefix = 6
+            elif b & 0x10:
+                prefix = 4                          # never-indexed
+            else:
+                prefix = 4                          # without indexing
+            idx, off = dec_int(data, off, prefix)
+            if idx:
+                if idx > len(STATIC):
+                    raise HpackError(f"dynamic name index {idx}")
+                name = STATIC[idx - 1][0]
+            else:
+                name, off = dec_str(data, off)
+            value, off = dec_str(data, off)
+            if b & 0x40:
+                # peer asked to index: legal on the wire, but with our
+                # 0-size table it must not be referenced later; accept
+                # the literal itself
+                pass
+            out.append((name, value))
+    return out
+
+
+# RFC 7541 Appendix B code table (code, nbits) for symbols 0..256
+_HUFF_CODES = [
+    (0x1ff8, 13), (0x7fffd8, 23), (0xfffffe2, 28), (0xfffffe3, 28),
+    (0xfffffe4, 28), (0xfffffe5, 28), (0xfffffe6, 28), (0xfffffe7, 28),
+    (0xfffffe8, 28), (0xffffea, 24), (0x3ffffffc, 30), (0xfffffe9, 28),
+    (0xfffffea, 28), (0x3ffffffd, 30), (0xfffffeb, 28), (0xfffffec, 28),
+    (0xfffffed, 28), (0xfffffee, 28), (0xfffffef, 28), (0xffffff0, 28),
+    (0xffffff1, 28), (0xffffff2, 28), (0x3ffffffe, 30), (0xffffff3, 28),
+    (0xffffff4, 28), (0xffffff5, 28), (0xffffff6, 28), (0xffffff7, 28),
+    (0xffffff8, 28), (0xffffff9, 28), (0xffffffa, 28), (0xffffffb, 28),
+    (0x14, 6), (0x3f8, 10), (0x3f9, 10), (0xffa, 12),
+    (0x1ff9, 13), (0x15, 6), (0xf8, 8), (0x7fa, 11),
+    (0x3fa, 10), (0x3fb, 10), (0xf9, 8), (0x7fb, 11),
+    (0xfa, 8), (0x16, 6), (0x17, 6), (0x18, 6),
+    (0x0, 5), (0x1, 5), (0x2, 5), (0x19, 6),
+    (0x1a, 6), (0x1b, 6), (0x1c, 6), (0x1d, 6),
+    (0x1e, 6), (0x1f, 6), (0x5c, 7), (0xfb, 8),
+    (0x7ffc, 15), (0x20, 6), (0xffb, 12), (0x3fc, 10),
+    (0x1ffa, 13), (0x21, 6), (0x5d, 7), (0x5e, 7),
+    (0x5f, 7), (0x60, 7), (0x61, 7), (0x62, 7),
+    (0x63, 7), (0x64, 7), (0x65, 7), (0x66, 7),
+    (0x67, 7), (0x68, 7), (0x69, 7), (0x6a, 7),
+    (0x6b, 7), (0x6c, 7), (0x6d, 7), (0x6e, 7),
+    (0x6f, 7), (0x70, 7), (0x71, 7), (0x72, 7),
+    (0xfc, 8), (0x73, 7), (0xfd, 8), (0x1ffb, 13),
+    (0x7fff0, 19), (0x1ffc, 13), (0x3ffc, 14), (0x22, 6),
+    (0x7ffd, 15), (0x3, 5), (0x23, 6), (0x4, 5),
+    (0x24, 6), (0x5, 5), (0x25, 6), (0x26, 6),
+    (0x27, 6), (0x6, 5), (0x74, 7), (0x75, 7),
+    (0x28, 6), (0x29, 6), (0x2a, 6), (0x7, 5),
+    (0x2b, 6), (0x76, 7), (0x2c, 6), (0x8, 5),
+    (0x9, 5), (0x2d, 6), (0x77, 7), (0x78, 7),
+    (0x79, 7), (0x7a, 7), (0x7b, 7), (0x7ffe, 15),
+    (0x7fc, 11), (0x3ffd, 14), (0x1ffd, 13), (0xffffffc, 28),
+    (0xfffe6, 20), (0x3fffd2, 22), (0xfffe7, 20), (0xfffe8, 20),
+    (0x3fffd3, 22), (0x3fffd4, 22), (0x3fffd5, 22), (0x7fffd9, 23),
+    (0x3fffd6, 22), (0x7fffda, 23), (0x7fffdb, 23), (0x7fffdc, 23),
+    (0x7fffdd, 23), (0x7fffde, 23), (0xffffeb, 24), (0x7fffdf, 23),
+    (0xffffec, 24), (0xffffed, 24), (0x3fffd7, 22), (0x7fffe0, 23),
+    (0xffffee, 24), (0x7fffe1, 23), (0x7fffe2, 23), (0x7fffe3, 23),
+    (0x7fffe4, 23), (0x1fffdc, 21), (0x3fffd8, 22), (0x7fffe5, 23),
+    (0x3fffd9, 22), (0x7fffe6, 23), (0x7fffe7, 23), (0xffffef, 24),
+    (0x3fffda, 22), (0x1fffdd, 21), (0xfffe9, 20), (0x3fffdb, 22),
+    (0x3fffdc, 22), (0x7fffe8, 23), (0x7fffe9, 23), (0x1fffde, 21),
+    (0x7fffea, 23), (0x3fffdd, 22), (0x3fffde, 22), (0xfffff0, 24),
+    (0x1fffdf, 21), (0x3fffdf, 22), (0x7fffeb, 23), (0x7fffec, 23),
+    (0x1fffe0, 21), (0x1fffe1, 21), (0x3fffe0, 22), (0x1fffe2, 21),
+    (0x7fffed, 23), (0x3fffe1, 22), (0x7fffee, 23), (0x7fffef, 23),
+    (0xfffea, 20), (0x3fffe2, 22), (0x3fffe3, 22), (0x3fffe4, 22),
+    (0x7ffff0, 23), (0x3fffe5, 22), (0x3fffe6, 22), (0x7ffff1, 23),
+    (0x3ffffe0, 26), (0x3ffffe1, 26), (0xfffeb, 20), (0x7fff1, 19),
+    (0x3fffe7, 22), (0x7ffff2, 23), (0x3fffe8, 22), (0x1ffffec, 25),
+    (0x3ffffe2, 26), (0x3ffffe3, 26), (0x3ffffe4, 26), (0x7ffffde, 27),
+    (0x7ffffdf, 27), (0x3ffffe5, 26), (0xfffff1, 24), (0x1ffffed, 25),
+    (0x7fff2, 19), (0x1fffe3, 21), (0x3ffffe6, 26), (0x7ffffe0, 27),
+    (0x7ffffe1, 27), (0x3ffffe7, 26), (0x7ffffe2, 27), (0xfffff2, 24),
+    (0x1fffe4, 21), (0x1fffe5, 21), (0x3ffffe8, 26), (0x3ffffe9, 26),
+    (0xffffffd, 28), (0x7ffffe3, 27), (0x7ffffe4, 27), (0x7ffffe5, 27),
+    (0xfffec, 20), (0xfffff3, 24), (0xfffed, 20), (0x1fffe6, 21),
+    (0x3fffe9, 22), (0x1fffe7, 21), (0x1fffe8, 21), (0x7ffff3, 23),
+    (0x3fffea, 22), (0x3fffeb, 22), (0x1ffffee, 25), (0x1ffffef, 25),
+    (0xfffff4, 24), (0xfffff5, 24), (0x3ffffea, 26), (0x7ffff4, 23),
+    (0x3ffffeb, 26), (0x7ffffe6, 27), (0x3ffffec, 26), (0x3ffffed, 26),
+    (0x7ffffe7, 27), (0x7ffffe8, 27), (0x7ffffe9, 27), (0x7ffffea, 27),
+    (0x7ffffeb, 27), (0xffffffe, 28), (0x7ffffec, 27), (0x7ffffed, 27),
+    (0x7ffffee, 27), (0x7ffffef, 27), (0x7fffff0, 27), (0x3ffffee, 26),
+    (0x3fffffff, 30),
+]

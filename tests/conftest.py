@@ -15,6 +15,12 @@ backend creation, so setting it here works.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+# when the axon tunnel is wedged, its sitecustomize register() can
+# block EVERY spawned interpreter (PERF.md r4 outage notes); an empty
+# pool-IP list skips registration entirely — tests never want the
+# device, so this is always safe here and keeps the suite runnable
+# during tunnel-down windows
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -203,3 +203,60 @@ def test_gui_tile_serves_dashboard_and_summary():
         runner.halt()
         runner.close()
         os.unlink(cap.name)
+
+
+def test_plugin_tile_streams_events_over_unix_socket(tmp_path):
+    """plugin tile: frag stream -> NDJSON events to an external unix-
+    socket client (ref: src/disco/plugin/fd_plugin_tile.c role)."""
+    import json as _json
+    import socket as _s
+
+    from firedancer_tpu.disco import Topology, TopologyRunner
+
+    pkts = [(i, bytes([i]) * 50) for i in range(1, 17)]
+    cap = str(tmp_path / "c.pcap")
+    with open(cap, "wb") as f:
+        write_pcap(f, pkts)
+    sock_path = str(tmp_path / "plugin.sock")
+    topo = (
+        Topology(f"pl{os.getpid()}", wksp_size=1 << 22)
+        .link("feed", depth=64, mtu=256)
+        .tile("pcap", "pcap", outs=["feed"], path=cap, loop=1,
+              realtime=True)                     # paced: client attaches
+        .tile("plugin", "plugin", ins=[("feed", False)],
+              sock_path=sock_path)
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=60)
+        deadline = time.time() + 10
+        cli = None
+        while time.time() < deadline and cli is None:
+            try:
+                cli = _s.socket(_s.AF_UNIX, _s.SOCK_STREAM)
+                cli.connect(sock_path)
+            except OSError:
+                cli = None
+                time.sleep(0.05)
+        assert cli is not None
+        cli.settimeout(20)
+        buf = b""
+        events = []
+        while len(events) < 10:
+            chunk = cli.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                events.append(_json.loads(line))
+        assert len(events) >= 10
+        assert events[0]["link"] == "feed"
+        assert all(e["sz"] == 50 for e in events[:10])
+        # payload prefix round-trips
+        tag = int(events[0]["data"][:2], 16)
+        assert events[0]["data"] == bytes([tag]).hex() * 50
+        cli.close()
+    finally:
+        runner.halt()
+        runner.close()

@@ -1,0 +1,296 @@
+"""HTTP/2 + HPACK + gRPC (waltz/h2.py, hpack.py, grpc.py) and the
+bundle tile end-to-end (ref: src/waltz/h2/, src/waltz/grpc/,
+src/disco/bundle/fd_bundle_tile.c)."""
+import os
+import struct
+import time
+
+import pytest
+
+from firedancer_tpu.waltz import h2, hpack
+from firedancer_tpu.waltz.grpc import (GrpcClient, GrpcError,
+                                       GrpcServer, pb_decode, pb_field)
+
+
+# -- hpack -------------------------------------------------------------------
+
+def test_hpack_rfc7541_huffman_vectors():
+    assert hpack.huff_decode(
+        bytes.fromhex("f1e3c2e5f23a6ba0ab90f4ff")) == b"www.example.com"
+    assert hpack.huff_decode(bytes.fromhex("6402")) == b"302"
+    assert hpack.huff_decode(bytes.fromhex("aec3771a4b")) == b"private"
+    assert hpack.huff_decode(
+        bytes.fromhex("d07abe941054d444a8200595040b8166e082a62d1bff")) \
+        == b"Mon, 21 Oct 2013 20:13:21 GMT"
+
+
+def test_hpack_roundtrip_and_static_refs():
+    hdrs = [(b":method", b"POST"), (b":status", b"200"),
+            (b"content-type", b"application/grpc"),
+            (b"x-custom", b"abc"), (b"te", b"trailers")]
+    blob = hpack.encode(hdrs)
+    assert hpack.decode(blob) == hdrs
+    # pure static pair encodes to a single byte
+    assert hpack.encode([(b":method", b"GET")]) == b"\x82"
+
+
+def test_hpack_integer_boundaries():
+    for v in (0, 30, 31, 32, 127, 128, 16383, 1 << 20):
+        b = hpack.enc_int(v, 5)
+        got, off = hpack.dec_int(b, 0, 5)
+        assert got == v and off == len(b)
+
+
+def test_hpack_rejects_dynamic_refs():
+    with pytest.raises(hpack.HpackError):
+        hpack.decode(bytes([0x80 | 62]))     # beyond the static table
+
+
+# -- h2 in-memory pair -------------------------------------------------------
+
+def _pump_pair(a, b, rounds=4):
+    for _ in range(rounds):
+        b.feed(a.take_tx())
+        a.feed(b.take_tx())
+
+
+def test_h2_handshake_headers_data_trailers():
+    cli = h2.Conn(is_client=True)
+    srv = h2.Conn(is_client=False)
+    _pump_pair(cli, srv)
+    assert cli._settings_acked and srv._settings_acked
+
+    st = cli.open_stream([(b":method", b"POST"), (b":path", b"/x")])
+    cli.send_data(st, b"hello-world", end_stream=True)
+    _pump_pair(cli, srv)
+    sst = srv.streams[st.sid]
+    assert dict(sst.headers)[b":path"] == b"/x"
+    assert bytes(sst.data) == b"hello-world" and sst.remote_closed
+
+    srv.send_headers(sst, [(b":status", b"200")])
+    srv.send_data(sst, b"resp")
+    srv.send_headers(sst, [(b"grpc-status", b"0")], end_stream=True)
+    _pump_pair(cli, srv)
+    assert dict(st.headers)[b":status"] == b"200"
+    assert bytes(st.data) == b"resp"
+    assert dict(st.trailers)[b"grpc-status"] == b"0"
+    assert st.remote_closed
+
+
+def test_h2_large_data_fragments_and_flow_control():
+    cli = h2.Conn(is_client=True)
+    srv = h2.Conn(is_client=False)
+    _pump_pair(cli, srv)
+    st = cli.open_stream([(b":method", b"POST"), (b":path", b"/big")])
+    big = bytes(range(256)) * 200            # 51200 bytes > 16384 frame
+    cli.send_data(st, big, end_stream=True)
+    _pump_pair(cli, srv, rounds=6)
+    sst = srv.streams[st.sid]
+    assert bytes(sst.data) == big
+    # server's WINDOW_UPDATEs replenished the client's send window
+    assert cli.send_window > 0
+
+
+def test_h2_ping_and_rst():
+    cli = h2.Conn(is_client=True)
+    srv = h2.Conn(is_client=False)
+    _pump_pair(cli, srv)
+    cli._tx += h2.frame(h2.FT_PING, 0, 0, b"12345678")
+    _pump_pair(cli, srv)
+    st = cli.open_stream([(b":method", b"POST"), (b":path", b"/r")])
+    _pump_pair(cli, srv)
+    srv.rst(srv.streams[st.sid], code=0x8)
+    _pump_pair(cli, srv)
+    assert st.reset == 0x8 and st.remote_closed
+
+
+# -- protobuf codec ----------------------------------------------------------
+
+def test_protobuf_codec_roundtrip():
+    msg = pb_field(1, b"abc") + pb_field(2, 300) + pb_field(1, b"def")
+    d = pb_decode(msg)
+    assert d[1] == [b"abc", b"def"] and d[2] == [300]
+    with pytest.raises(ValueError):
+        pb_decode(b"\x0a\xff")               # truncated length
+
+
+# -- gRPC over real TCP ------------------------------------------------------
+
+def test_grpc_unary_stream_and_errors():
+    def echo(req):
+        return pb_field(1, b"echo:" + pb_decode(req)[1][0])
+
+    def counter(req):
+        return [pb_field(1, i) for i in range(pb_decode(req)[1][0])]
+
+    def boom(req):
+        raise RuntimeError("handler exploded")
+
+    srv = GrpcServer({"/t.S/Echo": echo, "/t.S/Count": counter,
+                      "/t.S/Boom": boom})
+    try:
+        cli = GrpcClient(("127.0.0.1", srv.port))
+        rsp = cli.call_unary("a", "/t.S/Echo", pb_field(1, b"hi"))
+        assert pb_decode(rsp)[1][0] == b"echo:hi"
+        _, nxt = cli.open_server_stream("a", "/t.S/Count",
+                                        pb_field(1, 5))
+        got = []
+        while True:
+            m = nxt()
+            if m is None:
+                break
+            got.append(pb_decode(m)[1][0])
+        assert got == [0, 1, 2, 3, 4]
+        with pytest.raises(GrpcError) as e:
+            cli.call_unary("a", "/t.S/Missing", b"")
+        assert e.value.status == 12          # UNIMPLEMENTED
+        with pytest.raises(GrpcError) as e:
+            cli.call_unary("a", "/t.S/Boom", b"")
+        assert e.value.status == 13          # INTERNAL
+        cli.close()
+    finally:
+        srv.close()
+
+
+# -- bundle tile end-to-end --------------------------------------------------
+
+def test_bundle_tile_feeds_pack_atomically():
+    """block-engine gRPC stream -> bundle tile -> pack bundle_in ->
+    an exclusive in-order microblock on the bank link."""
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    from firedancer_tpu.runtime import Ring, Workspace
+    from firedancer_tpu.tiles.synth import make_signed_txns
+
+    txns = [bytes(t) for t in make_signed_txns(3, seed=21)]
+
+    sent = []
+
+    def subscribe(req):
+        # emit the bundle on the FIRST subscription only; later
+        # reconnects get an empty stream (the tile's reconnect loop is
+        # expected — the server is single-shot test scaffolding)
+        if not sent:
+            sent.append(1)
+            yield b"".join(pb_field(1, t) for t in txns)
+
+    srv = GrpcServer({"/fdtpu.BlockEngine/SubscribeBundles": subscribe})
+    plan = None
+    runner = None
+    try:
+        topo = (
+            Topology(f"bd{os.getpid()}", wksp_size=1 << 23)
+            .link("txn_in", depth=64, mtu=1280, external=True)
+            .link("bundles", depth=64, mtu=4096)
+            .link("bank0", depth=64, mtu=4200, external=True)
+            .link("done0", depth=64, mtu=64, external=True)
+            .tile("bundle", "bundle", outs=["bundles"],
+                  engine=f"127.0.0.1:{srv.port}")
+            .tile("pack", "pack",
+                  ins=[("txn_in", False), ("bundles", False),
+                       ("done0", False)],
+                  outs=["bank0"], txn_in="txn_in", bundle_in="bundles",
+                  bank_links=["bank0"], done_links=["done0"])
+        )
+        plan = topo.build()
+        runner = TopologyRunner(plan).start()
+        runner.wait_running(timeout_s=60)
+
+        w = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                      create=False)
+        li = plan["links"]["bank0"]
+        bank_ring = Ring(w, li["ring_off"], li["depth"],
+                         li["arena_off"], li["mtu"])
+        seq = 0
+        deadline = time.time() + 60
+        frames = []
+        while time.time() < deadline and not frames:
+            n, seq, buf, sizes, sigs, _ = bank_ring.gather(seq, 8,
+                                                           li["mtu"])
+            frames += [bytes(buf[i, :sizes[i]]) for i in range(n)]
+            time.sleep(0.02)
+        assert frames, "no microblock emitted"
+        bank, cnt, mb_id, slot = struct.unpack_from("<HHQQ",
+                                                    frames[0], 0)
+        assert cnt == 3                      # the bundle, exclusively
+        off = 20
+        got = []
+        for _ in range(cnt):
+            (ln,) = struct.unpack_from("<H", frames[0], off)
+            off += 2
+            got.append(frames[0][off:off + ln])
+            off += ln
+        assert got == txns                   # exact order preserved
+        m = runner.metrics("pack")
+        assert m["bundles"] >= 1 and m["bundle_rejects"] == 0
+        assert runner.metrics("bundle")["txns"] >= 3
+    finally:
+        if runner:
+            runner.halt()
+            runner.close()
+        srv.close()
+
+
+def test_hpack_padding_must_be_eos_prefix():
+    # '0' (code 00000) + 000 padding: zeros padding is a decode error
+    with pytest.raises(hpack.HpackError, match="padding"):
+        hpack.huff_decode(b"\x00")
+    # valid: '0' + 111 padding (EOS prefix)
+    assert hpack.huff_decode(b"\x07") == b"0"
+
+
+def test_h2_send_respects_flow_control_window():
+    """Data beyond the peer's 64KiB initial window waits for
+    WINDOW_UPDATE instead of overshooting (RFC 9113 §5.2)."""
+    cli = h2.Conn(is_client=True)
+    srv = h2.Conn(is_client=False)
+    _pump_pair(cli, srv)
+    st = cli.open_stream([(b":method", b"POST"), (b":path", b"/w")])
+    big = b"z" * (h2.DEFAULT_WINDOW + 10_000)
+    cli.send_data(st, big, end_stream=True)
+    # without feeding the server's WINDOW_UPDATEs back, the client
+    # must emit at most the initial window
+    first = cli.take_tx()
+    sent = sum(int.from_bytes(first[i:i+3], "big")
+               for i in _frame_offsets(first, h2.FT_DATA))
+    assert sent <= h2.DEFAULT_WINDOW
+    assert cli.send_window >= 0 and st.send_window >= 0
+    # deliver the withheld flight, then pump the rest
+    srv.feed(first)
+    _pump_pair(cli, srv, rounds=10)
+    assert bytes(srv.streams[st.sid].data) == big
+    assert srv.streams[st.sid].remote_closed
+
+
+def _frame_offsets(blob, want_type):
+    off = 0
+    out = []
+    while off + 9 <= len(blob):
+        ln = int.from_bytes(blob[off:off+3], "big")
+        if blob[off+3] == want_type:
+            out.append(off)
+        off += 9 + ln
+    return out
+
+
+def test_bundle_oversize_message_counted_not_crash():
+    """>5-txn subscribe messages are remote garbage: counted as
+    errors, never framed (the u8-count wire caps and pack's bundle
+    size cap both sit behind this check)."""
+    from firedancer_tpu.waltz.grpc import pb_field
+
+    def subscribe(req):
+        yield b"".join(pb_field(1, bytes([i]) * 10) for i in range(9))
+
+    srv = GrpcServer({"/fdtpu.BlockEngine/SubscribeBundles": subscribe})
+    try:
+        # drive the stream loop logic directly (no topology needed)
+        from firedancer_tpu.waltz.grpc import GrpcClient, pb_decode
+        cli = GrpcClient(("127.0.0.1", srv.port))
+        _, nxt = cli.open_server_stream(
+            "a", "/fdtpu.BlockEngine/SubscribeBundles", b"")
+        msg = nxt()
+        txns = [v for v in pb_decode(msg).get(1, [])]
+        assert len(txns) == 9              # arrives; the TILE rejects it
+        cli.close()
+    finally:
+        srv.close()
