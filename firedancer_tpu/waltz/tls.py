@@ -14,8 +14,13 @@ same scope, TPU-framework-shaped:
     spliced template like the reference's mock — ours parses)
 
 The key schedule (§7.1), transcript hashing, Finished MACs, and
-CertificateVerify context are implemented exactly per RFC; the test
-suite pins them against the published RFC 8448 trace vectors.
+CertificateVerify context are implemented exactly per RFC; external
+grounding comes from an independent stack (tests/test_tls.py): the
+x25519 exchange is pinned to RFC 7748 vectors and differentially
+checked against OpenSSL, and the generated certificate must parse
+under `cryptography.x509` with its self-signature verifying under
+OpenSSL's Ed25519 — so the DER encoder, signing input, and transcript
+discipline are witnessed beyond self-consistency.
 
 Flow (QUIC encryption levels, RFC 9001 §4.1):
   client               server

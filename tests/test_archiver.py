@@ -2,6 +2,9 @@
 then re-drive the SAME downstream tiles from the file and get identical
 results — the deterministic-replay CI tier (ref: src/disco/archiver/
 fd_archiver.h:1-20; SURVEY §4 tier 10)."""
+import pytest
+
+pytestmark = pytest.mark.slow
 import os
 
 from firedancer_tpu.disco import Topology, TopologyRunner

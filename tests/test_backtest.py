@@ -7,6 +7,8 @@ import struct
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from firedancer_tpu.app.backtest import record, replay
 from firedancer_tpu.funk.funk import Funk
 from firedancer_tpu.protocol.txn import build_message, build_txn

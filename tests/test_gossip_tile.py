@@ -2,6 +2,9 @@
 bootstrap off one entrypoint and converge their CRDS stores, with
 signed values verified on receipt (ref: src/discof/gossip/ tile +
 src/flamenco/gossip/fd_gossip.h)."""
+import pytest
+
+pytestmark = pytest.mark.slow
 import os
 import time
 

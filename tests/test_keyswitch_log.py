@@ -1,6 +1,9 @@
 """Keyswitch (live identity hot-swap) + logging subsystem tests
 (ref: src/disco/keyguard/fd_keyswitch.h, set_identity command;
 src/util/log/fd_log.h dual-sink discipline)."""
+import pytest
+
+pytestmark = pytest.mark.slow
 import os
 
 from firedancer_tpu.disco import Topology, TopologyRunner

@@ -8,6 +8,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from firedancer_tpu.disco import Topology, TopologyRunner
 from firedancer_tpu.disco.metrics import (
     NBUCKETS, HistAccum, bucket_of, quantile_ns, read_hists,

@@ -11,6 +11,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from firedancer_tpu.disco import Topology, TopologyRunner
 from firedancer_tpu.shred.shred_dest import ClusterNode
 from firedancer_tpu.tiles.repair import RepairCore

@@ -5,6 +5,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from firedancer_tpu.disco import Topology, TopologyRunner
 
 N = 32

@@ -2,6 +2,9 @@
 stream) -> snapin (reassemble + restore) across OS processes
 (ref: src/discof/restore/ pipeline shape; multi-frag ctl SOM/EOM
 discipline src/tango/fd_tango_base.h)."""
+import pytest
+
+pytestmark = pytest.mark.slow
 import os
 
 import numpy as np

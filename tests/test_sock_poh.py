@@ -14,6 +14,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from firedancer_tpu.disco import Topology, TopologyRunner
 from firedancer_tpu.disco.monitor import attach
 from firedancer_tpu.ops.poh import host_poh_append, host_poh_mixin

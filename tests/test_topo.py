@@ -11,6 +11,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from firedancer_tpu.disco import Topology, TopologyRunner
 from firedancer_tpu.disco.monitor import attach, snapshot, format_table
 

@@ -2,6 +2,9 @@
 tower vote -> keyguard-signed vote txn over UDP
 (ref: src/discof/tower/fd_tower_tile.c, src/discof/send/,
 src/disco/keyguard/ role SEND)."""
+import pytest
+
+pytestmark = pytest.mark.slow
 import os
 import socket
 import struct
