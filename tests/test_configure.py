@@ -1,0 +1,41 @@
+"""configure preflight stages (ref: src/app/shared/commands/configure/
+check/fix contract)."""
+import resource
+
+from firedancer_tpu.app import configure as cf
+
+
+def test_check_runs_on_live_host():
+    stages = cf.check(wksp_bytes=1 << 20)
+    names = [s["stage"] for s in stages]
+    assert names == ["shm", "nofile", "memlock", "cpus", "somaxconn",
+                     "overcommit"]
+    for s in stages:
+        assert s["status"] in (cf.PASS, cf.WARN, cf.FAIL)
+        assert s["detail"]
+    # 1 MiB of shm must exist on any runnable host
+    assert stages[0]["status"] == cf.PASS
+
+
+def test_shm_fail_when_impossible():
+    st = cf.stage_shm(wksp_bytes=1 << 50)      # petabyte: impossible
+    assert st["status"] in (cf.WARN, cf.FAIL)
+    assert st["fix"]
+
+
+def test_fix_nofile_raises_soft_toward_hard():
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    try:
+        want = min(hard, soft + 1) if hard != resource.RLIM_INFINITY \
+            else soft + 1
+        assert cf.fix_nofile(want)
+        assert resource.getrlimit(resource.RLIMIT_NOFILE)[0] >= want
+    finally:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
+
+
+def test_cli_prints_and_exits(capsys):
+    rc = cf.main(["check", "--wksp-bytes", str(1 << 20)])
+    out = capsys.readouterr().out
+    assert "shm" in out and '"result"' in out
+    assert rc in (0, 2)
