@@ -213,6 +213,49 @@ def sys_curve_group_op(vm, r1, r2, r3, r4, r5):
     return 1
 
 
+ALT_BN128_ADD = 0
+ALT_BN128_SUB = 1
+ALT_BN128_MUL = 2
+ALT_BN128_PAIRING = 3
+CU_BN128_ADD = 334
+CU_BN128_MUL = 3840
+CU_BN128_PAIRING_FIRST = 36364
+CU_BN128_PAIRING_OTHER = 12121
+
+
+def sys_alt_bn128_group_op(vm, r1, r2, r3, r4, r5):
+    """sol_alt_bn128_group_op(op, input_addr, input_len, result_addr)
+    — EIP-196/197 semantics (ref: src/flamenco/vm/syscall wiring of
+    src/ballet/bn254/). Returns 0 and writes the result on success,
+    1 on malformed/off-curve input (matching Agave's error-to-r0)."""
+    from ..utils import bn254 as bn
+    data = vm.mem_read(r2, r3) if r3 else b""
+    try:
+        if r1 == ALT_BN128_ADD:
+            vm.charge(CU_BN128_ADD)
+            out = bn.alt_bn128_add(data)
+        elif r1 == ALT_BN128_SUB:
+            vm.charge(CU_BN128_ADD)
+            out = bn.alt_bn128_sub(data)
+        elif r1 == ALT_BN128_MUL:
+            vm.charge(CU_BN128_MUL)
+            out = bn.alt_bn128_mul(data)
+        elif r1 == ALT_BN128_PAIRING:
+            # first + other*(n-1), nothing for empty input (the
+            # reference's pairing cost shape)
+            n = r3 // 192
+            if n:
+                vm.charge(CU_BN128_PAIRING_FIRST
+                          + CU_BN128_PAIRING_OTHER * (n - 1))
+            out = bn.alt_bn128_pairing(data)
+        else:
+            return 1
+    except ValueError:
+        return 1
+    vm.mem_write(r4, out)
+    return 0
+
+
 DEFAULT_SYSCALLS = {
     syscall_id(b"abort"): sys_abort,
     syscall_id(b"sol_log_"): sys_log,
@@ -227,4 +270,5 @@ DEFAULT_SYSCALLS = {
     syscall_id(b"sol_get_return_data"): sys_get_return_data,
     syscall_id(b"sol_curve_validate_point"): sys_curve_validate_point,
     syscall_id(b"sol_curve_group_op"): sys_curve_group_op,
+    syscall_id(b"sol_alt_bn128_group_op"): sys_alt_bn128_group_op,
 }

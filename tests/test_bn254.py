@@ -1,0 +1,140 @@
+"""alt-bn128 (utils/bn254.py) + the sol_alt_bn128_group_op syscall
+(ref: src/ballet/bn254/, src/flamenco/vm/syscall/). Gates are
+mathematical: generator membership, group laws, bilinearity — a wrong
+Miller loop or final exponentiation cannot satisfy them."""
+import pytest
+
+from firedancer_tpu.utils import bn254 as bn
+
+
+def test_generators_valid():
+    assert bn.g1_on_curve(bn.G1_GEN)
+    assert bn.g1_mul(bn.R, bn.G1_GEN) is None        # order r
+    assert bn.g2_in_subgroup(bn.G2_GEN)
+    # the untwist embedding lands on E(Fp12): y^2 = x^3 + 3
+    x12, y12 = bn._embed_g2(bn.G2_GEN)
+    lhs = bn.f12_mul(y12, y12)
+    rhs = bn._f12_add(bn.f12_mul(bn.f12_mul(x12, x12), x12),
+                      bn._f12_from_fp(3))
+    assert lhs == rhs
+
+
+def test_g1_group_laws():
+    g = bn.G1_GEN
+    assert bn.g1_add(bn.g1_mul(3, g), bn.g1_mul(4, g)) == bn.g1_mul(7, g)
+    assert bn.g1_add(g, bn.g1_neg(g)) is None
+    assert bn.g1_add(None, g) == g
+    assert bn.g1_mul(0, g) is None
+
+
+def test_pairing_bilinearity_and_nondegeneracy():
+    g1, g2 = bn.G1_GEN, bn.G2_GEN
+    # e(2P, 3Q) * e(-6P, Q) == 1
+    assert bn.pairing_check([(bn.g1_mul(2, g1), bn.g2_mul(3, g2)),
+                             (bn.g1_neg(bn.g1_mul(6, g1)), g2)])
+    # e(aP, Q) * e(-P, aQ) == 1 for another exponent
+    a = 11
+    assert bn.pairing_check([(bn.g1_mul(a, g1), g2),
+                             (bn.g1_neg(g1), bn.g2_mul(a, g2))])
+    # non-degenerate: a single real pairing is NOT 1
+    assert not bn.pairing_check([(g1, g2)])
+    # infinity entries contribute identity
+    assert bn.pairing_check([(None, g2), (g1, None)])
+    assert bn.pairing_check([])
+
+
+def test_eip196_serialization_and_ops():
+    g = bn.G1_GEN
+    two_g = bn.g1_mul(2, g)
+    data = bn.enc_g1(g) + bn.enc_g1(g)
+    assert bn.dec_g1(bn.alt_bn128_add(data)) == two_g
+    mul_in = bn.enc_g1(g) + (5).to_bytes(32, "big")
+    assert bn.dec_g1(bn.alt_bn128_mul(mul_in)) == bn.g1_mul(5, g)
+    # infinity round trip
+    assert bn.dec_g1(bytes(64)) is None
+    assert bn.enc_g1(None) == bytes(64)
+    # off-curve rejected
+    with pytest.raises(ValueError):
+        bn.dec_g1((1).to_bytes(32, "big") + (1).to_bytes(32, "big"))
+
+
+def _enc_g2(pt):
+    (xr, xi), (yr, yi) = pt
+    return (xi.to_bytes(32, "big") + xr.to_bytes(32, "big")
+            + yi.to_bytes(32, "big") + yr.to_bytes(32, "big"))
+
+
+def test_eip197_pairing_precompile_format():
+    g1, g2 = bn.G1_GEN, bn.G2_GEN
+    good = (bn.enc_g1(bn.g1_mul(2, g1)) + _enc_g2(bn.g2_mul(3, g2))
+            + bn.enc_g1(bn.g1_neg(bn.g1_mul(6, g1))) + _enc_g2(g2))
+    assert bn.alt_bn128_pairing(good)[-1] == 1
+    bad = bn.enc_g1(g1) + _enc_g2(g2)
+    assert bn.alt_bn128_pairing(bad)[-1] == 0
+    with pytest.raises(ValueError):
+        bn.alt_bn128_pairing(b"\x00" * 100)     # not a 192 multiple
+
+
+def test_syscall_roundtrip():
+    from firedancer_tpu.vm import Vm
+    from firedancer_tpu.vm.interp import INPUT_START
+    from firedancer_tpu.vm.syscalls import (ALT_BN128_ADD,
+                                            ALT_BN128_MUL,
+                                            ALT_BN128_PAIRING,
+                                            ALT_BN128_SUB,
+                                            sys_alt_bn128_group_op)
+    g = bn.G1_GEN
+    inp = bn.enc_g1(g) + bn.enc_g1(g)
+    vm = Vm(b"\x95" + bytes(7), input_data=inp + bytes(256))
+    vm._cu = 0
+    vm.compute_budget = 10_000_000
+    out_addr = INPUT_START + 128
+    rc = sys_alt_bn128_group_op(vm, ALT_BN128_ADD, INPUT_START, 128,
+                                out_addr, 0)
+    assert rc == 0
+    assert bn.dec_g1(vm.mem_read(out_addr, 64)) == bn.g1_mul(2, g)
+    # SUB: 2g - g = g
+    vm.mem_write(INPUT_START, vm.mem_read(out_addr, 64) + bn.enc_g1(g))
+    rc = sys_alt_bn128_group_op(vm, ALT_BN128_SUB, INPUT_START, 128,
+                                out_addr, 0)
+    assert rc == 0 and bn.dec_g1(vm.mem_read(out_addr, 64)) == g
+    # MUL
+    vm.mem_write(INPUT_START, bn.enc_g1(g) + (7).to_bytes(32, "big"))
+    rc = sys_alt_bn128_group_op(vm, ALT_BN128_MUL, INPUT_START, 96,
+                                out_addr, 0)
+    assert rc == 0
+    assert bn.dec_g1(vm.mem_read(out_addr, 64)) == bn.g1_mul(7, g)
+    # PAIRING verdict
+    good = (bn.enc_g1(bn.g1_mul(2, g)) + _enc_g2(bn.g2_mul(3, bn.G2_GEN))
+            + bn.enc_g1(bn.g1_neg(bn.g1_mul(6, g))) + _enc_g2(bn.G2_GEN))
+    vm.mem_write(INPUT_START, good)
+    rc = sys_alt_bn128_group_op(vm, ALT_BN128_PAIRING, INPUT_START,
+                                len(good), out_addr, 0)
+    assert rc == 0 and vm.mem_read(out_addr, 32)[-1] == 1
+    # malformed input -> r0=1, no crash
+    vm.mem_write(INPUT_START, b"\x01" * 64 + bytes(64))
+    rc = sys_alt_bn128_group_op(vm, ALT_BN128_ADD, INPUT_START, 128,
+                                out_addr, 0)
+    assert rc == 1
+
+
+def test_noncanonical_and_oversize_rejected():
+    """r4 review: coordinates >= P and oversized inputs must error
+    like the reference, not silently reduce/truncate."""
+    g = bn.G1_GEN
+    # G2 coordinate + P: same point mod P but non-canonical encoding
+    (xr, xi), (yr, yi) = bn.G2_GEN
+    bad = ((xi + bn.P).to_bytes(32, "big") + xr.to_bytes(32, "big")
+           + yi.to_bytes(32, "big") + yr.to_bytes(32, "big"))
+    with pytest.raises(ValueError, match="canonical"):
+        bn.dec_g2(bad)
+    # oversized add/mul inputs
+    with pytest.raises(ValueError, match="exceeds"):
+        bn.alt_bn128_add(bytes(192))
+    with pytest.raises(ValueError, match="exceeds"):
+        bn.alt_bn128_mul(bytes(100))
+    with pytest.raises(ValueError, match="exceeds"):
+        bn.alt_bn128_sub(bytes(129))
+    # sub helper semantics
+    data = bn.enc_g1(bn.g1_mul(9, g)) + bn.enc_g1(bn.g1_mul(4, g))
+    assert bn.dec_g1(bn.alt_bn128_sub(data)) == bn.g1_mul(5, g)
