@@ -1,0 +1,118 @@
+"""accdb v2: hot funk + cold groove fallthrough/promotion/eviction
+(ref: src/flamenco/accdb/fd_accdb_impl_v2.c role over funk+vinyl)."""
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.svm.accdb import Account
+from firedancer_tpu.svm.accdb_cold import (AccDbCold, ColdEvictError,
+                                           account_from_bytes,
+                                           account_to_bytes)
+
+
+def K(n):
+    return bytes([n]) * 32
+
+
+def test_account_codec_roundtrip():
+    a = Account(lamports=12345, data=b"\x07" * 99, owner=K(9),
+                executable=True, rent_epoch=3)
+    b = account_from_bytes(account_to_bytes(a))
+    assert (b.lamports, bytes(b.data), b.owner, b.executable,
+            b.rent_epoch) == (12345, b"\x07" * 99, K(9), True, 3)
+
+
+def test_evict_fallthrough_and_promotion(tmp_path):
+    funk = Funk()
+    db = AccDbCold(funk, str(tmp_path))
+    funk.rec_write(None, K(1), Account(5_000, bytearray(b"big" * 100)))
+    db.evict(K(1))
+    assert funk.rec_query(None, K(1)) is None      # gone from hot
+    funk.txn_prepare(None, "blk")
+    a = db.peek("blk", K(1))                       # cold fallthrough
+    assert a.lamports == 5_000 and bytes(a.data) == b"big" * 100
+    assert db.cold_stats["hits"] == 1
+    # promoted: second peek is a hot hit
+    db.peek("blk", K(1))
+    assert db.cold_stats["hits"] == 1
+    # handles work over promoted records
+    h = db.open_rw("blk", K(1))
+    h.account.lamports = 6_000
+    db.close_rw(h)
+    assert db.lamports("blk", K(1)) == 6_000
+    db.close()
+
+
+def test_evict_refuses_fork_dirty_keys(tmp_path):
+    funk = Funk()
+    db = AccDbCold(funk, str(tmp_path))
+    funk.rec_write(None, K(2), Account(10))
+    funk.txn_prepare(None, "f1")
+    funk.rec_write("f1", K(2), Account(99))        # unpublished state
+    with pytest.raises(ColdEvictError, match="fork"):
+        db.evict(K(2))
+    funk.txn_publish("f1")
+    db.evict(K(2))                                 # now legal
+    assert db.peek(None, K(2)).lamports == 99      # cold holds latest
+    db.close()
+
+
+def test_bulk_evict_and_restart_generation(tmp_path):
+    funk = Funk()
+    db = AccDbCold(funk, str(tmp_path))
+    for i in range(1, 9):
+        funk.rec_write(None, K(i),
+                       Account(i, bytearray(b"x" * (i * 40))))
+    n = db.evict_larger_than(150)                  # data > 150: i >= 4
+    assert n == 5
+    assert db.cold_stats["evicted"] == 5
+    db.close()
+
+    # restart: fresh funk, same cold dir — everything evicted serves
+    funk2 = Funk()
+    db2 = AccDbCold(funk2, str(tmp_path))
+    funk2.txn_prepare(None, "blk")
+    for i in range(4, 9):
+        a = db2.peek("blk", K(i))
+        assert a is not None and a.lamports == i
+    assert db2.peek("blk", K(1)) is None           # never evicted,
+    db2.close()                                    # lived in old funk
+
+
+def test_evict_missing_key_raises(tmp_path):
+    db = AccDbCold(Funk(), str(tmp_path))
+    with pytest.raises(ColdEvictError, match="rooted"):
+        db.evict(K(7))
+    db.close()
+
+
+def test_promotion_deletes_cold_copy_no_stale_resurrection(tmp_path):
+    """r4 review: hot XOR cold — promotion removes the cold record, so
+    later hot updates survive a restart and deletions via the facade
+    reach both layers."""
+    funk = Funk()
+    db = AccDbCold(funk, str(tmp_path))
+    funk.rec_write(None, K(1), Account(5))
+    db.evict(K(1))
+    funk.txn_prepare(None, "blk")
+    db.peek("blk", K(1))                   # promote (cold copy dies)
+    assert db.cold.get(K(1)) is None
+    # hot update then restart generation: the update must win
+    funk.rec_write(None, K(1), Account(77))
+    db.close()
+    funk2 = Funk()
+    db2 = AccDbCold(funk2, str(tmp_path))
+    assert db2.peek(None, K(1)) is None    # cold holds NOTHING stale
+    db2.close()
+
+
+def test_facade_remove_reaches_both_layers(tmp_path):
+    funk = Funk()
+    db = AccDbCold(funk, str(tmp_path))
+    funk.rec_write(None, K(3), Account(9))
+    db.evict(K(3))
+    db.remove(None, K(3))                  # never promoted; facade del
+    assert db.peek(None, K(3)) is None
+    db.close()
+    db2 = AccDbCold(Funk(), str(tmp_path))
+    assert db2.peek(None, K(3)) is None    # not resurrected
+    db2.close()
