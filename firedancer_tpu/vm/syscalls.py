@@ -108,6 +108,20 @@ def struct_pack_rent(lamports_per_byte_year: int, threshold: float,
         + bytes([burn_percent])
 
 
+def sys_get_epoch_schedule_sysvar(vm, r1, r2, r3, r4, r5):
+    """33-byte EpochSchedule (slots_per_epoch u64, leader_schedule_
+    slot_offset u64, warmup u8, first_normal_epoch u64,
+    first_normal_slot u64) — served from the same cache the account
+    view feeds (svm/sysvars.py)."""
+    vm.charge(CU_SYSCALL_BASE)
+    es = getattr(vm, "sysvars", {}).get("epoch_schedule")
+    if es is None:
+        import struct
+        es = struct.pack("<QQBQQ", 432_000, 432_000, 0, 0, 0)
+    vm.mem_write(r1, es)
+    return 0
+
+
 RETURN_DATA_MAX = 1024
 
 
@@ -266,6 +280,8 @@ DEFAULT_SYSCALLS = {
     syscall_id(b"sol_sha256"): sys_sha256,
     syscall_id(b"sol_get_clock_sysvar"): sys_get_clock_sysvar,
     syscall_id(b"sol_get_rent_sysvar"): sys_get_rent_sysvar,
+    syscall_id(b"sol_get_epoch_schedule_sysvar"):
+        sys_get_epoch_schedule_sysvar,
     syscall_id(b"sol_set_return_data"): sys_set_return_data,
     syscall_id(b"sol_get_return_data"): sys_get_return_data,
     syscall_id(b"sol_curve_validate_point"): sys_curve_validate_point,

@@ -93,3 +93,25 @@ def test_syscall_view_falls_back_without_accounts(env):
     assert sv.dec_clock(cache["clock"])["epoch"] == 2
     assert struct.unpack_from("<Q", cache["rent"], 0)[0] == \
         sv.LAMPORTS_PER_BYTE_YEAR
+
+
+def test_epoch_schedule_syscall_serves_account_bytes(env):
+    """sol_get_epoch_schedule_sysvar returns the SAME bytes as the
+    materialized sysvar account (the two-view invariant)."""
+    funk, db = env
+    from firedancer_tpu.svm.programs import TxnExecutor
+    from firedancer_tpu.vm import Vm
+    from firedancer_tpu.vm.interp import INPUT_START
+    from firedancer_tpu.vm.syscalls import (
+        sys_get_epoch_schedule_sysvar)
+    ex = TxnExecutor(db)
+    ex.begin_slot("blk", slot=7, slots_per_epoch=1000)
+    cache = sv.read_sysvar_cache(db, "blk", 0, 0)
+    vm = Vm(b"\x95" + bytes(7), input_data=bytes(64))
+    vm._cu = 0
+    vm.sysvars = cache
+    assert sys_get_epoch_schedule_sysvar(vm, INPUT_START,
+                                         0, 0, 0, 0) == 0
+    got = vm.mem_read(INPUT_START, 33)
+    assert got == bytes(db.peek("blk", sv.EPOCH_SCHEDULE_ID).data[:33])
+    assert struct.unpack_from("<Q", got, 0)[0] == 1000
