@@ -97,6 +97,37 @@ class RpcServer:
                           "absoluteSlot": slot,
                           "transactionCount": int(
                               st.get("txn_count", 0))}
+            elif method == "getBlockHeight":
+                result = int(st.get("slot", 0))
+            elif method == "getLatestBlockhash":
+                bh = st.get("blockhash", bytes(32))
+                result = {"context": {"slot": int(st.get("slot", 0))},
+                          "value": {"blockhash": b58_encode_32(
+                              bytes(bh)),
+                              "lastValidBlockHeight":
+                                  int(st.get("slot", 0)) + 150}}
+            elif method == "getMinimumBalanceForRentExemption":
+                from ..svm.sysvars import rent_exempt_minimum
+                result = rent_exempt_minimum(int(params[0])
+                                             if params else 0)
+            elif method == "getGenesisHash":
+                result = b58_encode_32(bytes(st.get("genesis_hash",
+                                                    bytes(32))))
+            elif method == "getIdentity":
+                result = {"identity": b58_encode_32(
+                    bytes(st.get("identity", bytes(32))))}
+            elif method == "getSupply":
+                funk = st.get("funk")
+                total = 0
+                if funk is not None:
+                    for v in funk.items_at(None).values():
+                        total += v.lamports if isinstance(v, Account) \
+                            else (int(v) if isinstance(v, int) else 0)
+                result = {"context": {"slot": int(st.get("slot", 0))},
+                          "value": {"total": total,
+                                    "circulating": total,
+                                    "nonCirculating": 0,
+                                    "nonCirculatingAccounts": []}}
             else:
                 return {"jsonrpc": "2.0", "id": rid,
                         "error": {"code": -32601,

@@ -82,3 +82,31 @@ def test_get_version_and_epoch_info():
         assert e["transactionCount"] == 42
     finally:
         srv.close()
+
+
+
+def test_extended_methods():
+    """r4 additions: block height, latest blockhash, rent exemption,
+    genesis hash, identity, supply."""
+    funk = Funk()
+    funk.rec_write(None, b"\x01" * 32, Account(lamports=500))
+    funk.rec_write(None, b"\x02" * 32, 250)
+    srv = RpcServer(lambda: {"funk": funk, "slot": 10,
+                             "blockhash": b"\xab" * 32,
+                             "identity": b"\xcd" * 32})
+    try:
+        p = srv.port
+        assert call(p, "getBlockHeight")["result"] == 10
+        bh = call(p, "getLatestBlockhash")["result"]
+        assert bh["value"]["blockhash"] == b58_encode_32(b"\xab" * 32)
+        assert bh["value"]["lastValidBlockHeight"] == 160
+        from firedancer_tpu.svm.sysvars import rent_exempt_minimum
+        assert call(p, "getMinimumBalanceForRentExemption",
+                    [100])["result"] == rent_exempt_minimum(100)
+        assert isinstance(call(p, "getGenesisHash")["result"], str)
+        assert call(p, "getIdentity")["result"]["identity"] == \
+            b58_encode_32(b"\xcd" * 32)
+        sup = call(p, "getSupply")["result"]["value"]
+        assert sup["total"] == 750 and sup["nonCirculating"] == 0
+    finally:
+        srv.close()
