@@ -34,20 +34,21 @@ def _read(path: str) -> str | None:
 
 
 def stage_shm(wksp_bytes: int = 1 << 30) -> dict:
-    """The workspace backing store: /dev/shm must exist and hold the
-    planned topology (the reference's hugetlbfs-mount analog — our
-    wksps are shm_open regions, not hugepages)."""
+    """The workspace backing store must hold the planned topology:
+    /dev/shm normally, or the FDTPU_HUGETLBFS mount when workspaces
+    are redirected there (native/fdtpu.cc wksp_open_fd)."""
+    backing = os.environ.get("FDTPU_HUGETLBFS") or "/dev/shm"
     st = {"stage": "shm", "status": FAIL, "detail": "", "fix": ""}
     try:
-        s = os.statvfs("/dev/shm")
+        s = os.statvfs(backing)
     except OSError as e:
-        st["detail"] = f"/dev/shm unavailable: {e}"
-        st["fix"] = "mount -t tmpfs -o size=2g tmpfs /dev/shm"
+        st["detail"] = f"{backing} unavailable: {e}"
+        st["fix"] = "mount -t tmpfs -o size=2g tmpfs /dev/shm"             if backing == "/dev/shm" else             f"mount hugetlbfs at {backing} or unset FDTPU_HUGETLBFS"
         return st
     free = s.f_bavail * s.f_frsize
     total = s.f_blocks * s.f_frsize
-    st["detail"] = (f"free {free >> 20} MiB of {total >> 20} MiB, "
-                    f"want {wksp_bytes >> 20} MiB")
+    st["detail"] = (f"{backing}: free {free >> 20} MiB of "
+                    f"{total >> 20} MiB, want {wksp_bytes >> 20} MiB")
     if free >= wksp_bytes:
         st["status"] = PASS
     elif total >= wksp_bytes:
@@ -164,9 +165,44 @@ def stage_overcommit() -> dict:
             "sysctl -w vm.overcommit_memory=0"}
 
 
+def stage_hugepages() -> dict:
+    """Hugepage availability (the reference mounts hugetlbfs for its
+    workspaces; ours use them when FDTPU_HUGETLBFS names a mount —
+    native/fdtpu.cc wksp_open_fd)."""
+    total = 0
+    raw = _read("/proc/meminfo") or ""
+    for line in raw.splitlines():
+        if line.startswith("HugePages_Total"):
+            total = int(line.split()[1])
+    mounts = []
+    for line in (_read("/proc/mounts") or "").splitlines():
+        f = line.split()
+        if len(f) >= 3 and f[2] == "hugetlbfs":
+            # /proc/mounts octal-escapes spaces etc. (\040)
+            mp = f[1].encode().decode("unicode_escape")
+            mounts.append(os.path.realpath(mp))
+    env_raw = os.environ.get("FDTPU_HUGETLBFS", "")
+    env = os.path.realpath(env_raw.rstrip("/")) if env_raw else ""
+    st = {"stage": "hugepages", "status": PASS,
+          "detail": f"HugePages_Total={total}, mounts={mounts or '-'}"
+                    f", FDTPU_HUGETLBFS={env_raw or '-'}", "fix": ""}
+    if env and env not in mounts:
+        st["status"] = WARN
+        st["fix"] = (f"FDTPU_HUGETLBFS={env_raw} is not a hugetlbfs "
+                     f"mount; workspaces get normal pages there")
+    elif total == 0:
+        st["status"] = WARN
+        st["fix"] = ("no hugepages reserved; THP madvise still "
+                     "applies — for guaranteed pages: sysctl -w "
+                     "vm.nr_hugepages=N and mount hugetlbfs, then set "
+                     "FDTPU_HUGETLBFS")
+    return st
+
+
 def check(wksp_bytes: int = 1 << 30) -> list[dict]:
-    return [stage_shm(wksp_bytes), stage_nofile(), stage_memlock(),
-            stage_cpus(), stage_somaxconn(), stage_overcommit()]
+    return [stage_shm(wksp_bytes), stage_hugepages(), stage_nofile(),
+            stage_memlock(), stage_cpus(), stage_somaxconn(),
+            stage_overcommit()]
 
 
 def fix(wksp_bytes: int = 1 << 30) -> list[dict]:

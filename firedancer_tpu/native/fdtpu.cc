@@ -3,12 +3,15 @@
 
 #include <atomic>
 #include <cerrno>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
 
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/vfs.h>
 #include <unistd.h>
 
 namespace {
@@ -86,6 +89,59 @@ extern "C" {
 
 /* ---- workspace ------------------------------------------------------- */
 
+static uint64_t wksp_map_len(uint64_t sz) {
+  /* hugetlbfs requires hugepage-multiple lengths for ftruncate AND
+   * munmap; statfs f_bsize on the mount reports its hugepage size.
+   * Normal shm keeps the exact size. */
+  const char *hugedir = getenv("FDTPU_HUGETLBFS");
+  if (!hugedir || !hugedir[0]) return sz;
+  struct statfs sf;
+  if (statfs(hugedir, &sf) != 0 || sf.f_bsize <= 0) return sz;
+  uint64_t ps = (uint64_t)sf.f_bsize;
+  return (sz + ps - 1) / ps * ps;
+}
+
+static int wksp_open_fd(const char *name, int create) {
+  /* Backing store selection (the reference's hugepage workspaces,
+   * ref: src/util/shmem/fd_shmem.h — hugetlbfs-backed named regions):
+   * when FDTPU_HUGETLBFS names a hugetlbfs mount, workspaces are
+   * FILES there (real 2M/1G pages, kernel-enforced); otherwise
+   * POSIX shm (/dev/shm) as before. Every process resolves the env
+   * identically, so creators and joiners agree on the backing. */
+  const char *hugedir = getenv("FDTPU_HUGETLBFS");
+  char path[512];
+  int fd;
+  if (hugedir && hugedir[0]) {
+    int n = snprintf(path, sizeof path, "%s/%s", hugedir, name);
+    if (n < 0 || (size_t)n >= sizeof path) {
+      errno = ENAMETOOLONG;        /* refuse truncated paths: a
+                                    * truncated name could alias (and
+                                    * replace-mode unlink) the WRONG
+                                    * file */
+      return -1;
+    }
+    if (create) {
+      fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+      if (fd < 0 && errno == EEXIST && create == 2) {
+        unlink(path);
+        fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+      }
+    } else {
+      fd = open(path, O_RDWR);
+    }
+    return fd;
+  }
+  if (create) {
+    fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd < 0 && errno == EEXIST && create == 2) {
+      shm_unlink(name);
+      fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+    }
+    return fd;
+  }
+  return shm_open(name, O_RDWR, 0600);
+}
+
 void *fdtpu_wksp_join(const char *name, uint64_t sz, int create) {
   /* create=0: join existing; create=1: exclusive create (fails on
    * EEXIST — safe under racing creators); create=2: replace — unlink any
@@ -93,33 +149,47 @@ void *fdtpu_wksp_join(const char *name, uint64_t sz, int create) {
    * Replace mode is single-creator-discipline only: the caller asserts
    * no live process is using the name (the topology builder is the one
    * creator; every tile joins with create=0). */
-  int fd;
+  int fd = wksp_open_fd(name, create);
+  if (fd < 0) return nullptr;
+  uint64_t len = wksp_map_len(sz);
   if (create) {
-    fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
-    if (fd < 0 && errno == EEXIST && create == 2) {
-      shm_unlink(name);
-      fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
-    }
-    if (fd < 0) return nullptr;
-    if (ftruncate(fd, (off_t)sz) != 0) { close(fd); return nullptr; }
+    if (ftruncate(fd, (off_t)len) != 0) { close(fd); return nullptr; }
   } else {
-    fd = shm_open(name, O_RDWR, 0600);
-    if (fd < 0) return nullptr;
     /* joining: segment must already be at least the requested size */
     struct stat st;
-    if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < sz) {
+    if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < len) {
       close(fd);
       return nullptr;
     }
   }
-  void *p = mmap(nullptr, sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  void *p = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
-  return p == MAP_FAILED ? nullptr : p;
+  if (p == MAP_FAILED) return nullptr;
+#ifdef MADV_HUGEPAGE
+  /* best-effort THP for shmem-backed regions (kernels with
+   * shmem_enabled=advise honor this; harmless everywhere else) */
+  madvise(p, sz, MADV_HUGEPAGE);
+#endif
+  return p;
 }
 
-int fdtpu_wksp_leave(void *base, uint64_t sz) { return munmap(base, sz); }
+int fdtpu_wksp_leave(void *base, uint64_t sz) {
+  return munmap(base, wksp_map_len(sz));
+}
 
-int fdtpu_wksp_unlink(const char *name) { return shm_unlink(name); }
+int fdtpu_wksp_unlink(const char *name) {
+  const char *hugedir = getenv("FDTPU_HUGETLBFS");
+  if (hugedir && hugedir[0]) {
+    char path[512];
+    int n = snprintf(path, sizeof path, "%s/%s", hugedir, name);
+    if (n < 0 || (size_t)n >= sizeof path) {
+      errno = ENAMETOOLONG;
+      return -1;
+    }
+    return unlink(path);
+  }
+  return shm_unlink(name);
+}
 
 /* ---- ring ------------------------------------------------------------- */
 

@@ -8,8 +8,8 @@ from firedancer_tpu.app import configure as cf
 def test_check_runs_on_live_host():
     stages = cf.check(wksp_bytes=1 << 20)
     names = [s["stage"] for s in stages]
-    assert names == ["shm", "nofile", "memlock", "cpus", "somaxconn",
-                     "overcommit"]
+    assert names == ["shm", "hugepages", "nofile", "memlock", "cpus",
+                     "somaxconn", "overcommit"]
     for s in stages:
         assert s["status"] in (cf.PASS, cf.WARN, cf.FAIL)
         assert s["detail"]
