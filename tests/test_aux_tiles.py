@@ -213,7 +213,10 @@ def test_plugin_tile_streams_events_over_unix_socket(tmp_path):
 
     from firedancer_tpu.disco import Topology, TopologyRunner
 
-    pkts = [(i, bytes([i]) * 50) for i in range(1, 17)]
+    # 300 ms between packets: realtime pacing holds the stream open
+    # long enough for the client to attach (events emitted before any
+    # client connects are dropped by design)
+    pkts = [(i * 300_000, bytes([i]) * 50) for i in range(1, 17)]
     cap = str(tmp_path / "c.pcap")
     with open(cap, "wb") as f:
         write_pcap(f, pkts)
@@ -221,7 +224,7 @@ def test_plugin_tile_streams_events_over_unix_socket(tmp_path):
     topo = (
         Topology(f"pl{os.getpid()}", wksp_size=1 << 22)
         .link("feed", depth=64, mtu=256)
-        .tile("pcap", "pcap", outs=["feed"], path=cap, loop=1,
+        .tile("pcap", "pcap", outs=["feed"], path=cap, loop=3,
               realtime=True)                     # paced: client attaches
         .tile("plugin", "plugin", ins=[("feed", False)],
               sock_path=sock_path)
