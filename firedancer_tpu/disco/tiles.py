@@ -446,7 +446,15 @@ class BankAdapter:
         if self.exec_mode in ("svm", "general"):
             _setup_jax()
             from ..funk.funk import Funk
-            self.funk = Funk()
+            # genesis checkpoint: restore the WHOLE boot state (funded
+            # users + vote/stake accounts from app/genesis.py) — the
+            # dev command's wiring; production restores from snapshot
+            if args.get("genesis_ckpt"):
+                from ..utils.checkpt import funk_restore
+                with open(args["genesis_ckpt"], "rb") as gf:
+                    self.funk = funk_restore(Funk, gf)
+            else:
+                self.funk = Funk()
             self.xid = None            # published root
             self._next_xid = 1
             # genesis balances: airdropped synth accounts (tests inject
