@@ -116,6 +116,35 @@ class RpcServer:
             elif method == "getIdentity":
                 result = {"identity": b58_encode_32(
                     bytes(st.get("identity", bytes(32))))}
+            elif method == "getVoteAccounts":
+                funk = st.get("funk")
+                out = []
+                if funk is not None:
+                    from ..flamenco.stakes import vote_stakes
+                    from ..svm.vote import (VOTE_PROGRAM_ID, VoteState,
+                                            _HDR_SZ)
+                    slot = int(st.get("slot", 0))
+                    spe = int(st.get("slots_per_epoch", 432_000))
+                    stakes = vote_stakes(funk, None, slot // spe)
+                    for key, v in funk.items_at(None).items():
+                        if not isinstance(v, Account) \
+                                or v.owner != VOTE_PROGRAM_ID \
+                                or len(v.data) < _HDR_SZ:
+                            continue
+                        vs = VoteState.from_bytes(v.data)
+                        out.append({
+                            "votePubkey": b58_encode_32(key),
+                            "nodePubkey": b58_encode_32(vs.node_pubkey),
+                            "activatedStake": stakes.get(key, 0),
+                            "commission": vs.commission,
+                            "rootSlot": vs.root_slot,
+                            "epochCredits": [
+                                [ep, cr, prev] for ep, cr, prev
+                                in vs.epoch_credits[-5:]],
+                            "lastVote": (vs.tower.votes[-1].slot
+                                         if vs.tower.votes else 0),
+                        })
+                result = {"current": out, "delinquent": []}
             elif method == "getSupply":
                 funk = st.get("funk")
                 total = 0

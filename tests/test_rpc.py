@@ -110,3 +110,22 @@ def test_extended_methods():
         assert sup["total"] == 750 and sup["nonCirculating"] == 0
     finally:
         srv.close()
+
+
+def test_get_vote_accounts():
+    """getVoteAccounts over a genesis-built funk: stake resolves
+    through the same aggregation consensus uses."""
+    from firedancer_tpu.app.genesis import build_genesis
+    funk, validators = build_genesis(n_validators=2, stake=750)
+    srv = RpcServer(lambda: {"funk": funk, "slot": 200,
+                             "slots_per_epoch": 100})
+    try:
+        r = call(srv.port, "getVoteAccounts")["result"]
+        assert len(r["current"]) == 2 and r["delinquent"] == []
+        for va in r["current"]:
+            assert va["activatedStake"] == 750
+            assert isinstance(va["votePubkey"], str)
+            assert isinstance(va["nodePubkey"], str)
+            assert va["commission"] >= 0
+    finally:
+        srv.close()
