@@ -116,6 +116,40 @@ class RpcServer:
             elif method == "getIdentity":
                 result = {"identity": b58_encode_32(
                     bytes(st.get("identity", bytes(32))))}
+            elif method in ("getLeaderSchedule", "getSlotLeader"):
+                funk = st.get("funk")
+                slot = int(st.get("slot", 0))
+                spe = int(st.get("slots_per_epoch", 432_000))
+                epoch = slot // spe
+                if funk is None:
+                    result = None if method == "getLeaderSchedule" \
+                        else b58_encode_32(bytes(32))
+                else:
+                    from ..flamenco.leaders import EpochLeaders
+                    from ..flamenco.stakes import node_stakes
+                    stakes = node_stakes(funk, None, epoch)
+                    if not stakes:
+                        result = None if method == "getLeaderSchedule" \
+                            else b58_encode_32(bytes(32))
+                    else:
+                        el = EpochLeaders(
+                            epoch, bytes(st.get("leader_seed",
+                                                bytes(32))),
+                            stakes, spe)
+                        if method == "getSlotLeader":
+                            result = b58_encode_32(
+                                el.leader_for(slot))
+                        else:
+                            sched: dict[str, list[int]] = {}
+                            # cap the rendered window (432000 entries
+                            # would be a 3+MB response); real clusters
+                            # page via params — serve the first 1000
+                            # slots of the epoch, enough for tooling
+                            for i in range(min(spe, 1000)):
+                                k = b58_encode_32(
+                                    el.leader_for(epoch * spe + i))
+                                sched.setdefault(k, []).append(i)
+                            result = sched
             elif method == "getVoteAccounts":
                 funk = st.get("funk")
                 out = []

@@ -129,3 +129,23 @@ def test_get_vote_accounts():
             assert va["commission"] >= 0
     finally:
         srv.close()
+
+
+def test_leader_schedule_and_slot_leader():
+    """getLeaderSchedule/getSlotLeader over a genesis funk: the same
+    EpochLeaders consensus uses, rendered in the Solana shape."""
+    from firedancer_tpu.app.genesis import build_genesis
+    funk, validators = build_genesis(n_validators=3, stake=100)
+    srv = RpcServer(lambda: {"funk": funk, "slot": 250,
+                             "slots_per_epoch": 100})
+    try:
+        sched = call(srv.port, "getLeaderSchedule")["result"]
+        assert sched and sum(len(v) for v in sched.values()) == 100
+        leader = call(srv.port, "getSlotLeader")["result"]
+        assert isinstance(leader, str) and len(leader) >= 32
+        # the slot's leader appears at the right index in the schedule
+        assert 50 in sched[leader] or any(
+            250 % 100 in idxs for k, idxs in sched.items()
+            if k == leader)
+    finally:
+        srv.close()
