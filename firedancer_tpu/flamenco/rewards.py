@@ -31,7 +31,7 @@ from ..svm.stake import STAKE_PROGRAM_ID, StakeState
 from ..svm.vote import VOTE_PROGRAM_ID, VoteState, _HDR_SZ
 
 SLOT_SECONDS = 0.4
-EPOCHS_PER_YEAR_DENOM = 365.25 * 24 * 3600
+YEAR_SECONDS = 31_557_600       # Julian year: 365.25 * 24 * 3600
 
 INITIAL_RATE_BPS = 800          # 8.00 %/yr
 TAPER_BPS = 1500                # 15 % of itself per year
@@ -45,8 +45,8 @@ def inflation_rate_bps(epoch: int, slots_per_epoch: int) -> int:
     `epoch`: initial·(1−taper)^years, floored at terminal. Computed in
     integer bps with per-year taper multiplication so every validator
     lands on the identical value."""
-    years = int(epoch * slots_per_epoch * SLOT_SECONDS
-                / EPOCHS_PER_YEAR_DENOM)
+    # exact integer ratio (slots·0.4s vs 31557600s/yr → ×4 // ×10·year)
+    years = (epoch * slots_per_epoch * 4) // (10 * YEAR_SECONDS)
     rate = INITIAL_RATE_BPS
     for _ in range(years):
         rate = rate * (10_000 - TAPER_BPS) // 10_000
@@ -62,7 +62,7 @@ def epoch_validator_issuance(capitalization: int, epoch: int,
     exact integer ratio (slots·4, year·10) to avoid floats."""
     rate = inflation_rate_bps(epoch, slots_per_epoch)
     num = capitalization * rate * slots_per_epoch * 4
-    den = 10_000 * int(EPOCHS_PER_YEAR_DENOM * 10)
+    den = 10_000 * YEAR_SECONDS * 10
     return num // den
 
 

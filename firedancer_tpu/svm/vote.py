@@ -99,15 +99,18 @@ class VoteState:
         """Agave vote_state::increment_credits: per-epoch history with
         a 64-entry cap, cumulative + previous-cumulative per entry."""
         if not self.epoch_credits:
-            self.epoch_credits.append((epoch, self.credits, self.credits))
+            # Agave vote_state::increment_credits seeds the empty
+            # history with (epoch, 0, 0) — pre-existing credits must
+            # not inflate the first rewarded epoch's earned delta.
+            self.epoch_credits.append((epoch, 0, 0))
         elif self.epoch_credits[-1][0] != epoch:
             _, cr, _ = self.epoch_credits[-1]
             self.epoch_credits.append((epoch, cr, cr))
             if len(self.epoch_credits) > 64:
                 self.epoch_credits.pop(0)
         self.credits += 1
-        ep, _, prev = self.epoch_credits[-1]
-        self.epoch_credits[-1] = (ep, self.credits, prev)
+        ep, cr, prev = self.epoch_credits[-1]
+        self.epoch_credits[-1] = (ep, cr + 1, prev)
 
     def apply_vote(self, slots: list[int], timestamp: int = 0,
                    epoch: int = 0) -> int:

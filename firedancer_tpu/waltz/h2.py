@@ -46,6 +46,7 @@ S_MAX_FRAME_SIZE = 0x5
 
 DEFAULT_WINDOW = 65535
 MAX_FRAME = 16384
+MAX_HEADER_BLOCK = 1 << 18      # cap on reassembled CONTINUATION blocks
 
 
 class H2Error(ConnectionError):
@@ -120,6 +121,11 @@ class Conn:
             if len(self._rx) < 9:
                 return
             ln = int.from_bytes(self._rx[:3], "big")
+            if ln > MAX_FRAME:
+                # RFC 9113 §4.2: larger than our advertised
+                # SETTINGS_MAX_FRAME_SIZE — fail before buffering so a
+                # hostile peer cannot grow _rx unboundedly.
+                raise H2Error("FRAME_SIZE_ERROR: %d > %d" % (ln, MAX_FRAME))
             if len(self._rx) < 9 + ln:
                 return
             ftype, flags = self._rx[3], self._rx[4]
@@ -167,12 +173,21 @@ class Conn:
                 st.reset = struct.unpack(">I", payload[:4])[0]
                 st.remote_closed = True
         elif ftype == FT_HEADERS:
+            # RFC 9113 §6.2 layout: [pad len][priority 5B][fragment][pad]
             body = payload
+            pad = 0
             if flags & F_PADDED:
-                pad = body[0]
-                body = body[1:len(body) - pad]
+                if not body:
+                    raise H2Error("PROTOCOL_ERROR: pad >= frame payload")
+                pad, body = body[0], body[1:]
             if flags & F_PRIORITY:
+                if len(body) < 5:
+                    raise H2Error("PROTOCOL_ERROR: truncated priority")
                 body = body[5:]
+            if pad > len(body):
+                # padding may not eat into priority/fragment space
+                raise H2Error("PROTOCOL_ERROR: pad >= frame payload")
+            body = body[:len(body) - pad]
             if flags & F_END_HEADERS:
                 self._on_headers(sid, body, flags)
             else:
@@ -182,6 +197,11 @@ class Conn:
         elif ftype == FT_CONTINUATION:
             if sid != self._cont_sid:
                 raise H2Error("CONTINUATION stream mismatch")
+            if len(self._cont_buf) + len(payload) > MAX_HEADER_BLOCK:
+                # unbounded CONTINUATION accumulation is the same DoS
+                # class as the oversized-frame announcement
+                raise H2Error("ENHANCE_YOUR_CALM: header block > %d"
+                              % MAX_HEADER_BLOCK)
             self._cont_buf += payload
             if flags & F_END_HEADERS:
                 csid, cbuf = self._cont_sid, self._cont_buf
@@ -194,8 +214,9 @@ class Conn:
                 return
             body = payload
             if flags & F_PADDED:
-                pad = body[0]
-                body = body[1:len(body) - pad]
+                if not body or body[0] >= len(body):
+                    raise H2Error("PROTOCOL_ERROR: pad >= frame payload")
+                body = body[1:len(body) - body[0]]
             st.data += body
             # liberal flow control: replenish both windows immediately
             if len(payload):
@@ -223,21 +244,34 @@ class Conn:
 
     # -- sending ------------------------------------------------------------
 
+    def _tx_headers(self, sid: int, headers, end_stream: bool):
+        """Emit a header block, splitting into HEADERS + CONTINUATION
+        frames when the HPACK encoding exceeds the peer's frame size
+        (RFC 9113 §6.10) — the receive side enforces the cap, so the
+        send side must honor it too."""
+        block = hpack.encode(headers)
+        limit = min(self.peer_max_frame, MAX_FRAME)
+        chunk, block = block[:limit], block[limit:]
+        flags = (F_END_STREAM if end_stream else 0) \
+            | (0 if block else F_END_HEADERS)
+        self._tx += frame(FT_HEADERS, flags, sid, chunk)
+        while block:
+            chunk, block = block[:limit], block[limit:]
+            self._tx += frame(FT_CONTINUATION,
+                              0 if block else F_END_HEADERS, sid, chunk)
+
     def open_stream(self, headers: list[tuple[bytes, bytes]],
                     end_stream: bool = False) -> Stream:
         sid = self.next_sid
         self.next_sid += 2
         st = self.streams[sid] = Stream(sid)
         st.send_window = self.peer_initial_window
-        flags = F_END_HEADERS | (F_END_STREAM if end_stream else 0)
-        self._tx += frame(FT_HEADERS, flags, sid, hpack.encode(headers))
+        self._tx_headers(sid, headers, end_stream)
         st.local_closed = end_stream
         return st
 
     def send_headers(self, st: Stream, headers, end_stream=False):
-        flags = F_END_HEADERS | (F_END_STREAM if end_stream else 0)
-        self._tx += frame(FT_HEADERS, flags, st.sid,
-                          hpack.encode(headers))
+        self._tx_headers(st.sid, headers, end_stream)
         st.local_closed = st.local_closed or end_stream
 
     def send_data(self, st: Stream, data: bytes, end_stream=False):

@@ -494,6 +494,7 @@ class _Conn:
         self.c1rtt: Keys | None = None
         self.s1rtt: Keys | None = None
         self.client_cid = b""
+        self.cid_latched = False    # RFC 9000 allows zero-length SCIDs
         self.streams: dict[int, _Stream] = {}
         self.tx_pn = 0                        # 1-RTT pn space
         self.tx_pn_i = 0                      # Initial pn space
@@ -620,7 +621,13 @@ class QuicServer:
             opened += 1
             off += consumed
             if ptype == PT_INITIAL:
-                conn.client_cid = scid
+                # Latch the return-CID on the FIRST authenticated
+                # Initial only: Initial keys derive from the public
+                # DCID, so an off-path forger could otherwise redirect
+                # our flights with a bogus SCID pre-handshake.
+                if not conn.cid_latched:
+                    conn.client_cid = scid
+                    conn.cid_latched = True
                 initial_seen = True
             else:
                 conn.initial_done = True

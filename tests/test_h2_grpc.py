@@ -104,6 +104,34 @@ def test_h2_ping_and_rst():
     assert st.reset == 0x8 and st.remote_closed
 
 
+def test_h2_rejects_oversized_frame_announcement():
+    # RFC 9113 §4.2: a declared length beyond our SETTINGS_MAX_FRAME_SIZE
+    # must fail fast instead of accumulating in the rx buffer.
+    srv = h2.Conn(is_client=False)
+    srv.feed(h2.PREFACE + h2.frame(h2.FT_SETTINGS, 0, 0, b""))
+    hdr = (1 << 20).to_bytes(3, "big") + bytes([h2.FT_DATA, 0]) \
+        + struct.pack(">I", 1)
+    with pytest.raises(h2.H2Error, match="FRAME_SIZE"):
+        srv.feed(hdr)
+
+
+def test_h2_rejects_pad_length_ge_payload():
+    # RFC 9113 §6.1/6.2: pad length >= payload length is PROTOCOL_ERROR.
+    cli = h2.Conn(is_client=True)
+    srv = h2.Conn(is_client=False)
+    _pump_pair(cli, srv)
+    st = cli.open_stream([(b":method", b"POST"), (b":path", b"/p")])
+    _pump_pair(cli, srv)
+    bad = bytes([200]) + b"xy"           # pad 200 >= 3-byte payload
+    with pytest.raises(h2.H2Error, match="pad"):
+        srv.feed(h2.frame(h2.FT_DATA, h2.F_PADDED, st.sid, bad))
+    srv2 = h2.Conn(is_client=False)
+    srv2.feed(h2.PREFACE + h2.frame(h2.FT_SETTINGS, 0, 0, b""))
+    with pytest.raises(h2.H2Error, match="pad"):
+        srv2.feed(h2.frame(h2.FT_HEADERS,
+                           h2.F_PADDED | h2.F_END_HEADERS, 1, bad))
+
+
 # -- protobuf codec ----------------------------------------------------------
 
 def test_protobuf_codec_roundtrip():
@@ -294,3 +322,52 @@ def test_bundle_oversize_message_counted_not_crash():
         cli.close()
     finally:
         srv.close()
+
+
+def test_h2_empty_padded_frame_rejected():
+    cli = h2.Conn(is_client=True)
+    srv = h2.Conn(is_client=False)
+    _pump_pair(cli, srv)
+    st = cli.open_stream([(b":method", b"POST"), (b":path", b"/e")])
+    _pump_pair(cli, srv)
+    with pytest.raises(h2.H2Error, match="pad"):
+        srv.feed(h2.frame(h2.FT_DATA, h2.F_PADDED, st.sid, b""))
+
+
+def test_h2_large_header_block_splits_into_continuations():
+    # sender must not emit a HEADERS frame beyond the peer frame size;
+    # RFC 9113 §6.10 CONTINUATION splitting, round-tripped here.
+    cli = h2.Conn(is_client=True)
+    srv = h2.Conn(is_client=False)
+    _pump_pair(cli, srv)
+    hdrs = [(b":method", b"POST"), (b":path", b"/big")]
+    hdrs += [(b"x-meta-%d" % i, bytes(90) + b"%d" % i) for i in range(400)]
+    st = cli.open_stream(hdrs, end_stream=True)
+    _pump_pair(cli, srv, rounds=6)
+    sst = srv.streams[st.sid]
+    got = dict(sst.headers)
+    assert got[b":path"] == b"/big"
+    assert got[b"x-meta-399"].endswith(b"399")
+    assert sst.remote_closed
+
+
+def test_h2_continuation_accumulation_capped():
+    srv = h2.Conn(is_client=False)
+    srv.feed(h2.PREFACE + h2.frame(h2.FT_SETTINGS, 0, 0, b""))
+    srv.feed(h2.frame(h2.FT_HEADERS, 0, 1, b"\x00" * 100))  # no END_HEADERS
+    blk = h2.frame(h2.FT_CONTINUATION, 0, 1, b"\x00" * h2.MAX_FRAME)
+    with pytest.raises(h2.H2Error, match="CALM"):
+        for _ in range(2 + h2.MAX_HEADER_BLOCK // h2.MAX_FRAME):
+            srv.feed(blk)
+
+
+def test_h2_headers_pad_cannot_eat_priority_fields():
+    # RFC 9113 §6.2: padding exceeding the fragment space is
+    # PROTOCOL_ERROR even when a priority section hides the overlap.
+    srv = h2.Conn(is_client=False)
+    srv.feed(h2.PREFACE + h2.frame(h2.FT_SETTINGS, 0, 0, b""))
+    payload = bytes([8]) + bytes(5) + bytes(4)   # pad 8 > 4-byte fragment
+    with pytest.raises(h2.H2Error, match="pad"):
+        srv.feed(h2.frame(h2.FT_HEADERS,
+                          h2.F_PADDED | h2.F_PRIORITY | h2.F_END_HEADERS,
+                          1, payload))

@@ -162,3 +162,14 @@ def test_quiet_epochs_all_paid_and_marker_persists():
     start = rw.paid_through(funk, "e")
     assert start == 3                    # nothing below 3 re-paid
     assert amt_after > 1_000_000
+
+
+def test_inflation_years_is_exact_integer_ratio():
+    # years must come from an exact integer ratio, not IEEE rounding
+    # (ADVICE r4): epoch*spe*0.4s vs 31557600 s/yr.
+    from firedancer_tpu.flamenco import rewards as rw
+    spe = 432_000
+    # one Julian year = 78_894_000 slots at 0.4 s → epoch 182.625*spe
+    edge = (10 * 31_557_600) // 4 // spe + 1      # first epoch past 1yr
+    assert rw.inflation_rate_bps(edge, spe) < rw.INITIAL_RATE_BPS
+    assert rw.inflation_rate_bps(0, spe) == rw.INITIAL_RATE_BPS

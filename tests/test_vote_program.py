@@ -165,3 +165,20 @@ def test_authorize_and_update_commission(env):
     assert ex.execute("blk", t).status == OK
     st = VoteState.from_bytes(db.peek("blk", VOTE_ACCT).data)
     assert st.commission == 42
+
+
+def test_epoch_credits_seed_matches_agave():
+    # Agave increment_credits seeds an empty history with (epoch, 0, 0)
+    # so pre-existing account credits never inflate the first rewarded
+    # epoch's earned delta (ADVICE r4).
+    from firedancer_tpu.svm.vote import VoteState
+    st = VoteState(node_pubkey=b"\x01" * 32, authorized_voter=b"\x02" * 32,
+                   authorized_withdrawer=b"\x02" * 32)
+    st.credits = 1000                       # pre-existing, empty history
+    st._increment_credits(epoch=7)
+    ep, cr, prev = st.epoch_credits[-1]
+    assert (ep, cr, prev) == (7, 1, 0)
+    st._increment_credits(epoch=7)
+    assert st.epoch_credits[-1] == (7, 2, 0)
+    st._increment_credits(epoch=8)
+    assert st.epoch_credits[-1] == (8, 3, 2)
