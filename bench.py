@@ -297,16 +297,20 @@ def main():
         # outage never erases the chip-measured number (the r3 lesson:
         # "a perf claim that isn't in the driver artifact doesn't
         # exist")
-        wit_path = os.path.join(HERE, "BENCH_r04_witnessed.json")
-        if result.get("platform", "").startswith("cpu") \
-                and os.path.exists(wit_path):
-            try:
-                with open(wit_path) as f:
-                    wit = json.load(f)
-                if wit.get("platform") == "tpu":
-                    result["witnessed_tpu"] = wit
-            except (OSError, json.JSONDecodeError):
-                pass
+        if result.get("platform", "").startswith("cpu"):
+            for name in ("BENCH_r05_witnessed.json",
+                         "BENCH_r04_witnessed.json"):
+                wit_path = os.path.join(HERE, name)
+                if not os.path.exists(wit_path):
+                    continue
+                try:
+                    with open(wit_path) as f:
+                        wit = json.load(f)
+                    if wit.get("platform") == "tpu":
+                        result["witnessed_tpu"] = wit
+                        break
+                except (OSError, json.JSONDecodeError):
+                    pass
     else:
         try:
             e2e = _run_child(

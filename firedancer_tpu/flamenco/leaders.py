@@ -4,21 +4,22 @@ SLOTS_PER_ROTATION slots, deduped pubkey table; sampling via a
 ChaCha20 RNG over cumulative stakes, ref fd_leaders.c:112 +
 src/ballet/wsample/).
 
-The schedule derives deterministically from (epoch seed, stake map):
-stakes sort descending with pubkey tie-break (consensus requires every
-validator to derive the identical table), then each rotation draws one
-leader by cumulative-stake inversion of a bounded uniform draw. The
-RNG stream layout follows the reference's structure; byte-for-byte
-Agave equivalence is NOT claimed here (that requires replicating
-rand_chacha's exact WeightedIndex consumption) — determinism and
-stake-proportionality are what the tests pin.
+The schedule derives deterministically from (epoch, stake map) and is
+**draw-for-draw identical to Agave's** (pinned against the reference's
+mainnet epoch-454 fixtures in tests/test_leaders_agave.py):
 
-INTEROP BLOCKER (tracked): on a real cluster this node would compute a
-different leader for every slot than Agave peers. Before any
-real-cluster milestone this must replicate rand_chacha's exact draw
-sequence (ChaCha20 block order + WeightedIndex's f64 cumulative-weight
-inversion). Self-contained clusters (all nodes this framework) are
-unaffected — every node derives the identical table.
+- stakes aggregate by node identity, then sort by stake descending
+  with pubkey DESCENDING tie-break (ref fd_leaders.c sort_vote_weights
+  _by_stake_id: memcmp(a,b) > 0 orders first);
+- the RNG is rand_chacha's ChaCha20Rng seeded with the epoch number as
+  little-endian u64 in a zeroed 32-byte key (ref fd_leaders.c:112);
+- each of ceil(slots/4) rotations draws Uniform<u64>[0, total_stake)
+  with rand 0.7's widening-multiply rejection (MODE_MOD, ref
+  fd_chacha_rng.h) and takes the first index whose cumulative stake
+  exceeds the draw (WeightedIndex semantics, ref fd_wsample.h:12-15).
+
+An explicit `seed` overrides the epoch-derived key for self-contained
+cluster tests; wire-parity requires seed=None.
 """
 from __future__ import annotations
 
@@ -28,14 +29,25 @@ from ..utils.chacha import ChaChaRng
 
 SLOTS_PER_ROTATION = 4          # FD_EPOCH_SLOTS_PER_ROTATION
 
+# base58 "1111111111indeterminateLeader9QSxFYNqsXA" — the placeholder
+# the reference returns for draws landing in the excluded-stake tail
+# (ref fd_leaders.h FD_INDETERMINATE_LEADER)
+INDETERMINATE_LEADER = bytes.fromhex(
+    "00000000000000000000" "99f60f962cdd3821f30c161de30a"
+    "0badf00d0badf00d")
+
 
 class WeightedSampler:
     """Cumulative-stake inversion sampler (src/ballet/wsample/
-    fd_wsample.h semantics, sampling WITH replacement)."""
+    fd_wsample.h semantics, sampling WITH replacement; draw-compatible
+    with rand's WeightedIndex via roll_mod). An `excluded` weight
+    models the reference's poisoned tail: draws landing past the live
+    cumulative range return index len(keys) (indeterminate)."""
 
-    def __init__(self, weighted: list[tuple[bytes, int]]):
+    def __init__(self, weighted: list[tuple[bytes, int]],
+                 excluded: int = 0):
         """weighted: (pubkey, stake), stake > 0; order = consensus
-        order (descending stake, pubkey tie-break)."""
+        order (descending stake, pubkey DESC tie-break)."""
         assert weighted, "empty stake set"
         self.keys = [k for k, _ in weighted]
         self.cum = []
@@ -44,28 +56,45 @@ class WeightedSampler:
             assert w > 0
             total += w
             self.cum.append(total)
-        self.total = total
+        self.total = total + excluded
+
+    def sample_idx(self, rng: ChaChaRng) -> int:
+        x = rng.roll_mod(self.total)
+        return bisect.bisect_right(self.cum, x)
 
     def sample(self, rng: ChaChaRng) -> bytes:
-        x = rng.roll_u64(self.total)
-        return self.keys[bisect.bisect_right(self.cum, x)]
+        i = self.sample_idx(rng)
+        return self.keys[i] if i < len(self.keys) else INDETERMINATE_LEADER
+
+
+def sort_stakes(stakes: dict[bytes, int]) -> list[tuple[bytes, int]]:
+    """Consensus stake order: stake descending, pubkey descending
+    tie-break (ref fd_leaders.c sort_vote_weights_by_stake_id)."""
+    return sorted(((k, s) for k, s in stakes.items() if s > 0),
+                  key=lambda kv: (kv[1], kv[0]), reverse=True)
+
+
+def epoch_seed(epoch: int) -> bytes:
+    """Agave's leader-schedule RNG key: epoch as LE u64, zero-padded
+    to 32 bytes (ref fd_leaders.c:112-115)."""
+    return epoch.to_bytes(8, "little") + bytes(24)
 
 
 class EpochLeaders:
-    def __init__(self, epoch: int, seed: bytes, stakes: dict[bytes, int],
-                 slots_per_epoch: int,
-                 slots_per_rotation: int = SLOTS_PER_ROTATION):
+    def __init__(self, epoch: int, seed: bytes | None,
+                 stakes: dict[bytes, int], slots_per_epoch: int,
+                 slots_per_rotation: int = SLOTS_PER_ROTATION,
+                 excluded_stake: int = 0):
         """stakes: node identity pubkey -> active stake (zero-stake
-        nodes never lead)."""
+        nodes never lead). seed=None derives Agave's epoch key; a
+        bytes seed overrides it (self-contained clusters only)."""
         self.epoch = epoch
         self.slots_per_epoch = slots_per_epoch
         self.slots_per_rotation = slots_per_rotation
         self.slot0 = epoch * slots_per_epoch
-        weighted = sorted(
-            ((k, s) for k, s in stakes.items() if s > 0),
-            key=lambda kv: (-kv[1], kv[0]))
-        sampler = WeightedSampler(weighted)
-        rng = ChaChaRng(seed)
+        weighted = sort_stakes(stakes)
+        sampler = WeightedSampler(weighted, excluded=excluded_stake)
+        rng = ChaChaRng(epoch_seed(epoch) if seed is None else seed)
         n_rot = -(-slots_per_epoch // slots_per_rotation)
         # deduped pubkey table + per-rotation index, the reference's
         # space layout (fd_leaders.h "dedup pubkeys into a lookup table")

@@ -132,9 +132,10 @@ class RpcServer:
                         result = None if method == "getLeaderSchedule" \
                             else b58_encode_32(bytes(32))
                     else:
+                        seed = st.get("leader_seed")
                         el = EpochLeaders(
-                            epoch, bytes(st.get("leader_seed",
-                                                bytes(32))),
+                            epoch,
+                            bytes(seed) if seed is not None else None,
                             stakes, spe)
                         if method == "getSlotLeader":
                             result = b58_encode_32(

@@ -74,3 +74,32 @@ class ChaChaRng:
             v = self.next_u64()
             if v < zone:
                 return v % bound
+
+    def roll_mod(self, bound: int) -> int:
+        """Uniform in [0, bound) matching Rust rand 0.7
+        Uniform<u64>::sample — the widening-multiply rejection with the
+        LARGEST k (MODE_MOD), as consumed by Agave's leader-schedule
+        WeightedIndex draws (ref: src/ballet/chacha/fd_chacha_rng.h
+        fd_chacha20_rng_ulong_roll, FD_CHACHA_RNG_MODE_MOD): accept
+        v·n's low half when <= 2^64-1 - (2^64-n)%n, answer is the high
+        half."""
+        assert 0 < bound < 1 << 64
+        m = (1 << 64) - 1
+        zone = m - (m - bound + 1) % bound
+        while True:
+            res = self.next_u64() * bound
+            if res & m <= zone:
+                return res >> 64
+
+    def roll_shift(self, bound: int) -> int:
+        """Uniform in [0, bound) with the power-of-two zone (MODE_SHIFT)
+        — the variant Agave's Turbine weighted shuffle consumes (ref:
+        src/ballet/chacha/fd_chacha_rng.h: zone =
+        (n << (63 - msb(n))) - 1)."""
+        assert 0 < bound < 1 << 64
+        m = (1 << 64) - 1
+        zone = ((bound << (64 - bound.bit_length())) - 1) & m
+        while True:
+            res = self.next_u64() * bound
+            if res & m <= zone:
+                return res >> 64

@@ -131,10 +131,12 @@ def _cluster(n, seed=7):
 
 def test_turbine_tree_partition():
     """Every non-leader node appears exactly once; children sets are
-    disjoint; the union of root + all children covers the cluster."""
+    disjoint; root + all children cover the cluster (fanout chosen so
+    the 3-level Agave tree spans: cnt-1 <= fanout^2 + fanout)."""
     nodes = _cluster(50)
     leader = nodes[0].pubkey
-    sd = ShredDest(nodes, self_pubkey=nodes[1].pubkey, fanout=4)
+    # first_hop is the LEADER's query (compute_first removes source)
+    sd = ShredDest(nodes, self_pubkey=leader, fanout=7)
     order = sd.tree_positions(5, 17, 0x80, leader)
     assert len(order) == 49 and leader not in order
     assert len(set(order)) == 49
@@ -142,7 +144,7 @@ def test_turbine_tree_partition():
     for n in nodes:
         if n.pubkey == leader:
             continue
-        sdn = ShredDest(nodes, self_pubkey=n.pubkey, fanout=4)
+        sdn = ShredDest(nodes, self_pubkey=n.pubkey, fanout=7)
         for c in sdn.children(5, 17, 0x80, leader):
             assert c.pubkey not in seen, "child claimed twice"
             seen.add(c.pubkey)
@@ -167,7 +169,8 @@ def test_turbine_stake_weighting():
     whale = ClusterNode(pubkey=b"\xaa" * 32, stake=10**12)
     nodes.append(whale)
     leader = nodes[0].pubkey
-    sd = ShredDest(nodes, self_pubkey=whale.pubkey, fanout=6)
+    # the leader runs compute_first (source == self is removed)
+    sd = ShredDest(nodes, self_pubkey=leader, fanout=6)
     hits = sum(sd.first_hop(5, i, 0x80, leader).pubkey == whale.pubkey
                for i in range(40))
     assert hits >= 30, hits
