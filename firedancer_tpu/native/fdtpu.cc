@@ -262,6 +262,42 @@ uint64_t fdtpu_ring_publish_buf(void *base, uint64_t ring_off, uint64_t sig,
   return fdtpu_ring_publish(base, ring_off, sig, chunk, sz, ctl, orig);
 }
 
+int64_t fdtpu_ring_publish_batch(void *base, uint64_t ring_off,
+                                 const uint8_t *buf, uint64_t stride,
+                                 const uint32_t *sizes,
+                                 const uint64_t *sigs,
+                                 const uint8_t *mask, int64_t start,
+                                 int64_t n, uint64_t arena_off,
+                                 uint64_t mtu, const uint64_t *fseq_offs,
+                                 int n_fseq, int64_t *published) {
+  /* Publish masked rows [start, n) of a gathered buffer in one native
+   * call, honoring reliable-consumer credits. Returns the row index it
+   * stopped at (== n when done; < n when credits ran out — the caller
+   * heartbeats and resumes). Credits are re-queried in blocks so the
+   * fseq loads stay off the per-row path. */
+  RingHdr *h = ring_hdr(base, ring_off);
+  int64_t credits = n_fseq ? fdtpu_fctl_credits(base, ring_off, fseq_offs,
+                                                n_fseq)
+                           : (int64_t)h->depth;
+  int64_t i = start;
+  for (; i < n; i++) {
+    if (!mask[i]) continue;
+    if (n_fseq && credits <= 0) {
+      credits = fdtpu_fctl_credits(base, ring_off, fseq_offs, n_fseq);
+      if (credits <= 0) break;
+    }
+    uint64_t seq = fdtpu_ring_prepare(base, ring_off);
+    uint64_t chunk = arena_off + (seq & (h->depth - 1)) * mtu;
+    uint32_t sz = sizes[i] <= mtu ? sizes[i] : (uint32_t)mtu;
+    std::memcpy(at(base, chunk), buf + (uint64_t)i * stride, sz);
+    fdtpu_ring_publish(base, ring_off, sigs ? sigs[i] : 0, chunk, sz,
+                       /*ctl=*/3, /*orig=*/0);
+    credits--;
+    if (published) (*published)++;
+  }
+  return i;
+}
+
 int fdtpu_ring_consume(void *base, uint64_t ring_off, uint64_t seq,
                        fdtpu_frag_t *out) {
   RingHdr *h = ring_hdr(base, ring_off);

@@ -92,6 +92,18 @@ uint64_t fdtpu_ring_publish_buf(void *base, uint64_t ring_off, uint64_t sig,
                                 const uint8_t *data, uint32_t sz,
                                 uint64_t arena_off, uint64_t mtu,
                                 uint16_t ctl, uint16_t orig);
+/* Credit-gated batch publish of masked rows [start, n) of a gathered
+ * (n, stride) buffer; returns the stop row (== n when complete, < n
+ * when out of credits — heartbeat and resume). *published counts rows
+ * actually sent. */
+int64_t fdtpu_ring_publish_batch(void *base, uint64_t ring_off,
+                                 const uint8_t *buf, uint64_t stride,
+                                 const uint32_t *sizes,
+                                 const uint64_t *sigs,
+                                 const uint8_t *mask, int64_t start,
+                                 int64_t n, uint64_t arena_off,
+                                 uint64_t mtu, const uint64_t *fseq_offs,
+                                 int n_fseq, int64_t *published);
 
 /* Speculative consume at `seq`:
  *   returns  0: frag copied into *out (stable — seq re-check passed)

@@ -43,6 +43,11 @@ def _load():
         "fdtpu_ring_prepare": (u64, [vp, u64]),
         "fdtpu_ring_publish": (u64, [vp, u64, u64, u64, u32, u16, u16]),
         "fdtpu_ring_consume": (ct.c_int, [vp, u64, u64, vp]),
+        "fdtpu_ring_publish_batch": (
+            i64, [vp, u64, ct.POINTER(ct.c_uint8), u64,
+                  ct.POINTER(u32), ct.POINTER(u64),
+                  ct.POINTER(ct.c_uint8), i64, i64, u64, u64,
+                  ct.POINTER(u64), ct.c_int, ct.POINTER(i64)]),
         "fdtpu_fseq_footprint": (u64, []),
         "fdtpu_fseq_init": (ct.c_int, [vp, u64, u64]),
         "fdtpu_fseq_query": (u64, [vp, u64]),
@@ -187,6 +192,35 @@ class Ring:
         self.wksp.view(slot_off, data.nbytes)[:] = data
         return lib.fdtpu_ring_publish(self.wksp.base, self.off, sig,
                                       slot_off, data.nbytes, ctl, orig)
+
+    def publish_batch(self, buf: np.ndarray, sizes: np.ndarray,
+                      sigs: np.ndarray, mask: np.ndarray,
+                      fseqs: list["Fseq"] | None = None,
+                      start: int = 0) -> tuple[int, int]:
+        """Credit-gated native publish of masked rows of a gathered
+        (n, stride) buffer — the verify tile's egress hot loop in ONE
+        C call. Returns (stop_row, published): stop_row < len(buf)
+        means credits ran out; heartbeat and resume from stop_row."""
+        assert self.mtu, "ring has no payload arena"
+        n, stride = buf.shape
+        buf = np.ascontiguousarray(buf, np.uint8)
+        sizes = np.ascontiguousarray(sizes, np.uint32)
+        assert not len(sizes) or int(sizes.max()) <= self.mtu, \
+            "payload larger than ring mtu"
+        sigs = np.ascontiguousarray(sigs, np.uint64)
+        mask = np.ascontiguousarray(mask, np.uint8)
+        offs = (ct.c_uint64 * len(fseqs))(*[f.off for f in fseqs]) \
+            if fseqs else None
+        pub = ct.c_int64(0)
+        stop = lib.fdtpu_ring_publish_batch(
+            self.wksp.base, self.off,
+            buf.ctypes.data_as(ct.POINTER(ct.c_uint8)), stride,
+            sizes.ctypes.data_as(ct.POINTER(ct.c_uint32)),
+            sigs.ctypes.data_as(ct.POINTER(ct.c_uint64)),
+            mask.ctypes.data_as(ct.POINTER(ct.c_uint8)),
+            start, n, self.arena_off, self.mtu,
+            offs, len(fseqs) if fseqs else 0, ct.byref(pub))
+        return int(stop), int(pub.value)
 
     def consume(self, seq: int):
         """-> (rc, Frag). rc 0=ok, 1=not yet, -1=overrun."""

@@ -248,3 +248,35 @@ print(bytes(bytearray(w.view(0, 8))))
     r2 = subprocess.run([sys.executable, "-c", code2], env=env,
                         capture_output=True, text=True, timeout=60)
     assert "hugedata" in r2.stdout, r2.stderr
+
+
+def test_ring_publish_batch_masked_and_credit_gated(wksp):
+    ring = Ring.create(wksp, depth=8, mtu=MTU)
+    f = Fseq(wksp)
+    n = 12
+    buf = np.zeros((n, MTU), np.uint8)
+    for i in range(n):
+        buf[i, :4] = i
+    sizes = np.full(n, 4, np.uint32)
+    sigs = np.arange(n, dtype=np.uint64)
+    mask = np.ones(n, np.uint8)
+    mask[5] = 0                       # hole: row 5 must not publish
+    stop, pub = ring.publish_batch(buf, sizes, sigs, mask, fseqs=[f])
+    assert pub == 8                   # depth-limited by the consumer
+    assert stop < n
+    f.update(8)                       # consumer catches up
+    stop, pub2 = ring.publish_batch(buf, sizes, sigs, mask, fseqs=[f],
+                                    start=stop)
+    assert stop == n and pub + pub2 == n - 1
+    # 11 publishes on a depth-8 ring: the first 3 slots were lapped;
+    # the live window holds the last 8 published sigs
+    published = [i for i in range(n) if i != 5]
+    got = []
+    seq = 3
+    while True:
+        rc, frag = ring.consume(seq)
+        if rc != 0:
+            break
+        got.append(int(frag.sig))
+        seq += 1
+    assert got == published[3:]

@@ -138,3 +138,44 @@ def test_verify_tile_credit_gating(wksp, txns):
     th.join(timeout=30)
     assert not th.is_alive()
     assert got == txns[:n]
+
+
+def test_verify_tile_pipelined_inflight(wksp, txns):
+    """Multiple microbatches queue on the device before the first
+    verdict is read back; ordering, dedup and fail-closed semantics
+    hold across the in-flight window, and flush() retires the tail."""
+    in_ring = Ring.create(wksp, depth=256, mtu=1280)
+    out_ring = Ring.create(wksp, depth=256, mtu=1280)
+    tc = Tcache(wksp, depth=512)
+    os.environ["FDTPU_VERIFY_INFLIGHT"] = "3"
+    try:
+        tile = VerifyTile(in_ring, out_ring, tc, batch=16)
+    finally:
+        del os.environ["FDTPU_VERIFY_INFLIGHT"]
+    assert tile.inflight == 3
+    bad = bytearray(txns[4])
+    bad[2] ^= 1
+    bad[-1] ^= 1
+    feed = [bytes(t) for t in txns[:12]] + [bytes(bad)]
+    # feed in small groups with polls between, so several gathered
+    # sets stack up inside the in-flight window
+    for k in range(0, len(feed), 3):
+        for t in feed[k:k + 3]:
+            in_ring.publish(t, sig=1)
+        tile.poll_once()
+    assert len(tile._pending) >= 1
+    for _ in range(16):
+        tile.poll_once()
+    tile.flush()
+    assert not tile._pending
+    m = tile.metrics
+    assert m["rx"] == 13 and m["verify_fail"] == 1 and m["tx"] == 12
+    got = []
+    seq = 0
+    while True:
+        rc, frag = out_ring.consume(seq)
+        if rc != 0:
+            break
+        got.append(bytes(out_ring.payload(frag)))
+        seq += 1
+    assert got == txns[:12]
