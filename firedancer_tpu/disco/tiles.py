@@ -106,7 +106,7 @@ class SynthAdapter:
         # pre-pack unique txns into one padded buffer so each burst is
         # a native credit-gated batch publish, not a per-txn Python
         # loop (the benchg hot loop is C for the same reason)
-        stride = max(len(t) for t in txns)
+        stride = max((len(t) for t in txns), default=1)
         self._buf = np.zeros((n_unique, stride), np.uint8)
         self._sizes = np.zeros(n_unique, np.uint32)
         for i, t in enumerate(txns):
@@ -118,7 +118,7 @@ class SynthAdapter:
 
     def poll_once(self) -> int:
         import numpy as np
-        if self.sent >= self.count:
+        if self.sent >= self.count or not self._n_unique:
             return 0
         b = min(self.burst, self.count - self.sent)
         idx = np.arange(self.sent, self.sent + b) % self._n_unique

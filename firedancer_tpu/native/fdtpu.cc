@@ -288,7 +288,10 @@ int64_t fdtpu_ring_publish_batch(void *base, uint64_t ring_off,
     }
     uint64_t seq = fdtpu_ring_prepare(base, ring_off);
     uint64_t chunk = arena_off + (seq & (h->depth - 1)) * mtu;
-    uint32_t sz = sizes[i] <= mtu ? sizes[i] : (uint32_t)mtu;
+    /* clamp to BOTH the slot capacity and the source row width — a
+     * size past the stride would read the next row's payload */
+    uint64_t cap = mtu < stride ? mtu : stride;
+    uint32_t sz = sizes[i] <= cap ? sizes[i] : (uint32_t)cap;
     std::memcpy(at(base, chunk), buf + (uint64_t)i * stride, sz);
     fdtpu_ring_publish(base, ring_off, sigs ? sigs[i] : 0, chunk, sz,
                        /*ctl=*/3, /*orig=*/0);

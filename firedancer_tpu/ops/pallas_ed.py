@@ -75,13 +75,9 @@ def _const_col(arr) -> jnp.ndarray:
     Built from broadcasted_iota + scalar selects rather than a literal
     array: Pallas TPU kernels may not capture non-scalar array constants
     (they would have to be passed as inputs), but scalar splats are fine
-    and Mosaic folds this chain at compile time."""
-    idx = jax.lax.broadcasted_iota(jnp.int32, (NL, 1), 0)
-    out = jnp.zeros((NL, 1), jnp.int32)
-    for i, v in enumerate(np.asarray(arr, np.int64)):
-        if int(v):
-            out = jnp.where(idx == i, jnp.int32(int(v)), out)
-    return out
+    and Mosaic folds this chain at compile time. (General-width form:
+    _const_rows, defined with the scalar machinery below.)"""
+    return _const_rows(arr, NL)
 
 
 _SUB_C = None     # initialized lazily to avoid import-order issues
@@ -141,6 +137,11 @@ def _reduce39(c):
     return _carry(c[:NL] + c[NL:] * FOLD, passes=2)
 
 
+_ROLL = pltpu.roll     # tests swap in jnp.roll to run kernels as pure
+                       # jnp on CPU (bit-identical: the rotated-in top
+                       # rows are always zeros here)
+
+
 def fmul(a, b):
     """Schoolbook product, row-broadcast pad+roll form: 20 shifted
     (2*NL,TB)-wide accumulations, entirely in VMEM — no HBM
@@ -151,7 +152,7 @@ def fmul(a, b):
     for i in range(NL):
         prod = a[i][None, :] * b                       # (NL, TB)
         padded = jnp.concatenate([prod, znl], axis=0)  # (2*NL, TB)
-        acc = acc + pltpu.roll(padded, shift=i, axis=0)
+        acc = acc + _ROLL(padded, shift=i, axis=0)
     return _reduce39(acc[: 2 * NL - 1])
 
 
@@ -169,7 +170,7 @@ def fmul_const(a, const_limbs):
         if not int(v):
             continue
         padded = jnp.concatenate([jnp.int32(int(v)) * a, znl], axis=0)
-        acc = acc + pltpu.roll(padded, shift=i, axis=0)
+        acc = acc + _ROLL(padded, shift=i, axis=0)
     return _reduce39(acc[: 2 * NL - 1])
 
 
@@ -399,16 +400,143 @@ def _fb_entry(ymx_j, ypx_j, t2d_j, w):
 
 
 # ---------------------------------------------------------------------------
+# in-kernel scalar/digit machinery (r5: the byte→digit conversions, the
+# mod-l reduction of the sha512 output, and 4-bit window extraction all
+# moved from the jnp glue into the fused kernel — the glue's
+# bits-matmuls and (64, B) window materializations were ~1/3 of the
+# strict path's wall time at batch 8192; row-op mirrors of
+# ops/ed25519.py::{sc_reduce64, sc_windows4} and fe25519.frombytes,
+# diff-tested against them in tests/test_pallas_ed.py)
+# ---------------------------------------------------------------------------
+
+def _const_rows(arr, width) -> jnp.ndarray:
+    """(width,) numpy constant -> (width, 1) broadcastable column
+    (the general-width form of _const_col, same splat-select
+    construction and rationale)."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, (width, 1), 0)
+    out = jnp.zeros((width, 1), jnp.int32)
+    for i, v in enumerate(np.asarray(arr, np.int64)):
+        if int(v):
+            out = jnp.where(idx == i, jnp.int32(int(v)), out)
+    return out
+
+
+def _bytes_to_digits(b, ndig, mask_top7=False):
+    """(nbytes, TB) int32 LE byte rows -> (ndig, TB) exact base-2^13
+    digits. Row-op mirror of fe25519.frombytes: digit j takes bits
+    [13j, 13j+13), i.e. 2 bytes when 13j%8 <= 3, else 3."""
+    nbytes = b.shape[0]
+    if mask_top7:
+        b = jnp.concatenate([b[:-1], b[-1:] & 0x7F], axis=0)
+    rows = []
+    for j in range(ndig):
+        a, r = divmod(BITS * j, 8)
+        if a >= nbytes:
+            rows.append(jnp.zeros_like(b[0:1]))
+            continue
+        v = b[a:a + 1] >> r
+        if a + 1 < nbytes:
+            v = v | (b[a + 1:a + 2] << (8 - r))
+        if r > 3 and a + 2 < nbytes:
+            v = v | (b[a + 2:a + 3] << (16 - r))
+        rows.append(v & MASK)
+    return jnp.concatenate(rows, axis=0)
+
+
+def _sc_pass(x, width):
+    """Sequential exact digit pass on (n, TB) rows -> (width, TB);
+    mirror of ed._exact_digit_pass (non-negative value, signed rows)."""
+    n = x.shape[0]
+    c = jnp.zeros_like(x[0:1])
+    rows = []
+    for i in range(width):
+        v = (x[i:i + 1] + c) if i < n else c
+        rows.append(v & MASK)
+        c = v >> BITS
+    return jnp.concatenate(rows, axis=0)
+
+
+def _sc_sub_l_if_ge(d):
+    ge = ~_flt_const(d, ed.L_DIGITS)
+    return _sc_pass(d - jnp.where(ge, _const_rows(ed.L_DIGITS, NL), 0),
+                    NL)
+
+
+def _rows_pad(x, width):
+    n = x.shape[0]
+    if n >= width:
+        return x[:width]
+    return jnp.concatenate(
+        [x, jnp.zeros((width - n, x.shape[1]), jnp.int32)], axis=0)
+
+
+def _sc_reduce_rows(d, nd):
+    """(nd, TB) exact digits of a value < 2^(13·nd) -> canonical digits
+    mod l. Row-op mirror of ed._reduce_digits_mod_l (fold 2^260 ≡
+    −256δ, then split at bit 252 and one δ multiply, then two
+    conditional subtracts)."""
+    tb = d.shape[-1]
+    delta = np.asarray(ed.DELTA256_DIGITS, np.int64)
+    while nd > 21:
+        m = nd - 20
+        K = (ed.DELTA256 * (1 << (BITS * m)) + ed.L - 1) // ed.L
+        A = K * ed.L
+        out_bits = (A + (1 << 260)).bit_length() + 1
+        width = -(-out_bits // BITS)
+        lo, hi = d[:20], d[20:nd]
+        conv_len = m + len(delta) - 1
+        conv_rows = []
+        for j in range(conv_len):
+            acc = None
+            for i, dd in enumerate(delta):
+                t = j - i
+                if 0 <= t < m and int(dd):
+                    term = hi[t:t + 1] * jnp.int32(int(dd))
+                    acc = term if acc is None else acc + term
+            conv_rows.append(acc if acc is not None
+                             else jnp.zeros((1, tb), jnp.int32))
+        conv = jnp.concatenate(conv_rows, axis=0)
+        acc = _rows_pad(lo, width) \
+            + _const_rows(ed._int_digits(A, width), width) \
+            - _rows_pad(conv, width)
+        d = _sc_pass(acc, width)
+        nd = width
+    if nd == 20:
+        d = jnp.concatenate([d, jnp.zeros((1, tb), jnp.int32)], axis=0)
+    hi = (d[19:20] >> 5) + (d[20:21] << 8)           # < 2^9
+    lo = jnp.concatenate([d[:19], d[19:20] & 31], axis=0)
+    sub = jnp.concatenate(
+        [hi * jnp.int32(int(ed.DELTA_DIGITS[i])) for i in range(10)]
+        + [jnp.zeros((10, tb), jnp.int32)], axis=0)
+    z = _sc_pass(lo + _const_rows(ed.L_DIGITS, NL) - sub, NL)
+    return _sc_sub_l_if_ge(_sc_sub_l_if_ge(z))
+
+
+def _win4(d, j):
+    """4-bit window j of exact (20, TB) scalar digits; static j."""
+    a, r = divmod(4 * j, BITS)
+    v = d[a:a + 1] >> r
+    if r > BITS - 4 and a + 1 < NL:
+        v = v | (d[a + 1:a + 2] << (BITS - r))
+    return v & 15
+
+
+# ---------------------------------------------------------------------------
 # kernels
 # ---------------------------------------------------------------------------
 
-def _verify_kernel(y_ref, sign_ref, sw_ref, kw_ref, ry_ref, rsign_ref,
-                   fb_ymx_ref, fb_ypx_ref, fb_t2d_ref, ok_ref):
-    """Fused verify core: decompress(A) → R' = [S]B + [k](−A) → encode →
-    compare against R. y_ref/ry_ref: exact 255-bit digits of A.y / R.y;
-    sign/rsign: their sign bits. y-canonicality (y<p), S canonicality and
-    small-order rejection are checked on the jnp side (digit compares,
-    cheap); everything multiplicative lives here in VMEM.
+def _verify_core(pub, rb, k64, s32, fb_ymx_ref, fb_ypx_ref, fb_t2d_ref):
+    """Fused verify core: bytes → digits → sc_reduce64 → decompress(A)
+    → R' = [S]B + [k](−A) → encode → compare against R. Inputs are raw
+    byte rows: pub/rb (32, TB), the 64-byte sha512 output k (64, TB)
+    and S (32, TB). y-canonicality (y<p), S canonicality and
+    small-order rejection are checked on the jnp side (byte compares,
+    cheap); everything else — including the digit conversions, the
+    mod-l reduction of k and per-window scalar extraction — lives here
+    in VMEM (r5: the jnp glue's bits-matmuls were ~1/3 of wall time).
+
+    Pure jnp modulo _ROLL, so tests can run it bit-for-bit on CPU
+    without Mosaic (tests/test_pallas_ed.py::test_verify_core_pure).
 
     Variable-base: per-lane 16-entry precomputed table of w·(−A), 64
     msb-first windows of 4 T-free doublings + 1 full doubling + 1 8-mul
@@ -417,8 +545,12 @@ def _verify_kernel(y_ref, sign_ref, sw_ref, kw_ref, ry_ref, rsign_ref,
     final verdict is digit+sign equality with R (== canonical byte
     equality) ANDed with the decompression mask.
     """
-    y = y_ref[:]
-    sign = sign_ref[:]
+    y = _bytes_to_digits(pub, NL, mask_top7=True)
+    sign = pub[31:32] >> 7
+    ry = _bytes_to_digits(rb, NL, mask_top7=True)
+    rsign = rb[31:32] >> 7
+    kd = _sc_reduce_rows(_bytes_to_digits(k64, 40), 40)
+    sd = _bytes_to_digits(s32, NL)
     tb = y.shape[-1]
     one = pt_identity(tb)[1]
 
@@ -455,6 +587,10 @@ def _verify_kernel(y_ref, sign_ref, sw_ref, kw_ref, ry_ref, rsign_ref,
     id_pre = (one, one, fmul_small2(one), jnp.zeros_like(one))
     vbtab = [id_pre] + [_to_pre(p) for p in full[1:]]
 
+    # 4-bit windows of both scalars, materialized once (row shifts)
+    kw = jnp.concatenate([_win4(kd, j) for j in range(64)], axis=0)
+    sw = jnp.concatenate([_win4(sd, j) for j in range(64)], axis=0)
+
     def window_step(i, carry_pts):
         vacc, facc = carry_pts
         j = 63 - i
@@ -463,10 +599,10 @@ def _verify_kernel(y_ref, sign_ref, sw_ref, kw_ref, ry_ref, rsign_ref,
         vacc = pt_dbl_not(vacc)
         vacc = pt_dbl_not(vacc)
         vacc = pt_dbl_t(vacc)
-        wk = kw_ref[pl.ds(j, 1), :]                  # (1, TB)
+        wk = jax.lax.dynamic_slice_in_dim(kw, j, 1, axis=0)  # (1, TB)
         vacc = pt_add_pre(vacc, _sel16(vbtab, wk))
         # fixed-base: += (w_j·16^j)·B
-        ws = sw_ref[pl.ds(j, 1), :]
+        ws = jax.lax.dynamic_slice_in_dim(sw, j, 1, axis=0)
         ymx_j = fb_ymx_ref[j]                        # (16, NL)
         ypx_j = fb_ypx_ref[j]
         t2d_j = fb_t2d_ref[j]
@@ -481,9 +617,16 @@ def _verify_kernel(y_ref, sign_ref, sw_ref, kw_ref, ry_ref, rsign_ref,
     zinv = finv(rpz)
     xc2 = fcanon(fmul(rpx, zinv))
     yc = fcanon(fmul(rpy, zinv))
-    match = jnp.all(yc == ry_ref[:], axis=0, keepdims=True)
-    match = match & ((xc2[0:1] & 1) == rsign_ref[:])
-    ok_ref[:] = (dec_ok & match).astype(jnp.int32)
+    match = jnp.all(yc == ry, axis=0, keepdims=True)
+    match = match & ((xc2[0:1] & 1) == rsign)
+    return (dec_ok & match).astype(jnp.int32)
+
+
+def _verify_kernel(pub_ref, r_ref, k64_ref, s32_ref,
+                   fb_ymx_ref, fb_ypx_ref, fb_t2d_ref, ok_ref):
+    ok_ref[:] = _verify_core(pub_ref[:], r_ref[:], k64_ref[:],
+                             s32_ref[:], fb_ymx_ref, fb_ypx_ref,
+                             fb_t2d_ref)
 
 
 # ---------------------------------------------------------------------------
@@ -502,31 +645,35 @@ def _win_spec(tb):
     return pl.BlockSpec((64, tb), lambda i: (0, i), memory_space=pltpu.VMEM)
 
 
+def _byte_spec(nrows, tb):
+    return pl.BlockSpec((nrows, tb), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+
+
 def _tab_spec():
     return pl.BlockSpec((64, 16, NL), lambda i: (0, 0, 0),
                         memory_space=pltpu.VMEM)
 
 
 @functools.partial(jax.jit, static_argnames=("tb", "interpret"))
-def verify_tpu(y_a, sign_a, s_w, k_w, r_y, r_sign,
-               tb=DEFAULT_TB, interpret=False):
-    """Fused verify core. y_a/r_y (NL, B) exact digits; sign rows
-    (1, B) int32; s_w/k_w (64, B) int32 windows. Returns ok (1, B)."""
-    b = y_a.shape[-1]
+def verify_tpu(pub_t, r_t, k64_t, s32_t, tb=DEFAULT_TB, interpret=False):
+    """Fused verify core. pub_t/r_t/s32_t (32, B) and k64_t (64, B)
+    int32 LE byte rows (pub/R encodings, sha512(R||A||M) output, S).
+    Returns ok (1, B)."""
+    b = pub_t.shape[-1]
     assert b % tb == 0, (b, tb)
     ymx, ypx, t2d = _fb_tables()
     grid = (b // tb,)
     return pl.pallas_call(
         _verify_kernel,
         grid=grid,
-        in_specs=[_fe_spec(tb), _row_spec(tb),
-                  _win_spec(tb), _win_spec(tb),
-                  _fe_spec(tb), _row_spec(tb),
+        in_specs=[_byte_spec(32, tb), _byte_spec(32, tb),
+                  _byte_spec(64, tb), _byte_spec(32, tb),
                   _tab_spec(), _tab_spec(), _tab_spec()],
         out_specs=[_row_spec(tb)],
         out_shape=[jax.ShapeDtypeStruct((1, b), jnp.int32)],
         interpret=interpret,
-    )(y_a, sign_a, s_w, k_w, r_y, r_sign,
+    )(pub_t, r_t, k64_t, s32_t,
       jnp.asarray(ymx), jnp.asarray(ypx), jnp.asarray(t2d))[0]
 
 
@@ -543,6 +690,23 @@ def _pad_to(x, b_pad, axis=0):
     return jnp.pad(x, widths)
 
 
+def _bytes_lt(b, const_int: int, mask_top7: bool = False):
+    """(B, 32) u8 < const, LE lexicographic byte compare (no digit
+    conversion — the glue's former bits-matmuls were the wall-time
+    sink this replaces)."""
+    c = const_int.to_bytes(32, "little")
+    x = b.astype(jnp.int32)
+    if mask_top7:
+        x = jnp.concatenate([x[:, :31], x[:, 31:32] & 0x7F], axis=-1)
+    lt = jnp.zeros(b.shape[:-1], bool)
+    eq = jnp.ones(b.shape[:-1], bool)
+    for i in range(31, -1, -1):
+        ci = int(c[i])
+        lt = lt | (eq & (x[:, i] < ci))
+        eq = eq & (x[:, i] == ci)
+    return lt
+
+
 def verify_batch(sig, pub, msg, msg_len, tb=DEFAULT_TB, interpret=False):
     """Drop-in equivalent of ops.ed25519.verify_batch on the Pallas path.
 
@@ -555,32 +719,17 @@ def verify_batch(sig, pub, msg, msg_len, tb=DEFAULT_TB, interpret=False):
     r_bytes = sig[:, :32]
     s_bytes = sig[:, 32:]
 
-    s_digits, s_ok = ed.sc_from_bytes32(s_bytes)
-    a_ok = fe.digits_lt(fe.frombytes(pub), fe.P_LIMBS)  # y < p
+    s_ok = _bytes_lt(s_bytes, ed.L)                      # S < l
+    a_ok = _bytes_lt(pub, fe.P, mask_top7=True)          # y < p
     a_ok = a_ok & ~ed.is_small_order_encoding(pub)
     r_ok = ~ed.is_small_order_encoding(r_bytes)
 
     kmsg = jnp.concatenate([r_bytes, pub, msg], axis=-1)
     from .pallas_sha import sha512 as sha512_pl
-    k_digits = ed.sc_reduce64(
-        sha512_pl(kmsg, msg_len + 64, interpret=interpret))
+    k64 = sha512_pl(kmsg, msg_len + 64, interpret=interpret)  # (B, 64)
 
-    s_w = jnp.moveaxis(ed.sc_windows4(s_digits), 0, -1)   # (64, B)
-    k_w = jnp.moveaxis(ed.sc_windows4(k_digits), 0, -1)
-
-    y_a = jnp.moveaxis(fe.frombytes(pub), 0, -1)          # (NL, B)
-    sign_a = (pub[:, 31] >> 7).astype(jnp.int32)[None, :]
-    r_y = jnp.moveaxis(fe.frombytes(r_bytes), 0, -1)      # (NL, B)
-    r_sign = (r_bytes[:, 31] >> 7).astype(jnp.int32)[None, :]
-
-    # pad batch to grid multiple
-    y_a = _pad_to(y_a, b_pad, axis=1)
-    sign_a = _pad_to(sign_a, b_pad, axis=1)
-    s_w = _pad_to(s_w, b_pad, axis=1)
-    k_w = _pad_to(k_w, b_pad, axis=1)
-    r_y = _pad_to(r_y, b_pad, axis=1)
-    r_sign = _pad_to(r_sign, b_pad, axis=1)
-
-    ok = verify_tpu(y_a, sign_a, s_w, k_w, r_y, r_sign,
-                    tb=tb, interpret=interpret)
+    to_rows = lambda a: _pad_to(                          # noqa: E731
+        jnp.moveaxis(a.astype(jnp.int32), 0, -1), b_pad, axis=1)
+    ok = verify_tpu(to_rows(pub), to_rows(r_bytes), to_rows(k64),
+                    to_rows(s_bytes), tb=tb, interpret=interpret)
     return s_ok & a_ok & r_ok & (ok[0, :bsz] == 1)

@@ -98,3 +98,85 @@ def test_pallas_sha512_matches_hashlib():
     for i in range(n):
         want = hashlib.sha512(bytes(msg[i, : ln[i]])).digest()
         assert bytes(out[i]) == want
+
+
+def test_scalar_row_helpers_match_jnp_reference():
+    """The in-kernel byte→digit, mod-l reduction and window extraction
+    (r5: moved from jnp glue into the fused kernel) are pure row
+    functions — diff them directly against ops/ed25519.py."""
+    rng = np.random.default_rng(11)
+    b64 = rng.integers(0, 256, (64, 8), dtype=np.uint8).astype(np.int32)
+    b32 = b64[:32]
+    # byte -> digit conversion vs fe.frombytes (which masks bit 255)
+    d = ped._bytes_to_digits(jnp.asarray(b32), ped.NL, mask_top7=True)
+    want = np.asarray(ed.fe.frombytes(jnp.asarray(
+        b32.T.astype(np.uint8)))).T
+    np.testing.assert_array_equal(np.asarray(d), want)
+    # 64-byte digits + mod-l reduction vs sc_reduce64
+    kd = ped._sc_reduce_rows(
+        ped._bytes_to_digits(jnp.asarray(b64), 40), 40)
+    want_k = np.asarray(ed.sc_reduce64(jnp.asarray(
+        b64.T.astype(np.uint8)))).T
+    np.testing.assert_array_equal(np.asarray(kd), want_k)
+    # window extraction vs sc_windows4
+    sd, _ = ed.sc_from_bytes32(jnp.asarray(b32.T.astype(np.uint8)))
+    got_w = np.concatenate(
+        [np.asarray(ped._win4(ped._bytes_to_digits(
+            jnp.asarray(b32), ped.NL), j)) for j in range(64)], axis=0)
+    want_w = np.asarray(ed.sc_windows4(sd)).T
+    np.testing.assert_array_equal(got_w, want_w)
+
+
+def test_bytes_lt_matches_digit_compare():
+    rng = np.random.default_rng(12)
+    b = rng.integers(0, 256, (64, 32), dtype=np.uint8)
+    # edge values around l and p
+    b[0] = np.frombuffer(ed.L.to_bytes(32, "little"), np.uint8)
+    b[1] = np.frombuffer((ed.L - 1).to_bytes(32, "little"), np.uint8)
+    b[2] = np.frombuffer(ed.fe.P.to_bytes(32, "little"), np.uint8)
+    b[3] = np.frombuffer((ed.fe.P - 1).to_bytes(32, "little"), np.uint8)
+    b[4] = 0xFF
+    got_s = np.asarray(ped._bytes_lt(jnp.asarray(b), ed.L))
+    d, want_s = ed.sc_from_bytes32(jnp.asarray(b))
+    np.testing.assert_array_equal(got_s, np.asarray(want_s))
+    got_p = np.asarray(ped._bytes_lt(jnp.asarray(b), ed.fe.P,
+                                     mask_top7=True))
+    want_p = np.asarray(ed.fe.digits_lt(
+        ed.fe.frombytes(jnp.asarray(b)), ed.fe.P_LIMBS))
+    np.testing.assert_array_equal(got_p, np.asarray(want_p))
+
+
+def test_verify_core_pure_matches_reference():
+    """Run the ENTIRE fused kernel body as pure jnp on CPU (swapping
+    the Mosaic roll for jnp.roll — bit-identical here since rotated-in
+    rows are zeros) against the RFC 8032 oracle + jnp verify_batch:
+    full-function validation without hardware or interpret mode."""
+    rng = np.random.default_rng(13)
+    n, msg_len = 16, 64
+    sig, pub, msg, ln = _mixed_batch(n, msg_len, rng)
+    want = np.asarray(ed.verify_batch(sig, pub, msg, ln))
+
+    import hashlib as _h
+    k64 = np.stack([
+        np.frombuffer(_h.sha512(
+            bytes(np.asarray(sig[i, :32])) + bytes(np.asarray(pub[i]))
+            + bytes(np.asarray(msg[i]))).digest(), np.uint8)
+        for i in range(n)])
+
+    old = ped._ROLL
+    ped._ROLL = lambda x, shift, axis: jnp.roll(x, shift, axis)
+    try:
+        ymx, ypx, t2d = ped._fb_tables()
+        ok = ped._verify_core(
+            jnp.asarray(np.asarray(pub).T.astype(np.int32)),
+            jnp.asarray(np.asarray(sig[:, :32]).T.astype(np.int32)),
+            jnp.asarray(k64.T.astype(np.int32)),
+            jnp.asarray(np.asarray(sig[:, 32:]).T.astype(np.int32)),
+            jnp.asarray(ymx), jnp.asarray(ypx), jnp.asarray(t2d))
+    finally:
+        ped._ROLL = old
+    got = np.asarray(ok)[0] == 1
+    # the kernel core omits the glue-side S/A/R canonicity masks; the
+    # mixed batch has canonical S and non-small-order points, so the
+    # core verdict must equal the full reference verdict here
+    np.testing.assert_array_equal(got, want)
