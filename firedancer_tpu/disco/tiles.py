@@ -1191,8 +1191,17 @@ class TowerAdapter:
             while self.out_fseqs and \
                     self.out.credits(self.out_fseqs) <= 0:
                 time.sleep(20e-6)
-            self.out.publish(struct.pack("<Q", slot) + block_id,
-                             sig=slot)
+            # vote frame carries the FULL tower (lockouts + root) so
+            # the send tile can build a real TowerSync instruction
+            tw = self.core.tower
+            frame = struct.pack("<Q", slot) + block_id
+            frame += (bytes([1]) + struct.pack("<Q", tw.root)
+                      if tw.root is not None else bytes([0]))
+            votes = list(tw.votes)[-31:]   # tower depth cap == 31
+            frame += struct.pack("<H", len(votes))
+            for v in votes:
+                frame += struct.pack("<QI", v.slot, v.conf)
+            self.out.publish(frame, sig=slot)
 
     def in_seqs(self):
         return dict(self.seqs)
@@ -1386,7 +1395,23 @@ class SendAdapter:
         for i in range(n):
             frame = bytes(buf[i, :sizes[i]])
             (slot,) = struct.unpack_from("<Q", frame, 0)
-            self.core.send_vote(slot, frame[8:40])
+            block_id = frame[8:40]
+            lockouts, root = [], None
+            if len(frame) > 40:                # tower payload present
+                off = 40
+                if frame[off]:
+                    (root,) = struct.unpack_from("<Q", frame, off + 1)
+                    off += 9
+                else:
+                    off += 1
+                (cnt,) = struct.unpack_from("<H", frame, off)
+                off += 2
+                for _ in range(cnt):
+                    s, c = struct.unpack_from("<QI", frame, off)
+                    lockouts.append((s, c))
+                    off += 12
+            self.core.send_vote(slot, block_id, lockouts=lockouts,
+                                root=root)
         return n
 
     def in_seqs(self):

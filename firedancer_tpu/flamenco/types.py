@@ -271,13 +271,10 @@ VOTE_IX_VOTE_DISC = 2           # VoteInstruction enum variant index
 
 def encode_vote_instruction(slots: list[int], block_hash: bytes,
                             timestamp: int | None = None) -> bytes:
-    """VoteInstruction::Vote(Vote { slots, hash, timestamp })."""
-    w = Writer()
-    w.u32(VOTE_IX_VOTE_DISC)
-    w.vec(slots, w.u64)
-    w.pubkey(block_hash)                     # Hash = 32 bytes
-    w.option(timestamp, w.i64)
-    return w.bytes()
+    """VoteInstruction::Vote(Vote { slots, hash, timestamp }) — single
+    implementation lives with the program (svm/vote.ix_vote)."""
+    from ..svm.vote import ix_vote
+    return ix_vote(slots, block_hash, timestamp)
 
 
 def decode_vote_instruction(data: bytes) -> dict:

@@ -11,60 +11,58 @@ pull-request bloom filters.
 """
 from __future__ import annotations
 
-import hashlib
-import struct
 from dataclasses import dataclass
 
-# value kinds (the reference's CRDS discriminants; subset)
-KIND_CONTACT_INFO = 0
-KIND_VOTE = 1
-KIND_LOWEST_SLOT = 2
-KIND_SNAPSHOT_HASHES = 3
-KIND_EPOCH_SLOTS = 4
-KIND_DUPLICATE_SHRED = 5
+from ..flamenco import gossip_wire as gw
+
+# value kinds — the REAL CRDS discriminants (r5 interop:
+# flamenco/gossip_wire.py; ref src/flamenco/gossip/fd_gossip_private.h:37-51)
+KIND_LEGACY_CONTACT_INFO = gw.V_LEGACY_CONTACT_INFO   # 0
+KIND_VOTE = gw.V_VOTE                                 # 1
+KIND_LOWEST_SLOT = gw.V_LOWEST_SLOT                   # 2
+KIND_SNAPSHOT_HASHES = gw.V_LEGACY_SNAPSHOT_HASHES    # 3
+KIND_EPOCH_SLOTS = gw.V_EPOCH_SLOTS                   # 5
+KIND_NODE_INSTANCE = gw.V_NODE_INSTANCE               # 8
+KIND_DUPLICATE_SHRED = gw.V_DUPLICATE_SHRED           # 9
+KIND_CONTACT_INFO = gw.V_CONTACT_INFO                 # 11
 
 
 @dataclass(frozen=True)
 class CrdsValue:
+    """In-memory CRDS value over the REAL wire encoding: `data` is the
+    bincode variant payload (the bytes after the u32 discriminant) and
+    every derived form (signable region, identity hash, wire bytes)
+    matches Agave's CrdsValue semantics byte-for-byte."""
     origin: bytes          # 32B pubkey of the producing node
-    kind: int
-    index: int             # distinguishes multiple values of one kind
+    kind: int              # CRDS discriminant (u32 on the wire)
+    index: int             # vote index (0 for single-instance kinds)
     wallclock: int         # producer's clock, ms — LWW resolution key
-    data: bytes            # kind-specific payload
+    data: bytes            # bincode variant payload
     signature: bytes = b""
 
     def key(self) -> tuple:
         return (self.origin, self.kind, self.index)
 
     def signable(self) -> bytes:
-        return (self.origin + bytes([self.kind])
-                + struct.pack("<IQ", self.index, self.wallclock)
-                + self.data)
-
-    def hash(self) -> bytes:
-        return hashlib.sha256(self.signable() + self.signature).digest()
+        """The signed region: serialize(CrdsData) = u32 tag + payload
+        (ref fd_gossvf_tile.c verify_crds_value)."""
+        return gw.signable(self.kind, self.data)
 
     def to_wire(self) -> bytes:
-        return (self.origin + bytes([self.kind])
-                + struct.pack("<IQHH", self.index, self.wallclock,
-                              len(self.data), len(self.signature))
-                + self.data + self.signature)
+        return gw.encode_value(self.kind, self.data,
+                               self.signature or bytes(64))
+
+    def hash(self) -> bytes:
+        """Identity hash over the full serialized value — the key pull
+        blooms filter on (Agave CrdsValue hash semantics)."""
+        return gw.value_hash(self.to_wire())
 
     @classmethod
     def from_wire(cls, b: bytes, off: int = 0) -> tuple["CrdsValue", int]:
-        origin = b[off:off + 32]
-        if len(origin) != 32:
-            raise ValueError("truncated CRDS value")
-        kind = b[off + 32]
-        index, wallclock, dlen, slen = struct.unpack_from(
-            "<IQHH", b, off + 33)
-        p = off + 33 + 16
-        data = b[p:p + dlen]
-        sig = b[p + dlen:p + dlen + slen]
-        if len(data) != dlen or len(sig) != slen:
-            raise ValueError("truncated CRDS value body")
-        return cls(bytes(origin), kind, index, wallclock, bytes(data),
-                   bytes(sig)), p + dlen + slen
+        v, end = gw.decode_value(b, off)
+        index = v["payload"][0] if v["tag"] == gw.V_VOTE else 0
+        return cls(v["origin"], v["tag"], index, v["wallclock_ms"],
+                   v["payload"], v["signature"]), end
 
 
 class CrdsStore:

@@ -11,6 +11,7 @@ optional for protocol-logic tests, mandatory on the wire.
 """
 from __future__ import annotations
 
+from ..flamenco import gossip_wire as gw
 from .active_set import ActiveSet, PruneFinder
 from .bloom import Bloom
 from .crds import KIND_CONTACT_INFO, CrdsStore, CrdsValue
@@ -41,10 +42,16 @@ class GossipNode:
         self.crds.upsert(v)
         return v
 
-    def publish_contact_info(self, addr: tuple) -> CrdsValue:
+    def publish_contact_info(self, addr: tuple,
+                             shred_version: int = 0) -> CrdsValue:
+        """Real ContactInfo(11) payload with our gossip socket
+        (flamenco/gossip_wire.ContactInfo)."""
         host, port = addr
-        data = host.encode() + b":" + str(port).encode()
-        return self.make_value(KIND_CONTACT_INFO, 0, data)
+        ci = gw.ContactInfo(
+            pubkey=self.pubkey, wallclock_ms=self.now_ms,
+            shred_version=shred_version,
+            sockets={gw.SOCKET_GOSSIP: (host, int(port))})
+        return self.make_value(KIND_CONTACT_INFO, 0, ci.encode())
 
     # -- push ---------------------------------------------------------------
 
@@ -88,15 +95,16 @@ class GossipNode:
 
     # -- pull (anti-entropy) ------------------------------------------------
 
-    def make_pull_request(self, seed: int = 0) -> bytes:
-        """Wire bloom of everything we hold."""
+    def make_pull_request(self, seed: int = 0) -> Bloom:
+        """Bloom of everything we hold; the tile wraps it in the real
+        CrdsFilter wire (gossip_wire.encode_pull_request)."""
         self.metrics["pull_rq"] += 1
-        return self.crds.bloom_of_contents(seed=seed).to_wire()
+        return self.crds.bloom_of_contents(seed=seed)
 
-    def handle_pull_request(self, bloom_wire: bytes,
+    def handle_pull_request(self, bloom: Bloom,
                             limit: int = 64) -> list[CrdsValue]:
         self.metrics["pull_rs"] += 1
-        return self.crds.missing_for(Bloom.from_wire(bloom_wire), limit)
+        return self.crds.missing_for(bloom, limit)
 
     def handle_pull_response(self, values: list[CrdsValue],
                              pre_verified: bool = False) -> int:

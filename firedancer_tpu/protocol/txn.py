@@ -77,6 +77,7 @@ class ParsedTxn:
     alut_cnt: int = 0
     # v0: [(table_key, writable_idxs bytes, readonly_idxs bytes)]
     aluts: tuple = ()
+    size: int = 0             # consumed wire bytes (== len for strict)
 
     def signatures(self, payload: bytes) -> list[bytes]:
         return [payload[self.sig_off + 64 * i: self.sig_off + 64 * (i + 1)]
@@ -87,7 +88,8 @@ class ParsedTxn:
                 for i in range(self.sig_cnt)]
 
     def message(self, payload: bytes) -> bytes:
-        return payload[self.msg_off:]
+        end = self.size if self.size else len(payload)
+        return payload[self.msg_off:end]
 
     def account_keys(self, payload: bytes) -> list[bytes]:
         return [payload[self.acct_off + 32 * i: self.acct_off + 32 * (i + 1)]
@@ -104,8 +106,12 @@ class ParsedTxn:
         return unsigned_idx < n_unsigned - self.n_ro_unsigned
 
 
-def parse_txn(payload: bytes) -> ParsedTxn:
-    if len(payload) > MTU:
+def parse_txn(payload: bytes, allow_trailing: bool = False) -> ParsedTxn:
+    """allow_trailing=True parses a txn at a PREFIX of payload and
+    reports the consumed size (the fd_txn_parse_core return-size
+    contract the gossip vote parser relies on,
+    ref src/flamenco/gossip/fd_gossip_msg_parse.c:114)."""
+    if len(payload) > MTU and not allow_trailing:
         raise TxnParseError(f"payload {len(payload)} > MTU {MTU}")
     sig_cnt, off = _cu16(payload, 0)
     if not 1 <= sig_cnt <= SIG_MAX:
@@ -200,12 +206,13 @@ def parse_txn(payload: bytes) -> ParsedTxn:
                     ix >= acct_cnt + n_loaded for ix in ins.acct_idxs):
                 raise TxnParseError("instr account index out of range")
 
-    if off != len(payload):
+    if off != len(payload) and not allow_trailing:
         raise TxnParseError(f"trailing bytes: {len(payload) - off}")
 
     return ParsedTxn(sig_cnt, sig_off, msg_off, version, n_signed,
                      n_ro_signed, n_ro_unsigned, acct_cnt, acct_off,
-                     blockhash_off, instrs, alut_cnt, tuple(aluts))
+                     blockhash_off, instrs, alut_cnt, tuple(aluts),
+                     size=off)
 
 
 def parse_message_shape(data: bytes) -> bool:

@@ -28,11 +28,16 @@ from ..choreo.tower import Tower, TowerVote
 VOTE_PROGRAM_ID = b"Vote" + bytes(28)
 NO_ROOT = (1 << 64) - 1
 
-VOTE_IX_INITIALIZE = 0
-VOTE_IX_VOTE = 1
-VOTE_IX_WITHDRAW = 2
-VOTE_IX_AUTHORIZE = 3          # u32 disc | new_authority 32 | u32 kind
-VOTE_IX_UPDATE_COMMISSION = 4  # u32 disc | u8 commission
+# Agave VoteInstruction enum discriminants (r5 wire parity; ref
+# src/flamenco/runtime/program/fd_vote_program.c instruction decode —
+# the subset this program implements)
+VOTE_IX_INITIALIZE = 0         # VoteInit {node, voter, withdrawer, u8}
+VOTE_IX_AUTHORIZE = 1          # Pubkey + u32 VoteAuthorize kind
+VOTE_IX_VOTE = 2               # Vote {slots: Vec<u64>, hash, Opt<i64>}
+VOTE_IX_WITHDRAW = 3           # u64 lamports
+VOTE_IX_UPDATE_COMMISSION = 5  # u8 commission
+VOTE_IX_TOWER_SYNC = 14        # TowerSync {lockouts, root, hash, ts,
+                               #            block_id}
 AUTH_KIND_VOTER = 0
 AUTH_KIND_WITHDRAWER = 1
 
@@ -144,12 +149,31 @@ def ix_initialize(node_pubkey: bytes, authorized_voter: bytes,
             + bytes([commission]))
 
 
+def _opt_i64(v: int | None) -> bytes:
+    return b"\x00" if v is None else b"\x01" + struct.pack("<q", v)
+
+
 def ix_vote(slots: list[int], block_hash: bytes = bytes(32),
-            timestamp: int = 0) -> bytes:
-    out = struct.pack("<IH", VOTE_IX_VOTE, len(slots))
+            timestamp: int | None = None) -> bytes:
+    """VoteInstruction::Vote — bincode: u32 disc 2, Vec<u64> slots
+    (u64 length), 32-byte hash, Option<i64> timestamp."""
+    out = struct.pack("<IQ", VOTE_IX_VOTE, len(slots))
     for s in slots:
         out += struct.pack("<Q", s)
-    return out + block_hash + struct.pack("<Q", timestamp)
+    return out + block_hash + _opt_i64(timestamp)
+
+
+def ix_tower_sync(lockouts: list[tuple[int, int]], root: int | None,
+                  block_hash: bytes, block_id: bytes,
+                  timestamp: int | None = None) -> bytes:
+    """VoteInstruction::TowerSync — bincode: u32 disc 14, Vec<Lockout>
+    {u64 slot, u32 confirmation_count}, Option<u64> root, hash,
+    Option<i64> timestamp, block_id."""
+    out = struct.pack("<IQ", VOTE_IX_TOWER_SYNC, len(lockouts))
+    for slot, conf in lockouts:
+        out += struct.pack("<QI", slot, conf)
+    out += b"\x00" if root is None else b"\x01" + struct.pack("<Q", root)
+    return out + block_hash + _opt_i64(timestamp) + block_id
 
 
 def ix_withdraw(lamports: int) -> bytes:
@@ -195,23 +219,44 @@ def exec_vote(ic) -> str:
         return ERR_INVALID_OWNER
     st = VoteState.from_bytes(acct.data)
 
-    if disc == VOTE_IX_VOTE:
-        if len(data) < 6:
+    if disc in (VOTE_IX_VOTE, VOTE_IX_TOWER_SYNC):
+        # bincode layouts (Agave VoteInstruction::Vote / ::TowerSync)
+        try:
+            off = 4
+            (cnt,) = struct.unpack_from("<Q", data, off)
+            off += 8
+            if cnt == 0 or cnt > 64:
+                return ERR_BAD_IX_DATA
+            slots = []
+            for _ in range(cnt):
+                (s,) = struct.unpack_from("<Q", data, off)
+                slots.append(s)
+                off += 8 if disc == VOTE_IX_VOTE else 12  # + u32 conf
+            if disc == VOTE_IX_TOWER_SYNC:
+                if data[off]:                 # Option<u64> root
+                    off += 9
+                else:
+                    off += 1
+            off += 32                         # bank hash
+            ts = None
+            if data[off]:                     # Option<i64> timestamp
+                (ts,) = struct.unpack_from("<q", data, off + 1)
+                off += 9
+            else:
+                off += 1
+            if disc == VOTE_IX_TOWER_SYNC:
+                off += 32                     # block_id
+            if off > len(data):
+                return ERR_BAD_IX_DATA
+        except (struct.error, IndexError):
             return ERR_BAD_IX_DATA
-        (cnt,) = struct.unpack_from("<H", data, 4)
-        need = 6 + 8 * cnt + 32 + 8
-        if len(data) < need or cnt == 0:
-            return ERR_BAD_IX_DATA
-        slots = [struct.unpack_from("<Q", data, 6 + 8 * i)[0]
-                 for i in range(cnt)]
-        ts = struct.unpack_from("<Q", data, 6 + 8 * cnt + 32)[0]
         # the AUTHORIZED VOTER must sign (ref: vote program authority
         # checks), not merely the vote account
         if st.authorized_voter not in ic.signer_keys():
             return ERR_MISSING_SIG
         if not ic.is_writable(0):
             return ERR_NOT_WRITABLE
-        st.apply_vote(slots, ts, epoch=ic.ctx.epoch)
+        st.apply_vote(sorted(slots), ts or 0, epoch=ic.ctx.epoch)
         acct.data = st.to_bytes()
         return OK
 

@@ -6,33 +6,42 @@ way the reference's gossip tile drives fd_gossip over the net tile
 pieces: entrypoint bootstrap via ContactInfo, push to the active set,
 bloom pulls for anti-entropy, prunes on duplicate routes).
 
-Wire format (one datagram per message):
-  u8 type | sender pubkey 32 | body
-  type 0 PUSH:      u16 n | n × CrdsValue wire
-  type 1 PULL_REQ:  bloom wire
-  type 2 PULL_RESP: u16 n | n × CrdsValue wire
-  type 3 PRUNE:     u16 n | n × origin pubkey 32
-
-CRDS values are ed25519-signed over CrdsValue.signable() and verified
-on receipt (the gossvf stage of the reference; host-rate signing via
-the oracle signer — gossip is not the hot path)."""
+Wire format: the REAL Solana gossip protocol
+(flamenco/gossip_wire.py; ref src/flamenco/gossip/fd_gossip_msg_parse.c)
+— u32 LE message enum, bincode CrdsValues (signature + u32 tag +
+payload), CrdsFilter pull requests, PruneData with the
+\xffSOLANA_PRUNE_DATA signable, ping/pong liveness. CRDS values are
+ed25519-signed over serialize(CrdsData) and verified on receipt (the
+gossvf stage of the reference; host-rate signing via the oracle signer
+— gossip is not the hot path)."""
 from __future__ import annotations
 
 import socket
 import struct
 
+from ..flamenco import gossip_wire as gw
 from ..gossip import CrdsValue, GossipNode
+from ..gossip.bloom import Bloom
 from ..gossip.crds import KIND_CONTACT_INFO
 from ..utils.ed25519_ref import keypair, sign, verify
 
-MSG_PUSH, MSG_PULL_REQ, MSG_PULL_RESP, MSG_PRUNE = 0, 1, 2, 3
-MTU = 1232
+MTU = gw.MTU
 
 
-def _pack_values(msg_type: int, sender: bytes, values) -> bytes:
-    out = bytes([msg_type]) + sender + struct.pack("<H", len(values))
+def _pack_containers(msg_type: int, sender: bytes, values) -> list[bytes]:
+    """CRDS values -> one or more real push/pull-response datagrams,
+    chunked to the gossip MTU and the 18-value cap."""
+    out, cur, cur_sz = [], [], 44
     for v in values:
-        out += v.to_wire()
+        w = v.to_wire()
+        if cur and (cur_sz + len(w) > MTU
+                    or len(cur) >= gw.MAX_CRDS_PER_MSG):
+            out.append(gw.encode_container(msg_type, sender, cur))
+            cur, cur_sz = [], 44
+        cur.append(w)
+        cur_sz += len(w)
+    if cur:
+        out.append(gw.encode_container(msg_type, sender, cur))
     return out
 
 
@@ -71,9 +80,9 @@ class GossipTile:
         if ci is None:
             return None
         try:
-            host, port = ci.data.decode().rsplit(":", 1)
-            return (host, int(port))
-        except ValueError:
+            info, _ = gw.ContactInfo.decode(ci.data, 0)
+            return info.gossip_addr()
+        except (gw.WireError, ValueError, struct.error):
             return None
 
     def _send(self, addr, payload: bytes):
@@ -103,16 +112,15 @@ class GossipTile:
         return n
 
     def _handle(self, data: bytes, addr):
-        mtype = data[0]
-        sender = data[1:33]
-        body = data[33:]
-        if mtype in (MSG_PUSH, MSG_PULL_RESP):
-            (cnt,) = struct.unpack_from("<H", body, 0)
-            off = 2
-            values = []
-            for _ in range(cnt):
-                v, off = CrdsValue.from_wire(body, off)
-                values.append(v)
+        view = gw.parse_message(data)
+        kind = view["kind"]
+        if kind in ("push", "pull_response"):
+            values = [CrdsValue(v["origin"], v["tag"],
+                                v["payload"][0] if v["tag"] == gw.V_VOTE
+                                else 0,
+                                v["wallclock_ms"], v["payload"],
+                                v["signature"])
+                      for v in view["values"]]
             pre = False
             if self.device_verify and values:
                 # gossvf: ONE device batch checks the whole packet's
@@ -123,23 +131,52 @@ class GossipTile:
                     sum(1 for ok in verdicts if not ok)
                 values = [v for v, ok in zip(values, verdicts) if ok]
                 pre = True
-            if mtype == MSG_PUSH:
-                fresh = self.node.handle_push(values, relayer=sender,
+            if kind == "push":
+                fresh = self.node.handle_push(values,
+                                              relayer=view["from"],
                                               pre_verified=pre)
                 self._push_queue.extend(fresh)     # relay onward
             else:
                 self.node.handle_pull_response(values,
                                                pre_verified=pre)
-        elif mtype == MSG_PULL_REQ:
-            resp = self.node.handle_pull_request(body, limit=16)
-            if resp:
-                self._send(addr, _pack_values(MSG_PULL_RESP, self.pubkey,
-                                              resp))
-        elif mtype == MSG_PRUNE:
-            (cnt,) = struct.unpack_from("<H", body, 0)
-            origins = [body[2 + 32 * i:2 + 32 * (i + 1)]
-                       for i in range(cnt)]
-            self.node.handle_prune(sender, origins)
+        elif kind == "pull_request":
+            bloom = Bloom.from_filter(view["bloom_keys"],
+                                      view["bloom_bits"],
+                                      view["bloom_bits_cnt"])
+            # the requester's contact info rides in the message
+            civ = view["ci"]
+            self.node.handle_push(
+                [CrdsValue(civ["origin"], civ["tag"], 0,
+                           civ["wallclock_ms"], civ["payload"],
+                           civ["signature"])], relayer=civ["origin"])
+            resp = self.node.handle_pull_request(bloom, limit=16)
+            for payload in _pack_containers(gw.MSG_PULL_RESPONSE,
+                                            self.pubkey, resp):
+                self._send(addr, payload)
+        elif kind == "prune":
+            # either signable form is acceptable (verify_prune)
+            ok = verify(view["signature"], view["from"],
+                        gw.prune_signable(view["from"], view["origins"],
+                                          view["destination"],
+                                          view["wallclock_ms"],
+                                          prefixed=True)) or \
+                verify(view["signature"], view["from"],
+                       gw.prune_signable(view["from"], view["origins"],
+                                         view["destination"],
+                                         view["wallclock_ms"],
+                                         prefixed=False))
+            if ok and view["destination"] == self.pubkey:
+                self.node.handle_prune(view["from"], view["origins"])
+            else:
+                self.metrics["bad_msg"] += 1
+        elif kind == "ping":
+            import hashlib as _h
+            pre = gw.pong_preimage(view["token"])
+            sig = sign(self.seed, _h.sha256(pre).digest())
+            self._send(addr, gw.encode_pong(self.pubkey, view["token"],
+                                            sig))
+        elif kind == "pong":
+            pass                       # liveness bookkeeping only
         else:
             self.metrics["bad_msg"] += 1
 
@@ -154,8 +191,8 @@ class GossipTile:
                        else self.node.now_ms + 100)
         # refresh own contact info periodically (wallclock advances)
         if self._tick % 50 == 1:
-            self.publish(KIND_CONTACT_INFO, 0,
-                         f"{self.addr[0]}:{self.addr[1]}".encode())
+            self._push_queue.append(
+                self.node.publish_contact_info(self.addr))
         # push queued fresh values to the active set (or entrypoints
         # while we know no peers — the bootstrap hop)
         if self._push_queue:
@@ -167,10 +204,12 @@ class GossipTile:
                     targets.add(self._addr_of(pk))
             if not targets:
                 targets = set(self.entrypoints)
-            payload = _pack_values(MSG_PUSH, self.pubkey, batch)
+            payloads = _pack_containers(gw.MSG_PUSH, self.pubkey,
+                                        batch)
             for addr in targets:
                 if addr and addr != self.addr:
-                    self._send(addr, payload)
+                    for payload in payloads:
+                        self._send(addr, payload)
         # anti-entropy pull every few ticks
         if self._tick % 5 == 0:
             peers = [self._addr_of(c.origin)
@@ -179,16 +218,22 @@ class GossipTile:
             peers = [p for p in peers if p] or list(self.entrypoints)
             if peers:
                 addr = peers[self._tick // 5 % len(peers)]
-                self._send(addr, bytes([MSG_PULL_REQ]) + self.pubkey
-                           + self.node.make_pull_request(
-                               seed=self._tick))
-        # prunes for noisy relayers
+                bloom = self.node.make_pull_request(seed=self._tick)
+                keys, bits, nset = bloom.filter_fields()
+                ci = self.node.crds.get(self.pubkey, KIND_CONTACT_INFO)
+                self._send(addr, gw.encode_pull_request(
+                    keys, bits, nset, (1 << 64) - 1, 0,
+                    ci.to_wire(), bits_cnt=bloom.num_bits))
+        # prunes for noisy relayers (PruneData signed with the
+        # \xffSOLANA_PRUNE_DATA prefix form)
         for relayer, origins in self.node.prunes_due().items():
             addr = self._addr_of(relayer)
             if addr:
-                self._send(addr, bytes([MSG_PRUNE]) + self.pubkey
-                           + struct.pack("<H", len(origins))
-                           + b"".join(origins))
+                wc = self.node.now_ms
+                sig = sign(self.seed, gw.prune_signable(
+                    self.pubkey, origins, relayer, wc, prefixed=True))
+                self._send(addr, gw.encode_prune(
+                    self.pubkey, origins, sig, relayer, wc))
 
     def close(self):
         self.sock.close()

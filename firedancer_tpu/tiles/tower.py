@@ -180,14 +180,22 @@ class SendCore:
         self.sock = sock
         self.metrics = {"votes": 0, "sent": 0, "sign_fail": 0}
 
-    def send_vote(self, slot: int, block_id: bytes) -> bool:
+    def send_vote(self, slot: int, block_id: bytes,
+                  lockouts: list[tuple[int, int]] | None = None,
+                  root: int | None = None) -> bool:
+        """Emit a REAL VoteInstruction::TowerSync transaction (r5 wire
+        parity — Agave's current vote form; the tower tile ships its
+        full lockout state in the vote frame)."""
         from ..protocol.txn import build_message, build_txn
-        from ..svm.vote import VOTE_PROGRAM_ID, ix_vote
+        from ..svm.vote import VOTE_PROGRAM_ID, ix_tower_sync
         self.metrics["votes"] += 1
+        if not lockouts:
+            lockouts = [(slot, 1)]
         msg = build_message(
             [self.identity], [self.vote_account, VOTE_PROGRAM_ID],
             block_id,                      # recent blockhash = voted block
-            [(2, bytes([1]), ix_vote([slot], block_id))],
+            [(2, bytes([1]),
+              ix_tower_sync(lockouts, root, block_id, block_id))],
             # the program account is READ-ONLY (reference wire form);
             # writable program ids would serialize all votes through
             # pack's conflict bitsets

@@ -27,9 +27,12 @@ def test_bloom_membership_and_wire():
     others = [rng.bytes(32) for _ in range(500)]
     fp = sum(f.contains(k) for k in others)
     assert fp < 25, f"false positive rate way off: {fp}/500"
-    g = Bloom.from_wire(f.to_wire())
+    # CrdsFilter wire fields round-trip (the real pull-request form)
+    fkeys, bits, nset = f.filter_fields()
+    g = Bloom.from_filter(fkeys, bits, f.num_bits)
     assert all(g.contains(k) for k in keys)
-    assert g.num_keys == f.num_keys and g.seed == f.seed
+    assert g.keys == f.keys and g.num_bits == f.num_bits
+    assert nset == f.num_bits_set > 0
 
 
 # ---------------------------------------------------------------------------
@@ -54,10 +57,15 @@ def test_crds_lww_upsert():
 
 
 def test_crds_wire_roundtrip():
-    v = CrdsValue(pk(3), KIND_CONTACT_INFO, 0, 777, b"10.0.0.3:8000",
+    from firedancer_tpu.flamenco import gossip_wire as gw
+    ci = gw.ContactInfo(pubkey=pk(3), wallclock_ms=777,
+                        sockets={gw.SOCKET_GOSSIP: ("10.0.0.3", 8000)})
+    v = CrdsValue(pk(3), KIND_CONTACT_INFO, 0, 777, ci.encode(),
                   b"s" * 64)
     w, end = CrdsValue.from_wire(v.to_wire())
     assert w == v and end == len(v.to_wire())
+    # the signable region is serialize(CrdsData): u32 tag + payload
+    assert v.signable() == (11).to_bytes(4, "little") + ci.encode()
 
 
 def test_crds_pull_missing():

@@ -9,9 +9,12 @@ import os
 import time
 
 from firedancer_tpu.disco import Topology, TopologyRunner
+from firedancer_tpu.flamenco import gossip_wire as gw
 from firedancer_tpu.gossip.crds import KIND_VOTE
+from firedancer_tpu.utils.ed25519_ref import keypair
 
 SEEDS = [bytes([i]) * 32 for i in (1, 2, 3)]
+VOTE_TXN_PATH = "/root/reference/src/flamenco/gossip/test_vote_txn.bin"
 
 
 def _free_ports(n):
@@ -33,12 +36,30 @@ def test_three_nodes_converge_over_udp():
     p0, p1, p2 = _free_ports(3)
     ep = [f"127.0.0.1:{p0}"]
     topo = Topology(f"gsp{os.getpid()}", wksp_size=1 << 22)
+    if os.path.exists(VOTE_TXN_PATH):
+        vote_txn = open(VOTE_TXN_PATH, "rb").read()
+    else:
+        # fixture absent: synthesize a real signed TowerSync vote txn
+        from firedancer_tpu.protocol.txn import build_message, build_txn
+        from firedancer_tpu.svm.vote import VOTE_PROGRAM_ID, ix_tower_sync
+        from firedancer_tpu.utils.ed25519_ref import sign as _sign
+        _, _, vp = keypair(SEEDS[0])
+        msg = build_message([vp], [vp, VOTE_PROGRAM_ID], bytes(32),
+                            [(2, bytes([1]),
+                              ix_tower_sync([(5, 1)], None, bytes(32),
+                                            bytes(32)))],
+                            n_ro_unsigned=1)
+        vote_txn = build_txn([_sign(SEEDS[0], msg)], msg)
     for i, (seed, port, eps) in enumerate(
             [(SEEDS[0], p0, []), (SEEDS[1], p1, ep), (SEEDS[2], p2, ep)]):
+        _, _, pub = keypair(seed)
+        # a REAL CrdsData::Vote payload (index, origin, vote txn,
+        # wallclock) — the receivers parse it with the wire codec
+        payload = gw.encode_vote(0, pub, vote_txn, 1000 + i)
         topo.tile(f"g{i}", "gossip", seed=seed.hex(), port=port,
                   entrypoints=eps,
                   publish=[{"kind": KIND_VOTE, "index": 0,
-                            "data_hex": bytes([0x40 + i]).hex()}])
+                            "data_hex": payload.hex()}])
     runner = TopologyRunner(topo.build()).start()
     try:
         runner.wait_running(timeout_s=120)
@@ -70,7 +91,7 @@ def test_gossvf_batch_verify_drops_forgeries():
     for i in range(6):
         seed = bytes([i + 1]) * 32
         _, _, pub = keypair(seed)
-        v = CrdsValue(pub, 1, 0, 1000 + i, b"data-%d" % i)
+        v = CrdsValue(pub, 1, 0, 1000 + i, b"data-%d" % i)  # store-only payload
         sig = bytes(64) if i % 3 == 2 else sign(seed, v.signable())
         vals.append(dataclasses.replace(v, signature=sig))
     got = batch_verify(vals)

@@ -1,0 +1,171 @@
+"""Solana gossip wire codec vs the reference's parser contract
+(src/flamenco/gossip/fd_gossip_msg_parse.c), using the reference
+tree's REAL vote transaction fixture (test_vote_txn.bin, read as
+binary TEST DATA — the same fixture test_gossip_ser.c uses)."""
+import hashlib
+import os
+import struct
+
+import pytest
+
+from firedancer_tpu.flamenco import gossip_wire as gw
+from firedancer_tpu.utils.ed25519_ref import keypair, sign, verify
+
+VOTE_TXN_PATH = "/root/reference/src/flamenco/gossip/test_vote_txn.bin"
+SEED = bytes(range(32))
+
+
+def _vote_txn() -> bytes:
+    if not os.path.exists(VOTE_TXN_PATH):
+        pytest.skip("reference fixture unavailable")
+    return open(VOTE_TXN_PATH, "rb").read()
+
+
+def test_real_vote_txn_parses_and_crds_vote_roundtrips():
+    txn = _vote_txn()
+    _, _, pub = keypair(SEED)
+    now_ms = 1234
+    payload = gw.encode_vote(0, pub, txn, now_ms)
+    # pinned layout: index u8 + pubkey 32 + txn + wallclock u64
+    assert len(payload) == 1 + 32 + len(txn) + 8
+    sig = sign(SEED, gw.signable(gw.V_VOTE, payload))
+    wire = gw.encode_value(gw.V_VOTE, payload, sig)
+    assert len(wire) == 64 + 4 + len(payload)   # sig + tag + data
+    v, end = gw.decode_value(wire, 0)
+    assert end == len(wire)
+    assert v["tag"] == gw.V_VOTE and v["origin"] == pub
+    assert v["wallclock_ms"] == now_ms
+    decoded, _ = gw.decode_vote(v["payload"], 0)
+    assert decoded["txn"] == txn and decoded["index"] == 0
+    # the signature verifies over exactly the signable region
+    assert verify(sig, pub, gw.signable(gw.V_VOTE, v["payload"]))
+    # identity hash covers the full serialized value
+    assert gw.value_hash(wire) == hashlib.sha256(wire).digest()
+
+
+def test_contact_info_roundtrip_and_port_delta_encoding():
+    _, _, pub = keypair(SEED)
+    ci = gw.ContactInfo(
+        pubkey=pub, wallclock_ms=987_654_321, outset_us=17,
+        shred_version=50093, version=(0, 6, 3), commit=0xDEADBEEF,
+        feature_set=1234, client=gw.CLIENT_FIREDANCER,
+        sockets={gw.SOCKET_GOSSIP: ("127.0.0.1", 8001),
+                 gw.SOCKET_TVU: ("127.0.0.1", 8002),
+                 gw.SOCKET_TPU: ("10.0.0.7", 8003),
+                 gw.SOCKET_RPC: ("127.0.0.1", 7000)})
+    payload = ci.encode()
+    got, end = gw.ContactInfo.decode(payload, 0)
+    assert end == len(payload)
+    assert got == ci
+    assert got.gossip_addr() == ("127.0.0.1", 8001)
+    # negative port deltas must survive the u16 wraparound
+    assert got.sockets[gw.SOCKET_RPC] == ("127.0.0.1", 7000)
+    # envelope round-trip through a push container
+    sig = sign(SEED, gw.signable(gw.V_CONTACT_INFO, payload))
+    wire = gw.encode_value(gw.V_CONTACT_INFO, payload, sig)
+    msg = gw.encode_container(gw.MSG_PUSH, pub, [wire])
+    view = gw.parse_message(msg)
+    assert view["kind"] == "push" and view["from"] == pub
+    assert view["values"][0]["wire"] == wire
+    assert view["values"][0]["wallclock_ms"] == 987_654_321
+
+
+def test_pull_request_bloom_roundtrip():
+    _, _, pub = keypair(SEED)
+    ci = gw.ContactInfo(pubkey=pub, wallclock_ms=5,
+                        sockets={gw.SOCKET_GOSSIP: ("127.0.0.1", 9)})
+    pay = ci.encode()
+    sig = sign(SEED, gw.signable(gw.V_CONTACT_INFO, pay))
+    civ = gw.encode_value(gw.V_CONTACT_INFO, pay, sig)
+    bits = struct.pack("<4Q", 1, 2, 4, 8)
+    msg = gw.encode_pull_request([7, 11], bits, 4, 0xFFFF, 16, civ)
+    view = gw.parse_message(msg)
+    assert view["kind"] == "pull_request"
+    assert view["bloom_keys"] == [7, 11]
+    assert view["bloom_bits"] == bits
+    assert view["mask"] == 0xFFFF and view["mask_bits"] == 16
+    assert view["ci"]["origin"] == pub
+
+
+def test_prune_message_and_both_signable_forms():
+    _, _, pub = keypair(SEED)
+    origins = [hashlib.sha256(b"%d" % i).digest() for i in range(3)]
+    dest = hashlib.sha256(b"dest").digest()
+    wc = 777
+    signable = gw.prune_signable(pub, origins, dest, wc, prefixed=True)
+    assert signable.startswith(b"\xffSOLANA_PRUNE_DATA")
+    # layout check against fd_gossvf_tile.c verify_prune offsets
+    assert len(signable) == 98 + 32 * len(origins)
+    sig = sign(SEED, signable)
+    msg = gw.encode_prune(pub, origins, sig, dest, wc)
+    view = gw.parse_message(msg)
+    assert view["kind"] == "prune" and view["origins"] == origins
+    assert view["destination"] == dest and view["wallclock_ms"] == wc
+    # the unprefixed form is the same bytes minus the 18-byte prefix
+    assert gw.prune_signable(pub, origins, dest, wc,
+                             prefixed=False) == signable[18:]
+
+
+def test_ping_pong_layout():
+    _, _, pub = keypair(SEED)
+    token = hashlib.sha256(b"tok").digest()
+    psig = sign(SEED, token)
+    ping = gw.encode_ping(pub, token, psig)
+    assert len(ping) == 4 + 128
+    view = gw.parse_message(ping)
+    assert view["kind"] == "ping" and view["token"] == token
+    pre = gw.pong_preimage(token)
+    assert pre == b"SOLANA_PING_PONG" + token
+    pong = gw.encode_pong(pub, token, sign(SEED, hashlib.sha256(pre)
+                                           .digest()))
+    view = gw.parse_message(pong)
+    assert view["kind"] == "pong"
+    assert view["token"] == hashlib.sha256(pre).digest()
+
+
+def test_hostile_wire_rejected():
+    _, _, pub = keypair(SEED)
+    with pytest.raises(gw.WireError):
+        gw.parse_message(struct.pack("<I", 9) + bytes(32))
+    # trailing bytes rejected (payload_sz==CUR_OFFSET contract)
+    token = bytes(32)
+    ping = gw.encode_ping(pub, token, bytes(64)) + b"x"
+    with pytest.raises(gw.WireError):
+        gw.parse_message(ping)
+    # oversize CRDS count
+    bad = struct.pack("<I", gw.MSG_PUSH) + pub + struct.pack("<Q", 500)
+    with pytest.raises(gw.WireError):
+        gw.parse_message(bad)
+    # vote with out-of-range index
+    with pytest.raises(gw.WireError):
+        gw.encode_vote(32, pub, b"", 0)
+
+
+def test_all_reference_crds_tags_scan_in_containers():
+    """A push datagram mixing every CRDS tag the reference parses must
+    scan value-by-value without aborting (real peers batch EpochSlots /
+    DuplicateShred / snapshot hashes alongside ContactInfos)."""
+    _, _, pub = keypair(SEED)
+    wc = struct.pack("<Q", 123)
+    payloads = [
+        (gw.V_ACCOUNT_HASHES, pub + struct.pack("<Q", 2)
+         + (struct.pack("<Q", 5) + bytes(32)) * 2 + wc),
+        (gw.V_INC_SNAPSHOT_HASHES, pub + struct.pack("<Q", 9) + bytes(32)
+         + struct.pack("<Q", 1) + struct.pack("<Q", 10) + bytes(32) + wc),
+        (gw.V_EPOCH_SLOTS, bytes([0]) + pub + struct.pack("<Q", 1)
+         + struct.pack("<I", 1) + struct.pack("<QQ", 7, 8)
+         + bytes([1]) + struct.pack("<Q", 2) + bytes(2)
+         + struct.pack("<Q", 16) + wc),
+        (gw.V_DUPLICATE_SHRED, struct.pack("<H", 1) + pub + wc
+         + struct.pack("<Q", 9) + bytes(5) + bytes([2, 0])
+         + struct.pack("<Q", 3) + b"abc"),
+        (gw.V_RESTART_HEAVIEST_FORK, pub + wc + struct.pack("<Q", 4)
+         + bytes(32) + struct.pack("<Q", 11)[:8] + struct.pack("<H", 1)),
+        (gw.V_NODE_INSTANCE, gw.encode_node_instance(pub, 123, 5, 6)),
+    ]
+    values = [gw.encode_value(t, p, bytes(64)) for t, p in payloads]
+    msg = gw.encode_container(gw.MSG_PUSH, pub, values)
+    view = gw.parse_message(msg)
+    assert [v["tag"] for v in view["values"]] == [t for t, _ in payloads]
+    assert all(v["origin"] == pub for v in view["values"])
+    assert all(v["wallclock_ms"] == 123 for v in view["values"])

@@ -229,7 +229,7 @@ def test_nonleader_repairs_replays_and_votes():
         .link("repair_shreds", depth=256, mtu=1280)
         .link("shred_slices", depth=64, mtu=1 << 16)
         .link("replay_tower", depth=128, mtu=128)
-        .link("tower_votes", depth=32, mtu=64)
+        .link("tower_votes", depth=32, mtu=512)
         .link("repair_req", depth=16, mtu=1280)
         .link("repair_sign_resp", depth=16, mtu=128)
         .link("send_req", depth=16, mtu=1280)

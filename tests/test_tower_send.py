@@ -77,7 +77,7 @@ def test_tower_send_sign_pipeline():
     topo = (
         Topology(f"tw{os.getpid()}", wksp_size=1 << 23)
         .link("replay_tower", depth=64, mtu=128)
-        .link("tower_votes", depth=32, mtu=64)
+        .link("tower_votes", depth=32, mtu=512)
         .link("send_req", depth=16, mtu=1280)
         .link("sign_resp", depth=16, mtu=128)
         .tile("driver", "synth", outs=["replay_tower"], count=0)
@@ -114,9 +114,11 @@ def test_tower_send_sign_pipeline():
         assert verify(t.signatures(data)[0], IDENTITY, t.message(data))
         ix = t.instrs[0]
         ix_data = data[ix.data_off:ix.data_off + ix.data_sz]
-        (disc, cnt) = struct.unpack_from("<IH", ix_data, 0)
-        (slot,) = struct.unpack_from("<Q", ix_data, 6)
-        assert disc == 1 and cnt == 1 and slot == 5
+        # real VoteInstruction::TowerSync (disc 14): u64 lockouts len,
+        # then (u64 slot, u32 conf) entries
+        (disc, cnt) = struct.unpack_from("<IQ", ix_data, 0)
+        (slot, conf) = struct.unpack_from("<QI", ix_data, 12)
+        assert disc == 14 and cnt >= 1 and slot == 5 and conf >= 1
         deadline = time.time() + 30
         while time.time() < deadline:
             if runner.metrics("send")["sent"] >= 1:
