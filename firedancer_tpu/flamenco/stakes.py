@@ -27,17 +27,77 @@ from ..svm.stake import STAKE_PROGRAM_ID, StakeState
 from ..svm.vote import VOTE_PROGRAM_ID, VoteState, _HDR_SZ
 
 
-def vote_stakes(funk, xid, epoch: int) -> dict[bytes, int]:
-    out: dict[bytes, int] = {}
+def _delegations(funk, xid):
     for key, acct in funk.items_at(xid).items():
         if not isinstance(acct, Account) \
                 or acct.owner != STAKE_PROGRAM_ID:
             continue
         try:
-            st = StakeState.from_bytes(acct.data)
+            yield key, StakeState.from_bytes(acct.data)
         except Exception:
             continue
-        amt = st.active_at(epoch)
+
+
+def read_stake_history(funk, xid) -> dict | None:
+    """StakeHistory sysvar -> {epoch: (effective, activating,
+    deactivating)}, or None when the account doesn't exist (tests /
+    self-contained clusters run step activation)."""
+    from ..svm.sysvars import STAKE_HISTORY_ID, dec_stake_history
+    acct = funk.rec_query(xid, STAKE_HISTORY_ID) \
+        if hasattr(funk, "rec_query") else None
+    if not isinstance(acct, Account) or len(acct.data) < 8:
+        return None
+    try:
+        return dec_stake_history(bytes(acct.data))
+    except Exception:
+        return None
+
+
+def cluster_stake_totals(funk, xid, epoch: int,
+                         history: dict) -> tuple[int, int, int]:
+    """(effective, activating, deactivating) cluster totals at `epoch`
+    given the history through epoch-1 — the entry the bank appends to
+    the StakeHistory sysvar at each boundary (ref:
+    src/flamenco/runtime/sysvar/fd_sysvar_stake_history.c update)."""
+    from ..svm.stake import stake_activating_and_deactivating
+    te = ta = td = 0
+    for _, st in _delegations(funk, xid):
+        e, a, d = stake_activating_and_deactivating(st, epoch, history)
+        te += e
+        ta += a
+        td += d
+    return te, ta, td
+
+
+def update_stake_history(funk, xid, epoch: int):
+    """Epoch-boundary duty: append `epoch`'s cluster totals to the
+    StakeHistory sysvar (newest first)."""
+    from ..svm.sysvars import (STAKE_HISTORY_ID, _write,
+                               dec_stake_history, enc_stake_history)
+    prev = funk.rec_query(xid, STAKE_HISTORY_ID)
+    hist = {}
+    if isinstance(prev, Account) and len(prev.data) >= 8:
+        try:
+            hist = dec_stake_history(bytes(prev.data))
+        except Exception:
+            hist = {}
+    totals = cluster_stake_totals(funk, xid, epoch, hist)
+    entries = [(epoch, totals)] + sorted(
+        ((e, t) for e, t in hist.items() if e != epoch),
+        key=lambda kv: -kv[0])
+    _write(funk, xid, STAKE_HISTORY_ID, enc_stake_history(entries))
+    return totals
+
+
+def vote_stakes(funk, xid, epoch: int,
+                history: dict | None = None) -> dict[bytes, int]:
+    """history=None reads the StakeHistory sysvar if present; pass {}
+    to force step activation."""
+    if history is None:
+        history = read_stake_history(funk, xid)
+    out: dict[bytes, int] = {}
+    for _, st in _delegations(funk, xid):
+        amt = st.active_at(epoch, history=history or None)
         if amt > 0:
             out[st.voter] = out.get(st.voter, 0) + amt
     return out

@@ -114,6 +114,7 @@ ERR_UNKNOWN_PROGRAM = "unknown_program"
 ERR_BAD_IX_DATA = "bad_instruction_data"
 ERR_VM = "program_failed"
 ERR_BALANCE_VIOLATION = "sum_of_lamports_changed"
+ERR_RENT = "insufficient_funds_for_rent"
 ERR_CPI = "cpi_violation"
 ERR_ALUT = "alut_resolution_failed"
 
@@ -170,6 +171,7 @@ class TxnContext:
         self.keys = txn.account_keys(payload) + list(loaded_keys)
         self._loaded_writable = list(loaded_writable)
         self._work: dict[bytes, Account] = {}
+        self._pre: dict[bytes, tuple] = {}   # (lamports, data_len) at load
         self.logs = LogCollector()
         self.last_exec_cu = 0        # CU used by the last BPF frame
         self.cu_limit = 200_000      # SetComputeUnitLimit applies here
@@ -193,7 +195,35 @@ class TxnContext:
             self._work[k] = Account() if a is None else \
                 Account(a.lamports, a.data, a.owner, a.executable,
                         a.rent_epoch)
+            self._pre[k] = (0, 0) if a is None else \
+                (a.lamports, len(a.data))
         return self._work[k]
+
+    def rent_violation(self) -> bytes | None:
+        """Post-execution rent-state check (modern consensus: rent is
+        never collected, but every touched account must LEAVE the txn
+        rent-exempt — ref src/flamenco/runtime/sysvar/fd_sysvar_rent.c
+        minimum-balance discipline + Agave check_rent_state):
+        an account passes when it is empty (0 lamports), meets the
+        rent-exempt minimum for its data size, or was ALREADY
+        rent-paying and did not grow (Agave's RentPaying->RentPaying
+        transition: same data size, lamports non-increasing; an
+        exempt account may never become rent-paying). Returns the
+        first offending key, else None."""
+        from .sysvars import rent_exempt_minimum
+        for k, a in self._work.items():
+            if a.lamports == 0:
+                continue
+            need = rent_exempt_minimum(len(a.data))
+            if a.lamports >= need:
+                continue
+            pre_l, pre_len = self._pre.get(k, (0, 0))
+            pre_paying = 0 < pre_l < rent_exempt_minimum(pre_len)
+            if pre_paying and a.lamports <= pre_l \
+                    and len(a.data) == pre_len:
+                continue               # rent-paying shrank/held: legal
+            return k
+        return None
 
     def commit(self):
         for k, a in self._work.items():
@@ -926,9 +956,11 @@ def dispatch_instr(ctx: TxnContext, ic: InstrCtx, depth: int = 0,
 class TxnExecutor:
     """fd_runtime_prepare_and_execute_txn analog for the host path."""
 
-    def __init__(self, db: AccDb, fee_per_signature: int = 5000):
+    def __init__(self, db: AccDb, fee_per_signature: int = 5000,
+                 enforce_rent: bool = True):
         self.db = db
         self.fee_per_signature = fee_per_signature
+        self.enforce_rent = enforce_rent
         self.epoch = 0               # advanced by the bank at boundaries
         self.slot = 0
 
@@ -962,6 +994,9 @@ class TxnExecutor:
         if payer.account.lamports < fee:
             self.db.close_rw(payer, discard=True)
             return TxnResult(ERR_FEE, 0, [])
+        # rent-state baseline is the PRE-FEE payer (Agave
+        # validate_fee_payer rejects exempt -> rent-paying via fees)
+        payer_pre = (payer.account.lamports, len(payer.account.data))
         payer.account.lamports -= fee
         self.db.close_rw(payer)
 
@@ -976,6 +1011,11 @@ class TxnExecutor:
         ctx = TxnContext(self.db, xid, txn, payload, epoch=self.epoch,
                          slot=self.slot, loaded_keys=loaded_keys,
                          loaded_writable=loaded_writable)
+        if self.enforce_rent:
+            # force the payer into the working set under its pre-fee
+            # baseline so the rent-state check always covers it
+            ctx.account(0)
+            ctx._pre[keys[0]] = payer_pre
         keys = ctx.keys                # static + table-loaded
         total = len(keys)
         # pre-scan ComputeBudget requests (the reference resolves the
@@ -1007,5 +1047,7 @@ class TxnExecutor:
             if st != OK:
                 # atomic rollback: drop the working set (fee stays)
                 return TxnResult(st, fee, ctx.logs)
+        if self.enforce_rent and ctx.rent_violation() is not None:
+            return TxnResult(ERR_RENT, fee, ctx.logs)
         ctx.commit()
         return TxnResult(OK, fee, ctx.logs, ctx.return_data)

@@ -4,10 +4,14 @@ Subset of the reference's stake program re-expressed for this runtime
 (ref: src/flamenco/runtime/program/fd_stake_program.c — Initialize /
 DelegateStake / Deactivate / Withdraw with the authorized-staker/
 withdrawer split; epoch-boundary activation semantics per the stake
-history discipline, simplified to step activation: a delegation made
-in epoch E is ACTIVE for epochs > E, a deactivation in epoch E stops
-counting for epochs > E — the reference's warmup/cooldown RATE limits
-are not modeled, documented divergence).
+history discipline). Activation runs in TWO modes: with a
+StakeHistory sysvar present, the reference's RATE-LIMITED
+warmup/cooldown (at most WARMUP_COOLDOWN_RATE x the prior epoch's
+cluster-effective stake moves per epoch, pro-rata —
+stake_activating_and_deactivating below, r5); without one, step
+activation (a delegation made in epoch E is ACTIVE for epochs > E, a
+deactivation in epoch E stops counting for epochs > E) for
+self-contained clusters and unit tests.
 
 The current epoch reaches the program through TxnContext.epoch — this
 framework's stand-in for the Clock sysvar (the reference reads
@@ -64,9 +68,26 @@ class StakeState:
 
     # -- epoch semantics ----------------------------------------------------
 
-    def active_at(self, epoch: int) -> int:
-        """Stake counted for `epoch` (step activation: active strictly
-        after the activation epoch, through the deactivation epoch)."""
+    def active_at(self, epoch: int, history: dict | None = None,
+                  rate: float | None = None) -> int:
+        """Stake counted for `epoch`.
+
+        Without `history`: step activation (active strictly after the
+        activation epoch, through the deactivation epoch) — the
+        self-contained-cluster mode.
+
+        With `history` (epoch -> (effective, activating, deactivating)
+        cluster totals, the StakeHistory sysvar): the reference's
+        RATE-LIMITED warmup/cooldown — at most rate x the prior
+        epoch's cluster-effective stake (de)activates per epoch,
+        apportioned pro-rata across waiting delegations (ref:
+        src/flamenco/runtime/program/fd_stake_program.c stake history
+        discipline; Agave stake_activating_and_deactivating)."""
+        if history is not None:
+            eff, _act, _deact = stake_activating_and_deactivating(
+                self, epoch, history,
+                rate if rate is not None else WARMUP_COOLDOWN_RATE)
+            return eff
         if self.state != ST_DELEGATED:
             return 0
         if self.activation_epoch == EPOCH_NONE \
@@ -84,6 +105,107 @@ class StakeState:
             return True
         return (self.deactivation_epoch != EPOCH_NONE
                 and epoch > self.deactivation_epoch)
+
+
+# post reduce_stake_warmup_cooldown rate (9%/epoch of cluster
+# effective stake; 25% before the feature)
+WARMUP_COOLDOWN_RATE = 0.09
+
+
+def _stake_and_activating(amount: int, activation_epoch: int,
+                          target_epoch: int, history: dict,
+                          rate: float) -> tuple[int, int]:
+    """(effective, activating) at target_epoch. Float weights mirror
+    Agave's f64 arithmetic exactly (consensus-visible there too)."""
+    if activation_epoch == EPOCH_NONE:
+        return amount, 0               # bootstrap: effective at genesis
+    if target_epoch < activation_epoch:
+        return 0, 0
+    if target_epoch == activation_epoch:
+        return 0, amount
+    prev = history.get(activation_epoch)
+    if prev is None:
+        return amount, 0               # no history entry: fully active
+    prev_epoch = activation_epoch
+    current = 0
+    while True:
+        current_epoch = prev_epoch + 1
+        remaining = amount - current
+        prev_eff, prev_act, _ = prev
+        if prev_act == 0:
+            break
+        weight = remaining / prev_act
+        newly_cluster = prev_eff * rate
+        newly = max(1, int(weight * newly_cluster))
+        current += newly
+        if current >= amount:
+            return amount, 0
+        if current_epoch >= target_epoch:
+            break
+        prev = history.get(current_epoch)
+        if prev is None:
+            break
+        prev_epoch = current_epoch
+    return current, amount - current
+
+
+def stake_activating_and_deactivating(st: "StakeState",
+                                      target_epoch: int,
+                                      history: dict,
+                                      rate: float = WARMUP_COOLDOWN_RATE
+                                      ) -> tuple[int, int, int]:
+    """(effective, activating, deactivating) for one delegation under
+    the cluster stake history — Agave
+    Delegation::stake_activating_and_deactivating, draw-compatible
+    including the max(1,...) per-epoch floor and the f64 weights."""
+    if st.state != ST_DELEGATED:
+        return 0, 0, 0
+    eff, act = _stake_and_activating(st.amount, st.activation_epoch,
+                                     target_epoch, history, rate)
+    de = st.deactivation_epoch
+    if target_epoch < de or de == EPOCH_NONE:
+        return eff, act, 0
+    if target_epoch == de:
+        return eff, 0, eff             # all effective stake cooling
+    # cooldown from the deactivation epoch's effective amount
+    eff_at_de, _ = _stake_and_activating(st.amount, st.activation_epoch,
+                                         de, history, rate)
+    prev = history.get(de)
+    if prev is None:
+        return 0, 0, 0                 # no history: instant cooldown
+    prev_epoch = de
+    current = eff_at_de
+    while True:
+        current_epoch = prev_epoch + 1
+        _, _, prev_deact = prev
+        prev_eff = prev[0]
+        if prev_deact == 0:
+            break
+        weight = current / prev_deact
+        newly_not = max(1, int(weight * (prev_eff * rate)))
+        current -= newly_not
+        if current <= 0:
+            return 0, 0, 0
+        if current_epoch >= target_epoch:
+            break
+        prev = history.get(current_epoch)
+        if prev is None:
+            break
+        prev_epoch = current_epoch
+    return current, 0, current
+
+
+def _read_history(ic) -> dict | None:
+    """StakeHistory sysvar via the instruction's txn context (None
+    when the account doesn't exist — step-activation mode)."""
+    from .sysvars import STAKE_HISTORY_ID, dec_stake_history
+    acct = ic.ctx.db.peek(ic.ctx.xid, STAKE_HISTORY_ID)
+    if acct is None or len(acct.data) < 8:
+        return None
+    try:
+        return dec_stake_history(bytes(acct.data))
+    except Exception:
+        return None
 
 
 def ix_initialize(staker: bytes, withdrawer: bytes) -> bytes:
@@ -126,8 +248,15 @@ def exec_stake(ic) -> str:
             return ERR_NOT_WRITABLE
         if acct.data and any(acct.data[:1]):
             return ERR_INVALID_OWNER         # already initialized
+        # the rent-exempt reserve is locked at initialize and never
+        # delegated or withdrawable while the account lives (ref
+        # fd_stake_program.c initialize: requires the rent minimum)
+        from .sysvars import rent_exempt_minimum
+        reserve = rent_exempt_minimum(STATE_SZ)
+        if acct.lamports < reserve:
+            return ERR_INSUFFICIENT
         st = StakeState(ST_INIT, staker=data[4:36],
-                        withdrawer=data[36:68])
+                        withdrawer=data[36:68], rent_reserve=reserve)
         acct.data = st.to_bytes()
         return OK
 
@@ -181,7 +310,17 @@ def exec_stake(ic) -> str:
             return ERR_MISSING_SIG
         if not ic.is_writable(0) or not ic.is_writable(1):
             return ERR_NOT_WRITABLE
-        if st.fully_inactive(epoch):
+        hist = _read_history(ic)
+        if hist:
+            # rate-limited cooldown: lamports stay locked while the
+            # stake history still counts them as effective (ref
+            # fd_stake_program.c withdraw: staked = delegation stake
+            # at the clock epoch under the history)
+            eff, act, _ = stake_activating_and_deactivating(
+                st, epoch, hist)
+            staked = eff + act
+            locked = (staked + st.rent_reserve) if staked else 0
+        elif st.fully_inactive(epoch):
             locked = 0                        # may drain + close
         else:
             locked = st.amount + st.rent_reserve

@@ -90,6 +90,28 @@ def enc_epoch_schedule(slots_per_epoch: int,
                        first_normal_slot)
 
 
+def enc_stake_history(entries: list[tuple[int, tuple]]) -> bytes:
+    """StakeHistory: Vec<(Epoch, {effective, activating, deactivating}
+    u64 x3)>, newest first, capped at 512 entries (Agave layout; ref
+    src/flamenco/runtime/sysvar/fd_sysvar_stake_history.c)."""
+    entries = entries[:512]
+    out = struct.pack("<Q", len(entries))
+    for epoch, (eff, act, deact) in entries:
+        out += struct.pack("<QQQQ", epoch, eff, act, deact)
+    return out
+
+
+def dec_stake_history(b: bytes) -> dict[int, tuple]:
+    (n,) = struct.unpack_from("<Q", b, 0)
+    out = {}
+    off = 8
+    for _ in range(n):
+        epoch, eff, act, deact = struct.unpack_from("<QQQQ", b, off)
+        out[epoch] = (eff, act, deact)
+        off += 32
+    return out
+
+
 def enc_slot_hashes(entries: list[tuple[int, bytes]]) -> bytes:
     """bincode Vec<(Slot, Hash)>, newest first, capped at 512."""
     entries = entries[:SLOT_HASHES_MAX]
@@ -121,7 +143,10 @@ def enc_recent_blockhashes(entries: list[tuple[bytes, int]]) -> bytes:
 
 
 def _write(db, xid, key: bytes, data: bytes):
-    db.funk.rec_write(xid, key, Account(
+    """Materialize a sysvar account; accepts an AccDb or a bare Funk
+    (the one shape for every sysvar writer)."""
+    funk = db.funk if hasattr(db, "funk") else db
+    funk.rec_write(xid, key, Account(
         lamports=rent_exempt_minimum(len(data)), data=bytearray(data),
         owner=SYSVAR_OWNER, executable=False))
 

@@ -33,9 +33,11 @@ def make_signed_txns(n: int, seed: int = 0,
         blockhash = hashlib.sha256(b"hash-%d" % seed).digest()
         dest = hashlib.sha256(b"dest-%d" % i).digest()
         # real system-program Transfer: u32 discriminant 2 + u64
-        # lamports — executable by the bank tile's SVM wave executor
+        # lamports — executable by the bank tile's SVM wave executor.
+        # Amounts stay above the 0-data rent-exempt minimum (~891K)
+        # so fresh destinations satisfy the rent-state check
         data = b"\x02\x00\x00\x00" \
-            + int(rng.integers(1, 1 << 31)).to_bytes(8, "little")
+            + int(rng.integers(1 << 20, 1 << 31)).to_bytes(8, "little")
         pub, _ = signer(key_seed, b"")
         msg = build_message([pub], [dest, SYSTEM_PROGRAM_ID], blockhash,
                             [(2, bytes([0, 1]), data)], n_ro_unsigned=1)

@@ -41,7 +41,7 @@ def env():
     db = AccDb(funk)
     funk.rec_write(None, PAYER, Account(lamports=1 << 30))
     funk.txn_prepare(None, "blk")
-    ex = TxnExecutor(db)
+    ex = TxnExecutor(db, enforce_rent=False)
     ex.slot = 100
     return funk, db, ex
 

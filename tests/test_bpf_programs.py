@@ -64,7 +64,7 @@ def env():
     funk.rec_write(None, A1, Account(lamports=500, owner=PROG))
     funk.rec_write(None, A2, Account(lamports=50, owner=PROG))
     funk.txn_prepare(None, "blk")
-    return funk, db, TxnExecutor(db)
+    return funk, db, TxnExecutor(db, enforce_rent=False)
 
 
 def deploy(funk, code):

@@ -27,9 +27,13 @@ from firedancer_tpu.svm.accdb import SYSTEM_PROGRAM_ID
 from firedancer_tpu.svm.stake import (
     STAKE_PROGRAM_ID, STATE_SZ, ix_deactivate, ix_delegate, ix_initialize,
 )
+from firedancer_tpu.svm.sysvars import rent_exempt_minimum
 from firedancer_tpu.svm.vote import VOTE_PROGRAM_ID, VoteState, ix_vote
+
+_STAKE_MIN = rent_exempt_minimum(STATE_SZ)
 from firedancer_tpu.svm.programs import (
     NONCE_STATE_SZ, SYS_ADVANCE_NONCE, SYS_CREATE_WITH_SEED,
+    SYS_TRANSFER,
     SYS_INIT_NONCE, create_with_seed,
 )
 
@@ -185,17 +189,47 @@ VECTORS = [
          instrs=[(2, [1], ix_vote([5], k(5)))], n_ro_unsigned=1,
          expect="invalid_account_owner", fee=2 * FEE),
 
+    # --- rent-state discipline (enforce_rent=True vectors; Agave
+    #     check_rent_state / fd_sysvar_rent.c) ---
+    dict(name="rent_transfer_below_minimum_to_new_refused",
+         pre={A: 1 << 30},
+         signers=[A], extra=[B, SYSTEM_PROGRAM_ID],
+         instrs=[(2, [0, 1],
+                  struct.pack("<IQ", SYS_TRANSFER, 1_000))],
+         n_ro_unsigned=1, enforce_rent=True,
+         expect="insufficient_funds_for_rent", fee=FEE),
+    dict(name="rent_transfer_at_minimum_to_new_ok",
+         pre={A: 1 << 30},
+         signers=[A], extra=[B, SYSTEM_PROGRAM_ID],
+         instrs=[(2, [0, 1],
+                  struct.pack("<IQ", SYS_TRANSFER,
+                              rent_exempt_minimum(0)))],
+         n_ro_unsigned=1, enforce_rent=True,
+         expect="ok", fee=FEE, post={B: rent_exempt_minimum(0)}),
+
     # --- stake program (fd_stake_program.c) ---
+    # stake accounts fund the rent-exempt reserve that initialize
+    # locks (r5 rent discipline)
     dict(name="stake_initialize_ok",
          pre={A: 100_000,
-              B: {"lamports": 5_000, "owner": STAKE_PROGRAM_ID,
+              B: {"lamports": _STAKE_MIN + 5_000,
+                  "owner": STAKE_PROGRAM_ID,
                   "data": bytes(STATE_SZ)}},
          signers=[A], extra=[B, STAKE_PROGRAM_ID],
          instrs=[(2, [1], ix_initialize(A, A))], n_ro_unsigned=1,
-         expect="ok", fee=FEE, post={B: 5_000}),
+         expect="ok", fee=FEE, post={B: _STAKE_MIN + 5_000}),
+    dict(name="stake_initialize_below_reserve_refused",
+         pre={A: 100_000,
+              B: {"lamports": _STAKE_MIN - 1,
+                  "owner": STAKE_PROGRAM_ID,
+                  "data": bytes(STATE_SZ)}},
+         signers=[A], extra=[B, STAKE_PROGRAM_ID],
+         instrs=[(2, [1], ix_initialize(A, A))], n_ro_unsigned=1,
+         expect="insufficient_funds", fee=FEE),
     dict(name="stake_delegate_to_nonvote_refused",
          pre={A: 100_000,
-              B: {"lamports": 5_000, "owner": STAKE_PROGRAM_ID,
+              B: {"lamports": _STAKE_MIN + 5_000,
+                  "owner": STAKE_PROGRAM_ID,
                   "data": bytes(STATE_SZ)},
               C: 10},
          signers=[A], extra=[B, C, STAKE_PROGRAM_ID],
@@ -204,12 +238,14 @@ VECTORS = [
          expect="invalid_account_owner", fee=FEE),
     dict(name="stake_deactivate_undelegated_refused",
          pre={A: 100_000,
-              B: {"lamports": 5_000, "owner": STAKE_PROGRAM_ID,
+              B: {"lamports": _STAKE_MIN + 5_000,
+                  "owner": STAKE_PROGRAM_ID,
                   "data": bytes(STATE_SZ)}},
          signers=[A], extra=[B, STAKE_PROGRAM_ID],
          instrs=[(2, [1], ix_initialize(A, A)),
                  (2, [1], ix_deactivate())], n_ro_unsigned=1,
-         expect="invalid_account_owner", fee=FEE, post={B: 5_000}),
+         expect="invalid_account_owner", fee=FEE,
+         post={B: _STAKE_MIN + 5_000}),
 
     # --- seed derivation (fd_system_program.c:389-554) ---
     dict(name="create_with_seed_ok",
@@ -285,7 +321,8 @@ def test_conformance(vec):
         pre_balances[key] = a.lamports
         funk.rec_write(None, key, a)
     funk.txn_prepare(None, "blk")
-    ex = TxnExecutor(db)
+    ex = TxnExecutor(db, enforce_rent=vec.get("enforce_rent",
+                                              False))
 
     msg = build_message(
         vec["signers"], vec["extra"], b"\x11" * 32,

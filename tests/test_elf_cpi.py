@@ -140,7 +140,7 @@ def env():
     db = AccDb(funk)
     funk.rec_write(None, PAYER, Account(lamports=1_000_000))
     funk.txn_prepare(None, "blk")
-    return funk, db, TxnExecutor(db)
+    return funk, db, TxnExecutor(db, enforce_rent=False)
 
 
 def _txn(instr_accounts, data, extra=()):

@@ -52,11 +52,11 @@ def test_genesis_restores_and_executes():
     msg = build_message([ident], [dest, SYSTEM_PROGRAM_ID],
                         b"\x11" * 32,
                         [(2, bytes([0, 1]),
-                          struct.pack("<IQ", 2, 123))],
+                          struct.pack("<IQ", 2, 1 << 20))],
                         n_ro_unsigned=1)
     r = ex.execute("blk", build_txn([bytes(64)], msg))
     assert r.status == "ok"
-    assert db.lamports("blk", dest) == 123
+    assert db.lamports("blk", dest) == 1 << 20
 
 
 def test_genesis_cli(tmp_path, capsys):

@@ -32,7 +32,7 @@ def env():
         funk.rec_write(None, a, Account(
             lamports=1, owner=BPF_UPGRADEABLE_LOADER_ID))
     funk.txn_prepare(None, "blk")
-    ex = TxnExecutor(db)
+    ex = TxnExecutor(db, enforce_rent=False)
     ex.slot = 50
     return funk, db, ex
 

@@ -52,7 +52,7 @@ def _exec(txn_bytes):
     db = AccDb(funk)
     funk.rec_write(None, k(1), Account(lamports=1_000_000))
     funk.txn_prepare(None, "blk")
-    return TxnExecutor(db).execute("blk", txn_bytes)
+    return TxnExecutor(db, enforce_rent=False).execute("blk", txn_bytes)
 
 
 def _txn(program_id, ix_data):

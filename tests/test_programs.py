@@ -44,7 +44,10 @@ def env():
     db = AccDb(funk)
     funk.rec_write(None, k(1), Account(lamports=1_000_000))
     funk.txn_prepare(None, "blk")
-    return funk, db, TxnExecutor(db)
+    # legacy micro-balance vectors predate the rent-state
+    # discipline; rent coverage lives in tests/test_rent.py +
+    # the conformance vectors (enforce_rent defaults ON)
+    return funk, db, TxnExecutor(db, enforce_rent=False)
 
 
 def test_transfer_ok_and_fee(env):

@@ -1,0 +1,110 @@
+"""Rent-state discipline (r5): modern consensus collects no rent, but
+every account a transaction touches must LEAVE the transaction
+rent-exempt — new accounts below the minimum are refused, pre-existing
+rent-paying accounts may only be topped up, draining to exactly zero
+closes an account (ref: src/flamenco/runtime/sysvar/fd_sysvar_rent.c
+minimum-balance discipline; Agave check_rent_state transitions)."""
+import struct
+
+import pytest
+
+from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.protocol.txn import build_message, build_txn
+from firedancer_tpu.svm import AccDb, Account, TxnExecutor
+from firedancer_tpu.svm.programs import (
+    ERR_RENT, OK, SYS_CREATE_ACCOUNT, SYS_TRANSFER, SYSTEM_PROGRAM_ID,
+)
+from firedancer_tpu.svm.sysvars import rent_exempt_minimum
+
+
+def k(i):
+    return bytes([i]) * 32
+
+
+def _txn(signers, extra, instrs, **kw):
+    msg = build_message(signers, extra, b"\x22" * 32, instrs, **kw)
+    return build_txn([bytes(64)] * len(signers), msg)
+
+
+@pytest.fixture
+def env():
+    funk = Funk()
+    db = AccDb(funk)
+    funk.rec_write(None, k(1), Account(lamports=1 << 40))
+    funk.txn_prepare(None, "blk")
+    return funk, db, TxnExecutor(db)        # enforce_rent defaults ON
+
+
+def test_create_below_minimum_refused(env):
+    funk, db, ex = env
+    need = rent_exempt_minimum(64)
+    ix = struct.pack("<IQQ", SYS_CREATE_ACCOUNT, need - 1, 64) + k(9)
+    r = ex.execute("blk", _txn([k(1), k(5)], [SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == ERR_RENT
+    assert db.peek("blk", k(5)) is None     # rolled back
+    ix = struct.pack("<IQQ", SYS_CREATE_ACCOUNT, need, 64) + k(9)
+    r = ex.execute("blk", _txn([k(1), k(5)], [SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == OK
+
+
+def test_transfer_creating_rent_paying_account_refused(env):
+    funk, db, ex = env
+    ix = struct.pack("<IQ", SYS_TRANSFER, 1000)
+    r = ex.execute("blk", _txn([k(1)], [k(6), SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == ERR_RENT
+    # funding to exactly the minimum is fine
+    ix = struct.pack("<IQ", SYS_TRANSFER, rent_exempt_minimum(0))
+    r = ex.execute("blk", _txn([k(1)], [k(6), SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == OK
+
+
+def test_rent_paying_account_may_shrink_but_not_grow(env):
+    """Agave's RentPaying->RentPaying transition: same data size and
+    lamports NON-INCREASING (a top-up that doesn't reach exemption is
+    refused; partial drains of grandfathered accounts are legal)."""
+    funk, db, ex = env
+    funk.rec_write("blk", k(7), Account(lamports=500))  # grandfathered
+    ix = struct.pack("<IQ", SYS_TRANSFER, 100)
+    r = ex.execute("blk", _txn([k(1)], [k(7), SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == ERR_RENT            # growth w/o exemption: no
+    # topping up all the way to exemption IS allowed
+    ix = struct.pack("<IQ", SYS_TRANSFER, rent_exempt_minimum(0) - 500)
+    r = ex.execute("blk", _txn([k(1)], [k(7), SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == OK
+    # a different grandfathered account may shrink (k1 pays the fee)
+    funk.rec_write("blk", k(9), Account(lamports=500))
+    funk.rec_write("blk", k(8), Account(lamports=1 << 30))
+    ix = struct.pack("<IQ", SYS_TRANSFER, 100)
+    r = ex.execute("blk", _txn([k(1), k(9)], [k(8), SYSTEM_PROGRAM_ID],
+                               [(3, bytes([1, 2]), ix)]))
+    assert r.status == OK
+    assert db.lamports("blk", k(9)) == 400
+
+
+def test_fee_cannot_push_exempt_payer_into_rent_paying(env):
+    funk, db, ex = env
+    funk.rec_write("blk", k(9), Account(
+        lamports=rent_exempt_minimum(0)))   # exactly exempt
+    funk.rec_write("blk", k(8), Account(lamports=1 << 30))
+    ix = struct.pack("<IQ", SYS_TRANSFER, 1)
+    r = ex.execute("blk", _txn([k(9)], [k(8), SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == ERR_RENT            # exempt -> rent-paying
+
+
+def test_draining_to_zero_closes_account(env):
+    funk, db, ex = env
+    funk.rec_write("blk", k(9), Account(lamports=1 << 30))
+    bal = 1 << 30
+    fee = 5000
+    ix = struct.pack("<IQ", SYS_TRANSFER, bal - fee)
+    r = ex.execute("blk", _txn([k(9)], [k(1), SYSTEM_PROGRAM_ID],
+                               [(2, bytes([0, 1]), ix)]))
+    assert r.status == OK                  # 0-lamport account closes
+    assert db.lamports("blk", k(9)) == 0

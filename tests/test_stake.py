@@ -8,6 +8,7 @@ import struct
 import pytest
 
 from firedancer_tpu.flamenco.leaders import EpochLeaders
+from firedancer_tpu.svm.stake import EPOCH_NONE
 from firedancer_tpu.flamenco.stakes import (
     node_stakes, total_stake, vote_stakes,
 )
@@ -50,6 +51,9 @@ def env():
     funk = Funk()
     db = AccDb(funk)
     funk.rec_write(None, PAYER, Account(lamports=1 << 40))
+    # withdrawal destination pre-exists rent-exempt (modern rent rules
+    # refuse creating rent-paying accounts via transfer)
+    funk.rec_write(None, DEST, Account(lamports=1 << 20))
     for v, n in ((V1, N1), (V2, N2)):
         vs = VoteState(n, PAYER, PAYER)
         funk.rec_write(None, v, Account(
@@ -59,8 +63,12 @@ def env():
 
 
 def _mk_stake(ex, stake_key, lamports):
-    """CreateAccount(owner=stake) + Initialize(staker=withdrawer=PAYER)."""
-    create = struct.pack("<IQQ", SYS_CREATE_ACCOUNT, lamports,
+    """CreateAccount(owner=stake) + Initialize(staker=withdrawer=PAYER).
+    `lamports` is the DELEGATABLE stake; the rent-exempt reserve is
+    funded on top (locked by initialize, r5 rent discipline)."""
+    from firedancer_tpu.svm.sysvars import rent_exempt_minimum
+    create = struct.pack("<IQQ", SYS_CREATE_ACCOUNT,
+                         lamports + rent_exempt_minimum(STATE_SZ),
                          STATE_SZ) + STAKE_PROGRAM_ID
     r = ex.execute("blk", txn(
         [PAYER, stake_key], [SYSTEM_PROGRAM_ID],
@@ -117,7 +125,7 @@ def test_delegation_lifecycle_and_epoch_window(env):
     # fully inactive at epoch 2: full withdraw allowed
     ex.epoch = 2
     assert _withdraw(ex, S1, 1000).status == OK
-    assert db.lamports("blk", DEST) == 1000
+    assert db.lamports("blk", DEST) == (1 << 20) + 1000
 
 
 def test_unauthorized_staker_refused(env):
@@ -167,3 +175,73 @@ def test_delegation_change_moves_leader_schedule(env):
     # the leader (now the only staked node) never retransmits to itself
     assert dest.first_hop(5, 0, 1, leader=N2) is None
     assert total_stake(funk, "blk", 2) == 101_000
+
+
+# ---------------------------------------------------------------------------
+# r5: rate-limited warmup/cooldown under the StakeHistory sysvar
+# ---------------------------------------------------------------------------
+
+def test_warmup_is_rate_limited_and_pro_rata():
+    from firedancer_tpu.svm.stake import (
+        ST_DELEGATED, StakeState, stake_activating_and_deactivating)
+    # cluster: 1M effective, our 500K delegation activates at epoch 10
+    # alongside another 500K (cluster activating = 1M)
+    hist = {10: (1_000_000, 1_000_000, 0),
+            11: (1_090_000, 910_000, 0),
+            12: (1_188_100, 811_900, 0)}
+    st = StakeState(state=ST_DELEGATED, amount=500_000,
+                    activation_epoch=10)
+    assert stake_activating_and_deactivating(st, 9, hist) == (0, 0, 0)
+    assert stake_activating_and_deactivating(st, 10, hist) \
+        == (0, 500_000, 0)
+    # epoch 11: rate 0.09 x 1M cluster effective = 90K activates,
+    # our share = 500K/1M -> 45K
+    eff, act, _ = stake_activating_and_deactivating(st, 11, hist)
+    assert eff == 45_000 and act == 455_000
+    # epoch 12 compounds against the new cluster state
+    eff2, act2, _ = stake_activating_and_deactivating(st, 12, hist)
+    assert eff2 > eff and eff2 + act2 == 500_000
+    # far future with full history coverage keeps ramping; without
+    # history entries past 12 the ramp stops (partial knowledge)
+    eff3, _, _ = stake_activating_and_deactivating(st, 13, hist)
+    assert eff3 >= eff2
+
+
+def test_cooldown_is_rate_limited():
+    from firedancer_tpu.svm.stake import (
+        ST_DELEGATED, StakeState, stake_activating_and_deactivating)
+    hist = {5: (1_000_000, 0, 800_000),
+            6: (920_000, 0, 720_000)}
+    st = StakeState(state=ST_DELEGATED, amount=400_000,
+                    activation_epoch=EPOCH_NONE,   # bootstrap: all in
+                    deactivation_epoch=5)
+    assert stake_activating_and_deactivating(st, 4, hist) \
+        == (400_000, 0, 0)
+    assert stake_activating_and_deactivating(st, 5, hist) \
+        == (400_000, 0, 400_000)
+    # epoch 6: 0.09 x 1M = 90K cools cluster-wide; our share
+    # 400K/800K -> 45K leaves
+    eff, act, deact = stake_activating_and_deactivating(st, 6, hist)
+    assert (eff, act, deact) == (355_000, 0, 355_000)
+
+
+def test_step_activation_unchanged_without_history():
+    from firedancer_tpu.svm.stake import ST_DELEGATED, StakeState
+    st = StakeState(state=ST_DELEGATED, amount=1000,
+                    activation_epoch=0)
+    assert st.active_at(0) == 0 and st.active_at(1) == 1000
+
+
+def test_stake_history_sysvar_roundtrip_and_update(env):
+    import firedancer_tpu.flamenco.stakes as fstakes
+    from firedancer_tpu.svm.sysvars import STAKE_HISTORY_ID
+    funk, db, ex = env
+    totals = fstakes.update_stake_history(funk, "blk", 3)
+    hist = fstakes.read_stake_history(funk, "blk")
+    assert hist is not None and 3 in hist and hist[3] == totals
+    # appending another epoch keeps both, newest first
+    fstakes.update_stake_history(funk, "blk", 4)
+    hist = fstakes.read_stake_history(funk, "blk")
+    assert set(hist) >= {3, 4}
+    acct = funk.rec_query("blk", STAKE_HISTORY_ID)
+    assert acct is not None and len(acct.data) >= 8

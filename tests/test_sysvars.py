@@ -76,7 +76,7 @@ def test_slot_hashes_accumulate_newest_first_capped(env):
 
 def test_syscall_view_equals_account_view(env):
     funk, db = env
-    ex = TxnExecutor(db)
+    ex = TxnExecutor(db, enforce_rent=False)
     ex.begin_slot("blk", slot=55, blockhash=b"\x01" * 32)
     cache = sv.read_sysvar_cache(db, "blk", 0, 0)
     clock_acct = bytes(db.peek("blk", sv.CLOCK_ID).data)
@@ -104,7 +104,7 @@ def test_epoch_schedule_syscall_serves_account_bytes(env):
     from firedancer_tpu.vm.interp import INPUT_START
     from firedancer_tpu.vm.syscalls import (
         sys_get_epoch_schedule_sysvar)
-    ex = TxnExecutor(db)
+    ex = TxnExecutor(db, enforce_rent=False)
     ex.begin_slot("blk", slot=7, slots_per_epoch=1000)
     cache = sv.read_sysvar_cache(db, "blk", 0, 0)
     vm = Vm(b"\x95" + bytes(7), input_data=bytes(64))

@@ -34,7 +34,10 @@ def env():
     funk.rec_write(None, VOTE_ACCT,
                    Account(lamports=5_000, owner=VOTE_PROGRAM_ID))
     funk.txn_prepare(None, "blk")
-    return funk, db, TxnExecutor(db)
+    # legacy micro-balance vectors predate the rent-state
+    # discipline; rent coverage lives in tests/test_rent.py +
+    # the conformance vectors (enforce_rent defaults ON)
+    return funk, db, TxnExecutor(db, enforce_rent=False)
 
 
 def _init(ex):
