@@ -99,6 +99,10 @@ def calculate_stake_rewards(funk, xid, rewarded_epoch: int,
             credits_by_vote[key] = earned
             commission_by_vote[key] = vs.commission
 
+    # rewards and vote_stakes/leader schedule must count the SAME
+    # stake: apply the rate-limited history when the sysvar exists
+    from .stakes import read_stake_history
+    history = read_stake_history(funk, xid)
     entries = []                 # (stake_key, points, vote_key)
     total_points = 0
     for key, acct in items.items():
@@ -109,7 +113,7 @@ def calculate_stake_rewards(funk, xid, rewarded_epoch: int,
             st = StakeState.from_bytes(acct.data)
         except Exception:
             continue
-        stake = st.active_at(rewarded_epoch)
+        stake = st.active_at(rewarded_epoch, history=history or None)
         credits = credits_by_vote.get(st.voter, 0)
         pts = stake * credits
         if pts > 0:

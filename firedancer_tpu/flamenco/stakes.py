@@ -42,15 +42,12 @@ def read_stake_history(funk, xid) -> dict | None:
     """StakeHistory sysvar -> {epoch: (effective, activating,
     deactivating)}, or None when the account doesn't exist (tests /
     self-contained clusters run step activation)."""
-    from ..svm.sysvars import STAKE_HISTORY_ID, dec_stake_history
+    from ..svm.sysvars import (STAKE_HISTORY_ID,
+                               stake_history_from_account)
     acct = funk.rec_query(xid, STAKE_HISTORY_ID) \
         if hasattr(funk, "rec_query") else None
-    if not isinstance(acct, Account) or len(acct.data) < 8:
-        return None
-    try:
-        return dec_stake_history(bytes(acct.data))
-    except Exception:
-        return None
+    return stake_history_from_account(
+        acct if isinstance(acct, Account) else None)
 
 
 def cluster_stake_totals(funk, xid, epoch: int,

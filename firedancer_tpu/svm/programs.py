@@ -518,8 +518,18 @@ def _exec_system(ic: InstrCtx) -> str:
             return ERR_NOT_WRITABLE
         if lamports > acct.lamports:
             return ERR_INSUFFICIENT
+        if lamports != acct.lamports:
+            # partial withdraw must leave the rent-exempt reserve
+            # (Agave nonce withdraw: lamports + min_balance must fit;
+            # a FULL withdraw closes the account instead)
+            from .sysvars import rent_exempt_minimum
+            if lamports + rent_exempt_minimum(NONCE_STATE_SZ) \
+                    > acct.lamports:
+                return ERR_INSUFFICIENT
         acct.lamports -= lamports
         ic.account(1).lamports += lamports
+        if acct.lamports == 0:
+            acct.data = b""               # full withdraw closes
         return OK
 
     if disc == SYS_ALLOCATE:
@@ -994,9 +1004,19 @@ class TxnExecutor:
         if payer.account.lamports < fee:
             self.db.close_rw(payer, discard=True)
             return TxnResult(ERR_FEE, 0, [])
-        # rent-state baseline is the PRE-FEE payer (Agave
-        # validate_fee_payer rejects exempt -> rent-paying via fees)
+        # rent-state baseline is the PRE-FEE payer; the fee itself may
+        # not push an exempt payer into rent-paying (Agave
+        # validate_fee_payer rejects at LOAD: no fee charged, no state
+        # committed)
         payer_pre = (payer.account.lamports, len(payer.account.data))
+        if self.enforce_rent:
+            from .sysvars import rent_exempt_minimum
+            post = payer_pre[0] - fee
+            need = rent_exempt_minimum(payer_pre[1])
+            pre_paying = payer_pre[0] < need
+            if post != 0 and post < need and not pre_paying:
+                self.db.close_rw(payer, discard=True)
+                return TxnResult(ERR_RENT, 0, [])
         payer.account.lamports -= fee
         self.db.close_rw(payer)
 

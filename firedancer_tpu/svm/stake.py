@@ -198,14 +198,9 @@ def stake_activating_and_deactivating(st: "StakeState",
 def _read_history(ic) -> dict | None:
     """StakeHistory sysvar via the instruction's txn context (None
     when the account doesn't exist — step-activation mode)."""
-    from .sysvars import STAKE_HISTORY_ID, dec_stake_history
-    acct = ic.ctx.db.peek(ic.ctx.xid, STAKE_HISTORY_ID)
-    if acct is None or len(acct.data) < 8:
-        return None
-    try:
-        return dec_stake_history(bytes(acct.data))
-    except Exception:
-        return None
+    from .sysvars import STAKE_HISTORY_ID, stake_history_from_account
+    return stake_history_from_account(
+        ic.ctx.db.peek(ic.ctx.xid, STAKE_HISTORY_ID))
 
 
 def ix_initialize(staker: bytes, withdrawer: bytes) -> bytes:

@@ -112,6 +112,18 @@ def dec_stake_history(b: bytes) -> dict[int, tuple]:
     return out
 
 
+def stake_history_from_account(acct) -> dict | None:
+    """Decode the StakeHistory sysvar account (or None when absent /
+    malformed) — the ONE read-and-decode policy shared by the stake
+    program's withdraw gate and the epoch-stakes aggregation."""
+    if acct is None or len(getattr(acct, "data", b"")) < 8:
+        return None
+    try:
+        return dec_stake_history(bytes(acct.data))
+    except Exception:
+        return None
+
+
 def enc_slot_hashes(entries: list[tuple[int, bytes]]) -> bytes:
     """bincode Vec<(Slot, Hash)>, newest first, capped at 512."""
     entries = entries[:SLOT_HASHES_MAX]

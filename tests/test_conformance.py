@@ -345,3 +345,84 @@ def test_conformance(vec):
     for key, want in post.items():
         assert db.lamports("blk", key) == want, \
             f'{vec["name"]}: {key.hex()[:8]} balance'
+
+
+# ---------------------------------------------------------------------------
+# r5: machine-importable fixture corpus (solfuzz shape)
+# tests/vectors/conformance/*.json, regenerated by
+# tests/gen_conformance_vectors.py — pre-state txn-context -> expected
+# effects, statuses/balances hand-derived from the cited reference
+# semantics.
+# ---------------------------------------------------------------------------
+
+import json as _json
+import os as _os
+
+_FIX_DIR = _os.path.join(_os.path.dirname(__file__), "vectors",
+                         "conformance")
+
+
+def _load_fixtures():
+    out = []
+    if not _os.path.isdir(_FIX_DIR):
+        return out
+    for fn in sorted(_os.listdir(_FIX_DIR)):
+        if fn.endswith(".json"):
+            with open(_os.path.join(_FIX_DIR, fn)) as f:
+                out.extend(_json.load(f))
+    return out
+
+
+_FIXTURES = _load_fixtures()
+
+
+def test_fixture_corpus_size():
+    # VERDICT r4 item 6 gate: >= 200 vectors incl. every implemented
+    # program family (fixtures + the hand table above)
+    assert len(_FIXTURES) + len(VECTORS) >= 200
+    assert len(_FIXTURES) >= 150
+
+
+@pytest.mark.parametrize(
+    "fx", _FIXTURES, ids=[f["name"] for f in _FIXTURES])
+def test_fixture(fx):
+    ctx = fx["context"]
+    funk = Funk()
+    db = AccDb(funk)
+    for spec in ctx["accounts"]:
+        funk.rec_write(None, bytes.fromhex(spec["address"]), Account(
+            lamports=spec["lamports"],
+            data=bytearray(bytes.fromhex(spec["data"])),
+            owner=bytes.fromhex(spec["owner"]),
+            executable=spec.get("executable", False)))
+    funk.txn_prepare(None, "blk")
+    ex = TxnExecutor(db, enforce_rent=ctx.get("enforce_rent", True))
+    ex.epoch = ctx.get("epoch", 0)
+    ex.slot = ctx.get("slot", 0)
+
+    tx = ctx["tx"]
+    signers = [bytes.fromhex(s) for s in tx["signers"]]
+    extra = [bytes.fromhex(e) for e in tx["extra"]]
+    msg = build_message(
+        signers, extra, b"\x11" * 32,
+        [(i["program_index"], bytes(i["accounts"]),
+          bytes.fromhex(i["data"])) for i in tx["instructions"]],
+        n_ro_signed=tx.get("n_ro_signed", 0),
+        n_ro_unsigned=tx.get("n_ro_unsigned", 0))
+    r = ex.execute("blk", build_txn([bytes(64)] * len(signers), msg))
+
+    eff = fx["effects"]
+    assert r.status == eff["status"], \
+        f'{fx["name"]}: {r.status} != {eff["status"]} ({r.logs})'
+    assert r.fee == eff["fee"], fx["name"]
+    for want in eff["accounts"]:
+        addr = bytes.fromhex(want["address"])
+        a = db.peek("blk", addr)
+        got_l = a.lamports if a is not None else 0
+        assert got_l == want["lamports"], \
+            f'{fx["name"]}: {addr[:4].hex()} lamports {got_l} != ' \
+            f'{want["lamports"]}'
+        if "data" in want:
+            got_d = bytes(a.data) if a is not None else b""
+            assert got_d == bytes.fromhex(want["data"]), \
+                f'{fx["name"]}: {addr[:4].hex()} data mismatch'

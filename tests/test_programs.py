@@ -289,8 +289,10 @@ def test_nonce_lifecycle(env):
         _parse_nonce,
     )
     funk, db, ex = env
-    funk.rec_write("blk", k(4), Account(lamports=20_000,
-                                        data=bytes(NONCE_STATE_SZ)))
+    from firedancer_tpu.svm.sysvars import rent_exempt_minimum
+    funk.rec_write("blk", k(4), Account(
+        lamports=rent_exempt_minimum(NONCE_STATE_SZ) + 20_000,
+        data=bytes(NONCE_STATE_SZ)))
     ex.slot = 9
     # init with k(1) as authority (account pre-allocated: the guard)
     r = ex.execute("blk", make_txn(
