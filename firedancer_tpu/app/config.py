@@ -31,13 +31,45 @@ Schema:
     outs = ["verify_dedup"]
     batch = 32               # every other key = tile arg, verbatim
 
+    [tile.supervise]         # per-tile restart policy (supervise.py)
+    policy = "restart"       # "fail_fast" (default) | "restart"
+    backoff_s = 0.05         # first respawn delay (doubles, capped
+    backoff_max_s = 1.0      #  at backoff_max_s)
+    max_restarts = 3         # within window_s -> circuit breaker
+    window_s = 30.0
+    wedge_timeout_s = 2.0    # heartbeat/fseq-progress staleness
+                             #  deadline (omit to disable watchdog)
+
+    [topology.supervise]     # optional topology-wide defaults,
+    policy = "restart"       #  deep-merged under each tile's table
+
+    [[tile.chaos.events]]    # seeded fault plan (utils/chaos.py):
+    action = "crash"         #  crash | freeze_hb | wedge | stall_fseq
+    at_rx = 24               #  | fail_dispatch (verify tile); fire at
+                             #  stem iteration (at_iter) or cumulative
+                             #  frags consumed (at_rx); [lo, hi] picks
+                             #  seeded-uniform from tile.chaos.seed
+
 Unknown top-level sections are rejected (typo safety — the reference
-validates its config the same way, fd_config_validate).
+validates its config the same way, fd_config_validate); a bad
+supervise table fails topology build before launch.
 """
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:          # py<3.11
+    try:
+        import tomli as tomllib
+    except ModuleNotFoundError:
+        try:                         # last resort: setuptools' vendored
+            from setuptools._vendor import tomli as tomllib
+        except ModuleNotFoundError as e:
+            raise ModuleNotFoundError(
+                "no TOML parser available on this Python (<3.11): "
+                "install 'tomli'") from e
 
 _TOP_SECTIONS = {"topology", "link", "tcache", "tile"}
 
@@ -106,11 +138,17 @@ def build_topology(cfg: dict, name: str | None = None):
                   mtu=int(ln.get("mtu", 1280)))
     for tc in cfg.get("tcache", []):
         topo.tcache(tc["name"], depth=int(tc.get("depth", 4096)))
+    default_sup = top.get("supervise")
     for t in cfg.get("tile", []):
         if "kind" not in t:
             raise ValueError(f"[[tile]] {t.get('name')!r}: missing 'kind'")
         args = {k: v for k, v in t.items()
                 if k not in ("name", "kind", "ins", "outs")}
+        if default_sup:
+            # topology-wide supervision defaults; the tile's own table
+            # wins per key (validated by topo.build via supervise.py)
+            args["supervise"] = _deep_merge(default_sup,
+                                            args.get("supervise", {}))
         topo.tile(t["name"], t["kind"], ins=t.get("ins", ()),
                   outs=t.get("outs", ()), **args)
     return topo

@@ -4,9 +4,11 @@
         [--duration S] [--name N]
 
 The fdctl-run analog (ref: src/app/shared/commands/run/run.c): load the
-config stack, materialize the topology, spawn every tile, supervise
-fail-fast, print the monitor table periodically, tear down on SIGINT or
-after --duration seconds.
+config stack, materialize the topology, spawn every tile, run the
+policy-driven supervisor (fail-fast by default; per-tile restart +
+wedge watchdog via [tile.supervise], disco/supervise.py), print the
+monitor table periodically, tear down on SIGINT or after --duration
+seconds.
 """
 from __future__ import annotations
 
@@ -36,14 +38,22 @@ def main(argv=None) -> int:
     try:
         runner.wait_running()
         t0 = time.monotonic()   # duration clock starts once tiles RUN
+        next_print = 0.0
         while not args.duration \
                 or time.monotonic() - t0 < args.duration:
+            # supervision runs at a fast cadence (restart backoffs and
+            # the wedge watchdog need sub-second polls); the monitor
+            # table prints at the human --interval
             runner.check_failures()
-            # the runner already holds the plan + workspace; no need to
-            # re-attach through the plan JSON like an external monitor
-            print(format_table(snapshot(runner.plan, runner.wksp)),
-                  flush=True)
-            time.sleep(args.interval)
+            now = time.monotonic()
+            if now >= next_print:
+                # the runner already holds the plan + workspace; no
+                # need to re-attach through the plan JSON like an
+                # external monitor
+                print(format_table(snapshot(runner.plan, runner.wksp)),
+                      flush=True)
+                next_print = now + args.interval
+            time.sleep(0.05)
     except KeyboardInterrupt:
         pass
     finally:

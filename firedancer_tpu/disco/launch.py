@@ -1,4 +1,4 @@
-"""Process launcher + fail-fast supervisor.
+"""Process launcher + policy-driven supervisor.
 
 The reference launches one sandboxed process per tile and runs a
 supervisor that tears the whole validator down if ANY tile dies
@@ -11,6 +11,12 @@ Here tiles are spawned processes (fresh interpreters — the moral
 equivalent of clone: no inherited jax/backends state); the plan dict is
 the only shared contract. The runner writes the plan JSON next to the
 shm segment so an external monitor can attach by topology name.
+
+Supervision policy is per tile (disco/supervise.py): fail_fast keeps
+the reference's "one tile dies => everything dies" default; restart
+respawns the tile with backoff + circuit breaker and rejoins its ring
+cursors at the producers' current seq. The wedge watchdog catches
+live-but-stuck tiles by heartbeat staleness and consumer-fseq stall.
 """
 from __future__ import annotations
 
@@ -101,17 +107,32 @@ class TopologyRunner:
                               create=False)
         self.procs: dict[str, mp.process.BaseProcess] = {}
         self._mp = mp.get_context("spawn")
+        self._halted = False
+        from .supervise import Supervisor
+        self.supervisor = Supervisor(
+            plan, self.wksp, procs=lambda: self.procs,
+            spawn=self._spawn, halt_all=self._halt_for_supervisor)
         with open(plan_path(plan["topology"]), "w") as f:
             json.dump(plan, f)
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _spawn(self, tn: str, rejoin: bool = False):
+        plan = self.plan
+        if rejoin:
+            # a deep copy only the child sees: the respawned consumer
+            # joins its in rings at the producers' CURRENT seq
+            plan = json.loads(json.dumps(self.plan))
+            plan["tiles"][tn]["rejoin_at_tail"] = True
+        p = self._mp.Process(target=tile_main, args=(plan, tn),
+                             name=f"tile:{tn}", daemon=True)
+        p.start()
+        self.procs[tn] = p
+        return p
+
     def start(self, tiles=None):
         for tn in (tiles or self.plan["tiles"]):
-            p = self._mp.Process(target=tile_main, args=(self.plan, tn),
-                                 name=f"tile:{tn}", daemon=True)
-            p.start()
-            self.procs[tn] = p
+            self._spawn(tn)
         return self
 
     def _cnc(self, tn: str) -> Cnc:
@@ -121,7 +142,7 @@ class TopologyRunner:
         """Block until every launched tile reaches RUN (compile warmup
         for device tiles can dominate; hence the generous default)."""
         t0 = time.time()
-        for tn in self.procs:
+        for tn in list(self.procs):
             while self._cnc(tn).state != CNC_RUN:
                 self.check_failures()
                 if time.time() - t0 > timeout_s:
@@ -130,15 +151,21 @@ class TopologyRunner:
         return self
 
     def check_failures(self):
-        """Fail-fast: any dead tile process fails the whole topology
-        (ref: run.c:925 — pid-namespace teardown)."""
-        dead = [tn for tn, p in self.procs.items()
-                if not p.is_alive() and p.exitcode not in (0, None)
-                and self._cnc(tn).state != CNC_HALT]
-        if dead:
-            info = {tn: self.procs[tn].exitcode for tn in dead}
-            self.halt(join_timeout_s=10.0)
-            raise RuntimeError(f"tile process(es) died: {info}")
+        """One supervision pass: fail-fast tiles raise on abnormal death
+        (ref: run.c:925 — pid-namespace teardown, the default policy);
+        restart-policy tiles are respawned with backoff, wedged tiles
+        are killed by the watchdog, and an exhausted restart budget
+        raises CircuitOpen after a clean halt."""
+        if not self._halted:
+            self.supervisor.poll()
+
+    def supervise(self, duration_s: float, poll_s: float = 0.02):
+        """Run supervision passes for duration_s (test/driver aid)."""
+        deadline = time.time() + duration_s
+        while time.time() < deadline:
+            self.check_failures()
+            time.sleep(poll_s)
+        return self
 
     def heartbeats(self) -> dict[str, int]:
         """Ticks since each tile's last heartbeat."""
@@ -150,9 +177,17 @@ class TopologyRunner:
         vals = topo_mod.read_metrics(self.wksp, self.plan, tile_name)
         # the plan carries the slot-name ABI (reorder-proof; r2 W7)
         names = self.plan["tiles"][tile_name].get("metrics_names", [])
-        return {nm: int(vals[i]) for i, nm in enumerate(names)}
+        out = {nm: int(vals[i]) for i, nm in enumerate(names)}
+        # supervisor counters ride in the region's top slots
+        from .supervise import sup_counters
+        out.update(sup_counters(vals))
+        return out
+
+    def _halt_for_supervisor(self):
+        self.halt(join_timeout_s=10.0)
 
     def halt(self, join_timeout_s: float = 30.0):
+        self._halted = True
         for tn in self.procs:
             self._cnc(tn).state = CNC_HALT
         deadline = time.time() + join_timeout_s

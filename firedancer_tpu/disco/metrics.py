@@ -133,6 +133,13 @@ def render_prometheus(plan: dict, wksp) -> str:
                 else "fdtpu_tile_metric"
             lines.append(
                 f'{series}{{{lab},name="{_esc(nm)}"}} {int(vals[i])}')
+        # supervisor counters (restarts / watchdog trips / down gauge)
+        # live in the region's top slots — same region, fixed indices
+        from .supervise import SUP_GAUGES, sup_counters
+        for nm, val in sup_counters(vals).items():
+            series = "fdtpu_tile_gauge" if nm in SUP_GAUGES \
+                else "fdtpu_tile_metric"
+            lines.append(f'{series}{{{lab},name="{nm}"}} {val}')
         for kind, h in read_hists(wksp, plan, tn).items():
             base = f"fdtpu_poll_{kind}_seconds"
             cum = 0

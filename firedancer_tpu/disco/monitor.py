@@ -27,6 +27,7 @@ _STATE = {CNC_BOOT: "boot", CNC_RUN: "run", CNC_HALT: "halt",
 def snapshot(plan: dict, wksp: Workspace) -> dict:
     """{tile: {state, hb_age_ticks, metrics{...}, wait/work latency}}"""
     from .metrics import quantile_ns, read_hists
+    from .supervise import sup_counters
     out = {}
     now = topo_mod.now_ticks()
     for tn, spec in plan["tiles"].items():
@@ -40,7 +41,10 @@ def snapshot(plan: dict, wksp: Workspace) -> dict:
             "state": _STATE.get(cnc.state, f"?{cnc.state}"),
             # clamp: clock reads race across processes by a few ticks
             "hb_age_ticks": max(0, now - cnc.last_heartbeat),
-            "metrics": {nm: int(vals[i]) for i, nm in enumerate(names)},
+            "metrics": {
+                **{nm: int(vals[i]) for i, nm in enumerate(names)},
+                # supervisor counters from the region's top slots
+                **sup_counters(vals)},
             "latency": {
                 kind: {"count": h["count"],
                        "p50_us": quantile_ns(h, 0.50) / 1e3,

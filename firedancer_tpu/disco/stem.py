@@ -58,6 +58,33 @@ class Stem:
             list(ctx.spec.get("metrics_names", [])) or None
         # wait/work poll latency histograms (flushed at housekeeping)
         self._hists = {"wait": HistAccum(), "work": HistAccum()}
+        # chaos harness: a seeded fault plan injected purely via tile
+        # args (utils/chaos.py) — fires deterministically in run()
+        self._chaos = None
+        self._hb_frozen = False
+        self._wedged = False
+        self._stalled_links: set | None = None   # None = no stall
+        if args.get("chaos"):
+            from ..utils.chaos import ChaosPlan
+            self._chaos = ChaosPlan(args["chaos"])
+
+    def _apply_chaos(self, iters: int, rx: int):
+        from ..utils import log
+        for ev in self._chaos.poll(iters, rx):
+            act = ev["action"]
+            log.warning(f"chaos: firing {act} (iter={iters} rx={rx})")
+            if act == "crash":
+                import os
+                os._exit(ev["code"])
+            elif act == "freeze_hb":
+                self._hb_frozen = True
+            elif act == "wedge":
+                self._hb_frozen = True
+                self._wedged = True
+            elif act == "stall_fseq":
+                if self._stalled_links is None:
+                    self._stalled_links = set()
+                self._stalled_links.add(ev["link"])   # None = all links
 
     def _flush_metrics(self):
         items = getattr(self.tile, "metrics_items", None)
@@ -81,6 +108,10 @@ class Stem:
         if seqs is None:
             return
         for ln, fs in self.ctx.in_fseqs.items():
+            if self._stalled_links is not None and \
+                    (None in self._stalled_links
+                     or ln in self._stalled_links):
+                continue              # chaos: progress frozen
             if ln in seqs():
                 fs.update(seqs()[ln])
 
@@ -92,13 +123,22 @@ class Stem:
         # randomized housekeeping (fd_stem.c — avoid phase-locking tiles)
         next_hk = 0.0
         iters = 0
+        rx_total = 0
         try:
             while True:
                 now = time.perf_counter()
                 if now >= next_hk:
-                    cnc.heartbeat()
-                    if cnc.state == CNC_HALT:
+                    if not self._hb_frozen:
+                        cnc.heartbeat()
+                    st = cnc.state
+                    if st == CNC_HALT:
                         break
+                    if st == CNC_FAIL:
+                        # externally failed (wedge watchdog): exit NOW,
+                        # leaving the FAIL state visible — on_halt and
+                        # the HALT transition are for clean shutdowns
+                        self._flush_metrics()
+                        return
                     self._update_in_fseqs()
                     hk = getattr(self.tile, "housekeeping", None)
                     if hk is not None:
@@ -106,6 +146,12 @@ class Stem:
                     self._flush_metrics()
                     next_hk = now + self.hk_interval_s * (
                         0.7 + 0.6 * random.random())
+                if self._wedged:
+                    # chaos: a hung tile — no polling, no heartbeats,
+                    # still killable (and halt-able) by the supervisor
+                    time.sleep(0.005)
+                    iters += 1
+                    continue
                 t0 = time.perf_counter_ns()
                 n = self.tile.poll_once()
                 # wait/work latency attribution: an idle poll is time
@@ -116,6 +162,9 @@ class Stem:
                 if not n:
                     time.sleep(self.idle_sleep_s)
                 iters += 1
+                rx_total += n
+                if self._chaos is not None:
+                    self._apply_chaos(iters, rx_total)
                 if max_iters is not None and iters >= max_iters:
                     break
         except Exception as e:

@@ -138,10 +138,13 @@ class SynthAdapter:
 @register("verify")
 class VerifyAdapter:
     """TPU sigverify bridge tile (ref: src/disco/verify/fd_verify_tile.h).
-    args: batch, max_len, tcache (name)."""
+    args: batch, max_len, tcache (name), device_retries,
+    device_timeout_s, device_fail_limit, chaos (fault plan)."""
 
     METRICS = ["rx", "parse_fail", "dedup_drop", "verify_fail", "tx",
-               "overruns", "batches", "backpressure"]
+               "overruns", "batches", "backpressure", "device_errors",
+               "cpu_fallback"]
+    GAUGES = ["cpu_fallback"]
 
     def __init__(self, ctx, args):
         _setup_jax()
@@ -154,6 +157,9 @@ class VerifyAdapter:
             else _single(ctx.tcaches, "tcache", ctx.tile_name)
         seed = bytes.fromhex(ctx.plan["seed"]) if "seed" in ctx.plan \
             else None
+        kw = {}
+        if "device_timeout_s" in args:
+            kw["device_timeout_s"] = float(args["device_timeout_s"])
         self.tile = VerifyTile(
             in_ring, out_ring, tc,
             batch=int(args.get("batch", 256)),
@@ -162,9 +168,13 @@ class VerifyAdapter:
             dedup_seed=seed,
             rr_cnt=int(args.get("rr_cnt", 1)),
             rr_idx=int(args.get("rr_idx", 0)),
-            devices=int(args.get("devices", 1)))
+            devices=int(args.get("devices", 1)),
+            device_retries=int(args.get("device_retries", 2)),
+            device_fail_limit=int(args.get("device_fail_limit", 3)),
+            chaos=args.get("chaos"), **kw)
         self.tile._cnc = ctx.cnc
         self.in_link = next(iter(ctx.in_rings))
+        self.tile.seq = ctx.in_seq0.get(self.in_link, 0)
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
@@ -197,7 +207,7 @@ class DedupAdapter:
             else _single(ctx.tcaches, "tcache", ctx.tile_name)
         self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
         self.out_fseqs = _single(ctx.out_fseqs, "out link", ctx.tile_name)
-        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.seqs = ctx.in_seqs0()
         self.mtu = max(ctx.plan["links"][ln]["mtu"] for ln in ctx.in_rings)
         self.m = {k: 0 for k in self.METRICS}
 
@@ -273,7 +283,7 @@ class PackAdapter:
         self.slot_ms = float(args.get("slot_ms", 400.0))
         self._slot_t0 = time.monotonic()
         self.batch = int(args.get("batch", 64))
-        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.seqs = ctx.in_seqs0()
         self.in_mtu = ctx.plan["links"][self.txn_in]["mtu"]
         self.busy = [None] * n_banks      # outstanding microblock id
         self._next_mb = 0
@@ -516,7 +526,7 @@ class BankAdapter:
                 from ..rpc.ws import WsServer
                 self.ws = WsServer(port=int(args["ws_port"]))
                 self.m["ws_port"] = self.ws.port
-        self.seq = 0
+        self.seq = ctx.in_seq0.get(self.in_link, 0)
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
 
     def _parse_payloads(self, frame, txn_cnt):
@@ -652,12 +662,22 @@ class BankAdapter:
                     ep = self.slot // self.slots_per_epoch
                     if ep > 0:
                         from ..flamenco import rewards as _rw
+                        from ..flamenco import stakes as _stakes
                         start = self._rewards_epoch
                         if start is None:
                             start = _rw.paid_through(self.funk, new_xid)
                         if ep > start:
                             import hashlib as _h
                             for e in range(start, ep):
+                                # epoch-boundary duty BEFORE rewards:
+                                # append epoch e's cluster totals to the
+                                # StakeHistory sysvar so rate-limited
+                                # warmup/cooldown engages from the
+                                # bank's own state, no external seeding
+                                # (ref: fd_sysvar_stake_history.c
+                                # update at the boundary)
+                                _stakes.update_stake_history(
+                                    self.funk, new_xid, e)
                                 s = _rw.distribute_epoch_rewards(
                                     self.funk, new_xid, e, None,
                                     self.slots_per_epoch,
@@ -868,7 +888,7 @@ class PohAdapter:
                                      ctx.tile_name)
             self.entry_fseqs = _single(ctx.out_fseqs, "out link",
                                        ctx.tile_name)
-        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.seqs = ctx.in_seqs0()
         self.mtu = max((ctx.plan["links"][ln]["mtu"]
                         for ln in ctx.in_rings), default=64)
         # entry frames re-wrap the bank frame's txn section (bank hdr
@@ -1065,7 +1085,7 @@ class ShredAdapter:
             self._handlers = {ln: handle_factory(ln)
                               for ln in self.in_links}
             self._handle = None
-        self.seqs = {ln: 0 for ln in self.in_links}
+        self.seqs = {ln: ctx.in_seq0.get(ln, 0) for ln in self.in_links}
         self.mtus = {ln: ctx.plan["links"][ln]["mtu"]
                      for ln in self.in_links}
 
@@ -1169,7 +1189,7 @@ class TowerAdapter:
         # fan-in: replay blocks + gossip/driver votes arrive on
         # separate links (the reference's tower tile polls several
         # producers the same way)
-        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.seqs = ctx.in_seqs0()
         self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
         self.out_fseqs = _single(ctx.out_fseqs, "out link",
                                  ctx.tile_name)
@@ -1272,7 +1292,7 @@ class RepairAdapter:
             root_slot=(int(args["root_slot"])
                        if "root_slot" in args else None),
             out_ring=out_ring, out_fseqs=out_fseqs)
-        self.seq = 0
+        self.seq = ctx.in_seq0.get(self.in_link, 0)
         self._ovr = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
 
@@ -1337,7 +1357,7 @@ class ReplayAdapter:
             hashes_per_tick=int(args.get("hashes_per_tick", 16)),
             verify_poh=bool(args.get("verify_poh", True)),
             slots_per_epoch=int(args.get("slots_per_epoch", 432_000)))
-        self.seq = 0
+        self.seq = ctx.in_seq0.get(self.in_link, 0)
         self._ovr = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
 
@@ -1384,7 +1404,7 @@ class SendAdapter:
             bytes.fromhex(args["vote_account_hex"]), kg,
             (host, int(port)),
             socket.socket(socket.AF_INET, socket.SOCK_DGRAM))
-        self.seq = 0
+        self.seq = ctx.in_seq0.get(self.in_link, 0)
         self.m_extra = {"overruns": 0}
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
 
@@ -1797,7 +1817,7 @@ class PluginAdapter:
         self.srv.listen(8)
         self.srv.setblocking(False)
         self.clients: list = []
-        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.seqs = ctx.in_seqs0()
         self.mtus = {ln: ctx.plan["links"][ln]["mtu"]
                      for ln in ctx.in_rings}
         self.m = {k: 0 for k in self.METRICS}
@@ -1938,7 +1958,7 @@ class VinylAdapter:
         self.out_mtu = ctx.plan["links"][out_link]["mtu"]
         self.db = Vinyl(args["path"])
         self.gc = bool(args.get("gc", True))
-        self.seq = 0
+        self.seq = ctx.in_seq0.get(self.in_link, 0)
         self.m = {k: 0 for k in self.METRICS}
 
     def poll_once(self) -> int:
@@ -2204,8 +2224,8 @@ class CswtchAdapter:
             prev = self._last.get(tn, i)
             if i - prev > 1000:
                 from ..utils import log
-                log.warn(f"cswtch: tile {tn} took {i - prev} "
-                         f"involuntary switches since last sample")
+                log.warning(f"cswtch: tile {tn} took {i - prev} "
+                            f"involuntary switches since last sample")
             self._last[tn] = i
         self.m.update(vol=vol, invol=invol, tiles_sampled=n,
                       max_invol=worst)
@@ -2365,7 +2385,7 @@ class SinkAdapter:
     def __init__(self, ctx, args):
         self.ctx = ctx
         self.batch = int(args.get("batch", 64))
-        self.seqs = {ln: 0 for ln in ctx.in_rings}
+        self.seqs = ctx.in_seqs0()
         self.mtu = max(ctx.plan["links"][ln]["mtu"] for ln in ctx.in_rings)
         self.m = {k: 0 for k in self.METRICS}
 

@@ -149,6 +149,7 @@ class Topology:
                 tc = Tcache(w, depth=depth)
                 plan["tcaches"][name] = {"off": tc.off, "depth": depth}
             from .metrics import HIST_REGION_U64
+            from .supervise import SUP_SLOT_MIN, normalize_policy
             for tn, t in self.tiles.items():
                 for i in t.ins:
                     if i["reliable"]:
@@ -159,17 +160,27 @@ class Topology:
                 w.view(metrics_off, METRICS_SLOTS * 8)[:] = 0
                 hist_off = w.alloc(HIST_REGION_U64 * 8)
                 w.view(hist_off, HIST_REGION_U64 * 8)[:] = 0
+                names = _metric_names(t.kind)
+                if len(names) > SUP_SLOT_MIN:
+                    raise ValueError(
+                        f"tile kind {t.kind}: {len(names)} metric slots "
+                        f"collide with supervisor slots (max "
+                        f"{SUP_SLOT_MIN})")
                 plan["tiles"][tn] = {
                     "kind": t.kind,
                     "ins": list(t.ins),
                     "outs": list(t.outs),
                     "args": dict(t.args),
+                    # per-tile restart/watchdog policy, validated at
+                    # build so a config typo fails before launch
+                    "supervise": normalize_policy(
+                        t.args.get("supervise")),
                     "cnc_off": cnc.off,
                     "metrics_off": metrics_off,
                     "hist_off": hist_off,
                     # explicit slot-name ABI: readers match by these names,
                     # never by adapter class declaration order (r2 W7)
-                    "metrics_names": _metric_names(t.kind),
+                    "metrics_names": names,
                     "metrics_gauges": _metric_gauges(t.kind),
                 }
                 if t.kind == "sign":
@@ -217,6 +228,20 @@ class TileCtx:
             if i["reliable"] and key in plan["fseqs"]:
                 self.in_fseqs[ln] = Fseq(self.wksp, off=plan["fseqs"][key])
 
+        # ring rejoin: a RESTARTED consumer attaches at each producer's
+        # current mcache seq instead of replaying from 0 (the supervisor
+        # sets rejoin_at_tail on the respawn plan; frags published while
+        # the tile was down are skipped — the documented loss contract).
+        # Publishing the fseq here also clears the STALE sentinel so the
+        # producer's credit flow re-includes this consumer immediately.
+        rejoin = bool(self.spec.get("rejoin_at_tail"))
+        self.in_seq0 = {}
+        for ln, r in self.in_rings.items():
+            self.in_seq0[ln] = int(r.seq) if rejoin else 0
+            fs = self.in_fseqs.get(ln)
+            if fs is not None and rejoin:
+                fs.update(self.in_seq0[ln])
+
         self.out_rings = {}
         self.out_fseqs = {}
         for ln in self.spec["outs"]:
@@ -231,6 +256,11 @@ class TileCtx:
             name: Tcache(self.wksp, depth=tc["depth"], off=tc["off"])
             for name, tc in plan["tcaches"].items()
         }
+
+    def in_seqs0(self) -> dict[str, int]:
+        """Initial consume cursor per in link: 0 on a fresh boot, the
+        producer's current seq on a supervised restart (ring rejoin)."""
+        return dict(self.in_seq0)
 
     def metrics_view(self):
         import numpy as np

@@ -352,6 +352,11 @@ int64_t fdtpu_fctl_credits(void *base, uint64_t ring_off,
   int64_t credits = (int64_t)h->depth;
   for (int i = 0; i < n_fseq; i++) {
     uint64_t cseq = fdtpu_fseq_query(base, fseq_offs[i]);
+    /* UINT64_MAX is the STALE sentinel: a dead/restarting consumer's
+     * fseq (marked by the supervisor) is excluded from credit flow so
+     * a crashed reliable consumer cannot wedge its producer; the
+     * restarted tile re-includes itself by publishing a real seq. */
+    if (cseq == UINT64_MAX) continue;
     int64_t c = (int64_t)h->depth - (int64_t)(seq - cseq);
     if (c < credits) credits = c;
   }

@@ -7,5 +7,5 @@ the TPU bridge; hot paths (publish, gather) stay in C++.
 """
 from .tango import (  # noqa: F401
     Workspace, Ring, Fseq, Cnc, Tcache, lib, CNC_BOOT, CNC_RUN, CNC_HALT,
-    CNC_FAIL,
+    CNC_FAIL, FSEQ_STALE,
 )

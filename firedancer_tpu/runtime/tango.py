@@ -263,6 +263,9 @@ class Ring:
                                       len(fseqs))
 
 
+FSEQ_STALE = (1 << 64) - 1    # sentinel: consumer excluded from fctl
+
+
 class Fseq:
     def __init__(self, wksp: Workspace, off: int | None = None,
                  seq0: int = 0):
@@ -277,6 +280,16 @@ class Fseq:
 
     def update(self, seq: int):
         lib.fdtpu_fseq_update(self.wksp.base, self.off, seq)
+
+    def mark_stale(self):
+        """Exclude this consumer from upstream credit flow (dead or
+        restarting tile — the native fctl skips the sentinel, so the
+        producer never wedges on a consumer that stopped advancing).
+        Cleared by the next real update()."""
+        lib.fdtpu_fseq_update(self.wksp.base, self.off, FSEQ_STALE)
+
+    def is_stale(self) -> bool:
+        return self.query() == FSEQ_STALE
 
 
 class Cnc:

@@ -314,11 +314,17 @@ def exec_stake(ic) -> str:
             eff, act, _ = stake_activating_and_deactivating(
                 st, epoch, hist)
             staked = eff + act
-            locked = (staked + st.rent_reserve) if staked else 0
-        elif st.fully_inactive(epoch):
-            locked = 0                        # may drain + close
         else:
-            locked = st.amount + st.rent_reserve
+            staked = 0 if st.fully_inactive(epoch) else st.amount
+        if staked:
+            locked = staked + st.rent_reserve
+        elif lamports == acct.lamports:
+            locked = 0            # full drain closes the account
+        else:
+            # Agave withdraw: a NON-closing withdraw must keep the
+            # rent-exempt reserve funded even with nothing staked
+            # (lamports + reserve <= balance)
+            locked = st.rent_reserve
         if lamports > acct.lamports - locked:
             return ERR_INSUFFICIENT
         acct.lamports -= lamports

@@ -1,0 +1,114 @@
+"""Chaos harness: seeded, deterministic fault plans for tile topologies.
+
+The reference validates its supervision story with fault drills (kill a
+tile, watch the pid-namespace supervisor tear down or the operator
+restart it); here the drill is a first-class, config-injected artifact
+so recovery invariants are TESTABLE: a fault plan is plain data in a
+tile's args (`chaos = {...}`), flows through the topology plan like any
+other arg, and fires deterministically inside the tile process.
+
+Plan schema (JSON-able; everything optional except `events`):
+
+    {"seed": 7,                   # derives any randomized trigger points
+     "events": [
+       {"action": "crash",       "at_iter": 500},        # os._exit
+       {"action": "crash",       "at_rx": 8, "code": 9}, # after 8 frags
+       {"action": "freeze_hb",   "at_iter": [100, 200]}, # seeded range
+       {"action": "wedge",       "at_rx": 4},            # stop polling
+       {"action": "stall_fseq",  "at_rx": 4, "link": "a_b"},
+       {"action": "fail_dispatch", "count": 3},          # verify tile
+       {"action": "fail_dispatch", "count": -1},         # persistent
+     ]}
+
+Triggers: `at_iter` counts stem loop iterations, `at_rx` counts frags
+consumed (deterministic relative to traffic). A two-element list is a
+seeded-uniform pick in [lo, hi] — same seed, same plan, same firing
+point. Each event fires at most once.
+
+Actions understood by the stem (disco/stem.py):
+
+  crash       exit the process immediately (simulated tile death)
+  freeze_hb   stop heartbeating (live-but-wedged; the watchdog's case)
+  wedge       freeze_hb AND stop polling (a hung tile that still
+              responds to nothing but SIGTERM)
+  stall_fseq  stop publishing consumer progress for `link` (or every
+              in link when omitted) — upstream credit flow stalls
+
+Action understood by the verify tile (tiles/verify.py):
+
+  fail_dispatch  fail the next `count` device dispatches (count=-1:
+                 every dispatch — the persistent-TPU-loss drill)
+"""
+from __future__ import annotations
+
+import random
+
+STEM_ACTIONS = ("crash", "freeze_hb", "wedge", "stall_fseq")
+ACTIONS = STEM_ACTIONS + ("fail_dispatch",)
+
+
+class ChaosPlan:
+    """Parsed fault plan. One instance per tile process; `poll` is
+    called from the stem loop, `take_dispatch_failure` from the verify
+    tile's device-dispatch wrapper."""
+
+    def __init__(self, spec: dict):
+        if not isinstance(spec, dict):
+            raise ValueError(f"chaos spec must be a dict, got {spec!r}")
+        rng = random.Random(int(spec.get("seed", 0)))
+        self.events: list[dict] = []
+        self._dispatch_failures = 0        # -1 = unbounded
+        for ev in spec.get("events", []):
+            act = ev.get("action")
+            if act not in ACTIONS:
+                raise ValueError(f"unknown chaos action {act!r}")
+            if act == "fail_dispatch":
+                cnt = int(ev.get("count", 1))
+                if cnt < 0 or self._dispatch_failures < 0:
+                    self._dispatch_failures = -1
+                else:
+                    self._dispatch_failures += cnt
+                continue
+            parsed = {"action": act, "fired": False,
+                      "link": ev.get("link"),
+                      "code": int(ev.get("code", 70))}
+            for key in ("at_iter", "at_rx"):
+                if key in ev:
+                    v = ev[key]
+                    if isinstance(v, (list, tuple)):
+                        lo, hi = int(v[0]), int(v[1])
+                        parsed[key] = rng.randint(lo, hi)
+                    else:
+                        parsed[key] = int(v)
+            if "at_iter" not in parsed and "at_rx" not in parsed:
+                parsed["at_iter"] = 0          # fire immediately
+            self.events.append(parsed)
+
+    def poll(self, iters: int, rx: int) -> list[dict]:
+        """Events due at (iteration count, cumulative frags consumed);
+        each is returned exactly once."""
+        due = []
+        for ev in self.events:
+            if ev["fired"]:
+                continue
+            hit = ("at_iter" in ev and iters >= ev["at_iter"]) or \
+                  ("at_rx" in ev and rx >= ev["at_rx"])
+            if hit:
+                ev["fired"] = True
+                due.append(ev)
+        return due
+
+    def take_dispatch_failure(self) -> bool:
+        """True if the next device dispatch should fail (consumes one
+        budgeted failure; unbounded when the plan says count=-1)."""
+        if self._dispatch_failures < 0:
+            return True
+        if self._dispatch_failures > 0:
+            self._dispatch_failures -= 1
+            return True
+        return False
+
+
+class ChaosDeviceError(RuntimeError):
+    """Injected device-dispatch failure (distinguishable in logs from a
+    real device error, handled identically by the fallback path)."""

@@ -39,6 +39,9 @@ def test_two_verify_tiles_round_robin_one_link():
     try:
         runner.wait_running(timeout_s=540)
         runner.wait_idle("sink", "rx", N, timeout_s=540)
+        # shm metrics flush one housekeeping interval behind the frag
+        # flow — poll the upstream counters, don't snapshot
+        runner.wait_idle("dedup", "rx", N, timeout_s=60)
         v0, v1 = runner.metrics("v0"), runner.metrics("v1")
         # disjoint ownership: each tile verified its share, no overlap
         assert v0["tx"] + v1["tx"] == N
@@ -86,6 +89,9 @@ def test_verify_tile_shard_map_multidevice():
             got += tile.poll_once()
             if got >= 13:
                 break
+        # r5 async pipelining: verdicts publish at drain, not inside
+        # poll_once — retire every in-flight batch before asserting
+        tile.flush()
         assert tile.metrics["tx"] == 12
         # the corrupted copy fails verify (same first-sig tag would have
         # been dedup-dropped only AFTER verify; corruption hits earlier)

@@ -17,6 +17,14 @@ def k(n):
     return bytes([n]) * 32
 
 
+# transfers creating an account must leave it rent-exempt (the Agave
+# check_rent_state discipline the executor enforces): 0-data minimum is
+# 128 * 3480 * 2 = 890_880 lamports
+EXEMPT = 890_880
+A0, A1 = EXEMPT + 300, EXEMPT + 250
+FUNDING = 10_000_000
+
+
 def transfer_txn(src, dst, lamports):
     data = struct.pack("<IQ", SYS_TRANSFER, lamports)
     msg = build_message([src], [dst, SYSTEM_PROGRAM_ID], b"\x11" * 32,
@@ -27,7 +35,7 @@ def transfer_txn(src, dst, lamports):
 def _run_ledger(amounts, fp):
     """Execute one block of transfers under capture; capture -> fp."""
     funk = Funk()
-    funk.rec_write(None, k(1), Account(lamports=1_000_000))
+    funk.rec_write(None, k(1), Account(lamports=FUNDING))
     funk.txn_prepare(None, "blk")
     w = CapWriter(fp)
     cex = CapturingExecutor(TxnExecutor(AccDb(funk)), w)
@@ -41,7 +49,7 @@ def _run_ledger(amounts, fp):
 
 def test_capture_roundtrip_and_contents():
     fp = io.BytesIO()
-    res = _run_ledger([300, 250], fp)
+    res = _run_ledger([A0, A1], fp)
     assert all(r.status == OK for r in res)
     fp.seek(0)
     recs = list(read_records(fp))
@@ -51,16 +59,16 @@ def test_capture_roundtrip_and_contents():
     assert t0["status"] == OK and t0["index"] == 0
     # pre/post for payer, dest, and the program account
     assert t0["pre"][k(2)] is None            # dest did not exist yet
-    assert t0["post"][k(2)]["lamports"] == 300
-    assert t0["pre"][k(1)]["lamports"] == 1_000_000
+    assert t0["post"][k(2)]["lamports"] == A0
+    assert t0["pre"][k(1)]["lamports"] == FUNDING
     delta = t0["pre"][k(1)]["lamports"] - t0["post"][k(1)]["lamports"]
-    assert delta == 300 + t0["fee"]
+    assert delta == A0 + t0["fee"]
 
 
 def test_identical_ledgers_diff_clean():
     fa, fb = io.BytesIO(), io.BytesIO()
-    _run_ledger([300, 250], fa)
-    _run_ledger([300, 250], fb)
+    _run_ledger([A0, A1], fa)
+    _run_ledger([A0, A1], fb)
     fa.seek(0), fb.seek(0)
     assert diff(fa, fb) is None
 
@@ -69,8 +77,8 @@ def test_divergent_execution_pinpointed():
     """One lamport of divergence in txn 1 must be reported at the
     account level for txn index 1 — the fd_solcap_diff workflow."""
     fa, fb = io.BytesIO(), io.BytesIO()
-    _run_ledger([300, 250], fa)
-    _run_ledger([300, 251], fb)
+    _run_ledger([A0, A1], fa)
+    _run_ledger([A0, A1 + 1], fb)
     fa.seek(0), fb.seek(0)
     d = diff(fa, fb)
     assert d is not None and d["slot"] == 7
@@ -130,7 +138,8 @@ def test_v0_alut_txn_captures_looked_up_accounts():
     cex = CapturingExecutor(ex, w)
     w.slot(11, bytes(32))
     t = vtxn([SYSTEM_PROGRAM_ID],
-             [(1, bytes([0, 2]), struct.pack("<IQ", SYS_TRANSFER, 999))],
+             [(1, bytes([0, 2]),
+               struct.pack("<IQ", SYS_TRANSFER, EXEMPT + 999))],
              n_ro_unsigned=1, version=0, aluts=[(table, bytes([0]), b"")])
     assert cex.execute("blk", t).status == OK
     w.bank(bytes(32))
@@ -138,7 +147,7 @@ def test_v0_alut_txn_captures_looked_up_accounts():
     fp.seek(0)
     trec = [v for kd, v in read_records(fp) if kd == "txn"][0]
     assert trec["pre"][looked_up] is None
-    assert trec["post"][looked_up]["lamports"] == 999
+    assert trec["post"][looked_up]["lamports"] == EXEMPT + 999
 
 
 def test_pre_state_divergence_reported_at_first_txn():
