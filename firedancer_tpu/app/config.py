@@ -126,6 +126,27 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
     return cfg
 
 
+def _validate_tile_keys(t: dict):
+    """Reject unknown [[tile]] keys with a did-you-mean hint. The key
+    registry is shared with fdlint (lint/registry.py) — the linter's
+    dangling-ref checks and this schema gate stay in sync by
+    construction. A typo'd arg key used to pass through silently as a
+    tile arg the adapter never reads."""
+    from ..lint import registry as reg
+    kind = t["kind"]
+    known = reg.known_keys(kind)
+    if not known:
+        raise ValueError(
+            f"[[tile]] {t.get('name')!r}: unknown kind {kind!r}"
+            + reg.suggest(str(kind), reg.TILE_ARGS))
+    bad = set(t) - known
+    if bad:
+        key = sorted(bad)[0]
+        raise ValueError(
+            f"[[tile]] {t.get('name')!r} (kind {kind!r}): unknown "
+            f"key(s) {sorted(bad)}" + reg.suggest(key, known))
+
+
 def build_topology(cfg: dict, name: str | None = None):
     """Merged config -> Topology (unbuilt; caller runs .build())."""
     from ..disco import Topology
@@ -142,6 +163,7 @@ def build_topology(cfg: dict, name: str | None = None):
     for t in cfg.get("tile", []):
         if "kind" not in t:
             raise ValueError(f"[[tile]] {t.get('name')!r}: missing 'kind'")
+        _validate_tile_keys(t)
         args = {k: v for k, v in t.items()
                 if k not in ("name", "kind", "ins", "outs")}
         if default_sup:

@@ -2037,6 +2037,13 @@ class VinylAdapter:
         if self.gc:
             self.db.maybe_compact()
 
+    def in_seqs(self):
+        # publish consumer progress: without this a RELIABLE rq
+        # producer (an in-topo client tile, unlike the external-link
+        # test clients) wedges once the ring fills against a frozen
+        # fseq (found by fdlint's silent-consumer rule)
+        return {self.in_link: self.seq}
+
     def on_halt(self):
         self.db.close()
 

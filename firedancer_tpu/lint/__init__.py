@@ -1,0 +1,22 @@
+"""fdlint: static topology, tile-contract, and JAX/Pallas purity lint.
+
+Three analyzer families over one finding/suppression/reporting core
+(lint/core.py):
+
+  graph.py      topology graph analysis — cfg/*.toml (and programmatic
+                `Topology` builds via `lint_topology`) checked for dead
+                links, credit-flow hazards, backpressure cycles, and
+                supervise/chaos schema errors before anything runs
+  contracts.py  tile-contract analysis — AST over tile classes:
+                metric-slot collisions with the supervisor's reserved
+                top slots, tango protocol order (credit-gated publish,
+                mark_stale only from supervision), consumer-progress
+                contracts
+  jaxlint.py    JAX/Pallas purity — host-sync hazards inside jitted
+                code, x64 dtypes reaching kernels, PRNG key reuse,
+                jit entry points without donation
+
+CLI: `python -m firedancer_tpu.lint [paths...]` (tools/fdlint wraps it);
+exits nonzero on any non-baselined error finding.
+"""
+from .core import Finding, RULES  # noqa: F401

@@ -1,0 +1,118 @@
+"""fdlint CLI: `python -m firedancer_tpu.lint [paths...]`.
+
+File routing (mirrors the analyzer scopes):
+
+    *.toml                         -> graph analysis (app/config.py load,
+                                      `# fdlint: layers=` honored)
+    **/tiles/*.py, **/disco/tiles.py -> tile-contract analysis
+    **/ops/*.py,  **/tiles/*.py      -> JAX/Pallas purity analysis
+
+Exit status: nonzero iff any non-baselined ERROR finding remains
+(warnings report but never gate). `--format json` is stable for
+machine consumption (schema-versioned, sorted, fixed keys).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import (Finding, RULES, filter_baselined, load_baseline,
+                   render_json, render_text)
+
+DEFAULT_BASELINE = "lint-baseline.toml"
+
+
+def _collect(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
+    toml, contract, jaxf = [], [], []
+
+    def route(p: str):
+        q = p.replace(os.sep, "/")
+        if q.endswith(".toml") and not q.endswith(DEFAULT_BASELINE):
+            toml.append(p)
+        elif q.endswith(".py"):
+            if "/tiles/" in q or q.endswith("disco/tiles.py"):
+                contract.append(p)
+            if "/ops/" in q or "/tiles/" in q:
+                jaxf.append(p)
+
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for fn in sorted(files):
+                    route(os.path.join(root, fn))
+        else:
+            route(path)
+    return toml, contract, jaxf
+
+
+def run(paths: list[str]) -> list[Finding]:
+    from .core import check_suppressions
+    from .contracts import lint_tiles_source
+    from .graph import lint_config_file
+    from .jaxlint import lint_jax_source
+    toml, contract, jaxf = _collect(paths)
+    findings: list[Finding] = []
+    sources: dict[str, str] = {}        # read each file exactly once
+
+    def src(p: str) -> str:
+        if p not in sources:
+            with open(p) as f:
+                sources[p] = f.read()
+        return sources[p]
+
+    for p in toml:
+        src(p)
+        findings.extend(lint_config_file(p))
+    for p in contract:
+        findings.extend(lint_tiles_source(src(p), p))
+    for p in jaxf:
+        findings.extend(lint_jax_source(src(p), p))
+    for p in sorted(sources):           # typo'd disable= tokens
+        findings.extend(check_suppressions(sources[p], p))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdlint",
+        description="static topology / tile-contract / JAX purity lint")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: cfg "
+                         "firedancer_tpu, relative to the repo root)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline TOML (default: {DEFAULT_BASELINE} "
+                         f"next to the package, then cwd)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule, (family, sev, desc) in sorted(
+                RULES.items(), key=lambda kv: (kv[1][0], kv[0])):
+            print(f"{rule:28s} {family:9s} {sev:8s} {desc}")
+        return 0
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = args.paths or [os.path.join(repo_root, "cfg"),
+                           os.path.join(repo_root, "firedancer_tpu")]
+    findings = run(paths)
+
+    if not args.no_baseline:
+        bl_path = args.baseline
+        if bl_path is None:
+            cand = os.path.join(repo_root, DEFAULT_BASELINE)
+            bl_path = cand if os.path.exists(cand) else DEFAULT_BASELINE
+        findings = filter_baselined(findings, load_baseline(bl_path))
+
+    out = render_json(findings) if args.format == "json" \
+        else render_text(findings) + "\n"
+    sys.stdout.write(out)
+    return 1 if any(f.severity == "error" for f in findings) else 0

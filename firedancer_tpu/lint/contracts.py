@@ -1,0 +1,229 @@
+"""Tile-contract analysis: AST over tile classes.
+
+Scope: `firedancer_tpu/tiles/*.py` and `disco/tiles.py` — every class
+that runs inside a tile process. Three contract groups:
+
+  * metric-slot ABI: METRICS/GAUGES declarations must not collide with
+    the supervisor's reserved top slots (disco/metrics.py renders both
+    from the same region; disco/supervise.py owns slots >= SUP_SLOT_MIN),
+    must not duplicate names (slots are positional), and every GAUGES
+    entry must be a declared metric.
+  * tango protocol order: Ring.publish only inside a credit window
+    (a `.credits(...)` / `_wait_credits(...)` check in the same
+    function; `publish_batch` is credit-gated natively), and
+    Fseq.mark_stale never from tile code (the STALE sentinel is
+    supervision-owned).
+  * consumer progress: a registered adapter that reads `ctx.in_rings`
+    must define `in_seqs()` — otherwise the stem never publishes its
+    fseq progress and any reliable upstream producer wedges.
+
+The same AST pass also exports `adapter_summaries()` — the per-kind
+facts (metrics, in_seqs, ring usage) the graph analyzer cross-checks
+configs against.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from functools import lru_cache
+
+from .core import Finding, filter_suppressed, finding
+
+# reserved supervisor slot names + the slot floor, mirrored from
+# disco/supervise.py (imported lazily so linting never needs the native
+# runtime; verified in tests/test_lint.py against the live module)
+SUP_NAMES = ("sup_restarts", "sup_watchdog_trips", "sup_down")
+SUP_SLOT_MIN = 61
+
+_RING_RECEIVER = re.compile(r"ring|out|\brq\b|\bcq\b", re.I)
+
+
+def own_nodes(fn: ast.AST):
+    """Yield the nodes belonging to fn's OWN body — not to nested
+    function/lambda scopes (those are analyzed as their own
+    functions). Scope-sensitive rules must use this, or a credit
+    check inside a never-called nested helper would exempt the outer
+    function's publish."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _const_str_list(node: ast.AST) -> list[str] | None:
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    out = []
+    for el in node.elts:
+        if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+            return None
+        out.append(el.value)
+    return out
+
+
+def _is_registered(cls: ast.ClassDef) -> str | None:
+    """The registry kind string when the class carries
+    @register("kind")."""
+    for dec in cls.decorator_list:
+        if isinstance(dec, ast.Call) and isinstance(dec.func, ast.Name) \
+                and dec.func.id == "register" and dec.args \
+                and isinstance(dec.args[0], ast.Constant):
+            return str(dec.args[0].value)
+    return None
+
+
+def _attr_used(node: ast.AST, attr: str) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr == attr
+               for n in ast.walk(node))
+
+
+def _class_metrics(cls: ast.ClassDef):
+    """(METRICS list|None, its line, GAUGES list|None, its line)."""
+    metrics = gauges = None
+    mline = gline = cls.lineno
+    for st in cls.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                isinstance(st.targets[0], ast.Name):
+            if st.targets[0].id == "METRICS":
+                metrics, mline = _const_str_list(st.value), st.lineno
+            elif st.targets[0].id == "GAUGES":
+                gauges, gline = _const_str_list(st.value), st.lineno
+    return metrics, mline, gauges, gline
+
+
+def lint_tiles_source(source: str, path: str) -> list[Finding]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [finding("silent-consumer", path, e.lineno or 0,
+                        f"unparseable tile module: {e.msg}")]
+    out: list[Finding] = []
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out.extend(_lint_class(node, path))
+
+    # tango order rules are function-granular (own scope only, see
+    # own_nodes) and apply to every function/lambda in a tile module
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+            out.extend(_lint_function(fn, path))
+    return filter_suppressed(out, source)
+
+
+def _lint_class(cls: ast.ClassDef, path: str) -> list[Finding]:
+    out: list[Finding] = []
+    metrics, mline, gauges, gline = _class_metrics(cls)
+    if metrics is not None:
+        for nm in metrics:
+            if nm in SUP_NAMES:
+                out.append(finding(
+                    "reserved-metric", path, mline,
+                    f"{cls.name}.METRICS declares {nm!r} — reserved "
+                    f"for the supervisor's top slots"))
+        if len(metrics) > SUP_SLOT_MIN:
+            out.append(finding(
+                "metrics-overflow", path, mline,
+                f"{cls.name} declares {len(metrics)} metric slots "
+                f"(max {SUP_SLOT_MIN} below the supervisor region)"))
+        seen: set[str] = set()
+        for nm in metrics:
+            if nm in seen:
+                out.append(finding(
+                    "dup-metric", path, mline,
+                    f"{cls.name}.METRICS lists {nm!r} twice"))
+            seen.add(nm)
+        if gauges is not None:
+            for nm in gauges:
+                if nm not in metrics and nm not in SUP_NAMES:
+                    out.append(finding(
+                        "undeclared-gauge", path, gline,
+                        f"{cls.name}.GAUGES entry {nm!r} is not a "
+                        f"declared metric"))
+    kind = _is_registered(cls)
+    if kind is not None and _attr_used(cls, "in_rings"):
+        has_in_seqs = any(
+            isinstance(st, ast.FunctionDef) and st.name == "in_seqs"
+            for st in cls.body)
+        if not has_in_seqs:
+            out.append(finding(
+                "silent-consumer", path, cls.lineno,
+                f"adapter {cls.name} (kind {kind!r}) reads "
+                f"ctx.in_rings but defines no in_seqs(); reliable "
+                f"upstream producers wedge on its frozen fseq"))
+    return out
+
+
+def _lint_function(fn: ast.FunctionDef, path: str) -> list[Finding]:
+    out: list[Finding] = []
+    has_credit_check = False
+    publishes: list[tuple[int, str]] = []
+    for node in own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        if name in ("credits", "_wait_credits", "publish_batch"):
+            has_credit_check = True
+        elif name == "mark_stale":
+            out.append(finding(
+                "stale-outside-supervision", path, node.lineno,
+                "mark_stale() from tile code — only the supervisor "
+                "marks a consumer stale (rejoin clears it)"))
+        elif name == "publish" and isinstance(func, ast.Attribute):
+            recv = ast.unparse(func.value)
+            if _RING_RECEIVER.search(recv):
+                publishes.append((node.lineno, recv))
+    if not has_credit_check:
+        name = getattr(fn, "name", "<lambda>")
+        for line, recv in publishes:
+            out.append(finding(
+                "uncredited-publish", path, line,
+                f"{recv}.publish() with no credit check in "
+                f"{name}() — gate on .credits(fseqs) (or "
+                f"_wait_credits) before publishing"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# adapter summaries for the graph analyzer
+# ---------------------------------------------------------------------------
+
+def adapters_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "disco", "tiles.py")
+
+
+@lru_cache(maxsize=4)
+def adapter_summaries(path: str | None = None) -> dict[str, dict]:
+    """kind -> {metrics, gauges, in_seqs, reads_in_rings,
+    reads_out_rings}, extracted statically from the adapter registry
+    module (no tile imports, no jax, no native lib)."""
+    path = path or adapters_path()
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    out: dict[str, dict] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        kind = _is_registered(node)
+        if kind is None:
+            continue
+        metrics, _, gauges, _ = _class_metrics(node)
+        out[kind] = {
+            "metrics": metrics or [],
+            "gauges": gauges or [],
+            "in_seqs": any(isinstance(st, ast.FunctionDef)
+                           and st.name == "in_seqs"
+                           for st in node.body),
+            "reads_in_rings": _attr_used(node, "in_rings"),
+            "reads_out_rings": _attr_used(node, "out_rings"),
+        }
+    return out

@@ -1,0 +1,263 @@
+"""JAX/Pallas purity lint: host-sync and TPU-hostility hazards.
+
+Scope: `firedancer_tpu/ops/*.py` and `firedancer_tpu/tiles/*.py` — the
+device compute kernels and the tiles that drive them.
+
+Region model (conservative — no call-graph): a function is *jitted
+code* when it is jit-decorated, passed to a tracing transform
+(jax.jit / shard_map / vmap / checkpoint / lax.scan / fori_loop /
+while_loop / cond / pl.pallas_call), or lexically nested inside such a
+function. Hazard rules fire only inside these regions, so host-side
+helpers (numpy constant prep, ctypes glue) never false-positive; the
+fixture tests in tests/test_lint.py prove each rule still fires.
+
+Rules: .item() and float()/int() on traced values (device->host sync /
+ConcretizationTypeError), np.* calls (sync when applied to traced
+arrays; constants belong hoisted out of the trace), Python if/while on
+jnp expressions (traced bools cannot branch), int64/float64 dtypes
+(x64 is off on TPU), PRNG key reuse across draws, and jit entry points
+taking arrays without donate_argnums (warning).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, filter_suppressed, finding
+
+# names whose call-argument functions become jit regions
+_TRACING_CALLS = {
+    "jit", "pallas_call", "shard_map", "vmap", "checkpoint", "remat",
+    "scan", "fori_loop", "while_loop", "cond", "switch", "custom_jvp",
+    "custom_vjp", "grad", "value_and_grad",
+}
+_X64_ATTRS = {"int64", "float64", "uint64"}
+_X64_STRS = {"int64", "float64", "uint64"}
+# jax.random draws that consume a key (reusing one key across several
+# of these is the bug; split/fold_in/PRNGKey derive keys and are fine)
+_KEY_CONSUMERS = {
+    "bits", "uniform", "normal", "randint", "bernoulli", "categorical",
+    "choice", "permutation", "shuffle", "gamma", "beta", "exponential",
+    "poisson", "truncated_normal", "gumbel", "laplace",
+}
+
+
+def _call_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _src_has(node: ast.AST, needle: str) -> bool:
+    return needle in ast.unparse(node)
+
+
+class _Regions:
+    """Compute the set of function/lambda nodes that are jitted code."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.funcs = [n for n in ast.walk(tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda))]
+        rooted_names: set[str] = set()
+        rooted_nodes: set[ast.AST] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_tracing_expr(dec):
+                        rooted_nodes.add(node)
+            if isinstance(node, ast.Call) and \
+                    _call_name(node.func) in _TRACING_CALLS:
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        rooted_nodes.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        rooted_names.add(arg.id)
+        for fn in self.funcs:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name in rooted_names:
+                rooted_nodes.add(fn)
+        self.region_funcs: set[ast.AST] = set()
+        for fn in self.funcs:
+            n: ast.AST | None = fn
+            while n is not None:
+                if n in rooted_nodes:
+                    self.region_funcs.add(fn)
+                    break
+                n = self.parents.get(n)
+
+    @staticmethod
+    def _is_tracing_expr(dec: ast.AST) -> bool:
+        """@jax.jit / @jit / @partial(jax.jit, ...) /
+        @functools.partial(jax.jit, ...)."""
+        if _call_name(dec) in _TRACING_CALLS:
+            return True
+        if isinstance(dec, ast.Call):
+            if _call_name(dec.func) in _TRACING_CALLS:
+                return True
+            if _call_name(dec.func) == "partial" and dec.args and \
+                    _call_name(dec.args[0]) in _TRACING_CALLS:
+                return True
+        return False
+
+    def enclosing_func(self, node: ast.AST) -> ast.AST | None:
+        n = self.parents.get(node)
+        while n is not None and not isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            n = self.parents.get(n)
+        return n
+
+    def in_region(self, node: ast.AST) -> bool:
+        fn = self.enclosing_func(node)
+        return fn is not None and fn in self.region_funcs
+
+
+def lint_jax_source(source: str, path: str) -> list[Finding]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [finding("numpy-in-jit", path, e.lineno or 0,
+                        f"unparseable module: {e.msg}")]
+    regions = _Regions(tree)
+    out: list[Finding] = []
+
+    for node in ast.walk(tree):
+        in_region = regions.in_region(node)
+        if isinstance(node, ast.Call):
+            name = _call_name(node.func)
+            if in_region and name == "item" and \
+                    isinstance(node.func, ast.Attribute):
+                out.append(finding(
+                    "host-sync-item", path, node.lineno,
+                    f"{ast.unparse(node.func)}() inside jitted code"))
+            elif in_region and name in ("float", "int", "bool") and \
+                    isinstance(node.func, ast.Name) and node.args and \
+                    _src_has(node.args[0], "jnp."):
+                out.append(finding(
+                    "host-cast-traced", path, node.lineno,
+                    f"{name}({ast.unparse(node.args[0])}) inside "
+                    f"jitted code forces the traced value to host"))
+            elif in_region and isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in ("np", "numpy"):
+                out.append(finding(
+                    "numpy-in-jit", path, node.lineno,
+                    f"np.{node.func.attr}() inside jitted code — "
+                    f"hoist constants out of the trace; on traced "
+                    f"arrays this is a host sync"))
+        elif isinstance(node, (ast.If, ast.While)) and in_region and \
+                _src_has(node.test, "jnp."):
+            out.append(finding(
+                "traced-bool", path, node.lineno,
+                f"Python {type(node).__name__.lower()} on "
+                f"`{ast.unparse(node.test)}` — use jnp.where/"
+                f"lax.cond, a traced bool cannot branch"))
+        if in_region:
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _X64_ATTRS and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in ("jnp", "np", "numpy", "jax"):
+                out.append(finding(
+                    "x64-in-kernel", path, node.lineno,
+                    f"{ast.unparse(node)} inside jitted/Pallas code — "
+                    f"x64 is disabled on TPU"))
+            elif isinstance(node, ast.Constant) and \
+                    node.value in _X64_STRS and \
+                    _is_dtype_position(node, regions):
+                out.append(finding(
+                    "x64-in-kernel", path, node.lineno,
+                    f"dtype {node.value!r} inside jitted/Pallas code "
+                    f"— x64 is disabled on TPU"))
+
+    out.extend(_lint_key_reuse(tree, path))
+    out.extend(_lint_missing_donate(tree, path))
+    return filter_suppressed(out, source)
+
+
+def _is_dtype_position(node: ast.Constant, regions: _Regions) -> bool:
+    """String x64 names only count as dtypes when passed as
+    dtype=... or astype('int64')."""
+    parent = regions.parents.get(node)
+    if isinstance(parent, ast.keyword) and parent.arg == "dtype":
+        return True
+    return isinstance(parent, ast.Call) and \
+        _call_name(parent.func) == "astype"
+
+
+def _lint_key_reuse(tree: ast.Module, path: str) -> list[Finding]:
+    """Within each function's OWN scope, in source order: the same
+    Name passed as the key (first positional arg) to 2+ jax.random
+    draws — without being rebound in between (the `key, sub =
+    split(key)` idiom resets the count) — is correlated randomness."""
+    from .contracts import own_nodes
+    out: list[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # (position, kind, name, line): rebinding clears the tally
+        events: list[tuple[tuple[int, int], str, str, int]] = []
+        for node in own_nodes(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _KEY_CONSUMERS \
+                    and _src_has(node.func, "random") \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                a = node.args[0]
+                events.append(((a.lineno, a.col_offset), "use",
+                               a.id, node.lineno))
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign, ast.NamedExpr,
+                                   ast.For)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name):
+                            events.append(
+                                ((nm.lineno, nm.col_offset), "bind",
+                                 nm.id, nm.lineno))
+        first_use: dict[str, int] = {}
+        for _, kind, name, line in sorted(events):
+            if kind == "bind":
+                first_use.pop(name, None)
+            elif name in first_use:
+                out.append(finding(
+                    "prng-key-reuse", path, line,
+                    f"PRNG key {name!r} consumed again (first draw "
+                    f"at line {first_use[name]}) without a split"))
+            else:
+                first_use[name] = line
+    return out
+
+
+def _lint_missing_donate(tree: ast.Module, path: str) -> list[Finding]:
+    """jax.jit(...) calls/decorators without donate_argnums — large
+    device inputs get copied every dispatch (warning severity: only
+    worth it for entry points fed big arrays)."""
+    out: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = None
+        if _call_name(node.func) == "jit" and \
+                _src_has(node.func, "jit"):
+            target = node
+        elif _call_name(node.func) == "partial" and node.args and \
+                _call_name(node.args[0]) == "jit":
+            target = node
+        if target is None:
+            continue
+        kwargs = {kw.arg for kw in target.keywords}
+        if not kwargs & {"donate_argnums", "donate_argnames"}:
+            out.append(finding(
+                "missing-donate", path, node.lineno,
+                "jax.jit without donate_argnums/donate_argnames — "
+                "device inputs are copied, not reused"))
+    return out

@@ -1,0 +1,117 @@
+"""The tile-arg key registry: one table shared by fdlint's graph
+analyzer (dangling-reference checks) and `app/config.py` (unknown-key
+rejection with a did-you-mean hint).
+
+Every adapter in disco/tiles.py reads its args with `args.get(...)` /
+`args[...]`; this table is the static mirror of those reads. A key's
+value classifies what it references so the linter can resolve it
+against the topology:
+
+    None        plain value, nothing to resolve
+    IN          a link name that must be among the tile's ins
+    OUT         a link name that must be among the tile's outs
+    IN_LIST     list of link names, each among the tile's ins
+    OUT_LIST    list of link names, each among the tile's outs
+    TCACHE      a tcache name declared in the topology
+    TILE        another tile's name
+
+Keys every tile understands (consumed by the stem/launcher/builder,
+not the adapter) live in COMMON_KEYS.
+"""
+from __future__ import annotations
+
+IN, OUT, IN_LIST, OUT_LIST, TCACHE, TILE = (
+    "in", "out", "in[]", "out[]", "tcache", "tile")
+
+# consumed by topo.build / launch.tile_main / stem, valid on any tile
+COMMON_KEYS: dict[str, str | None] = {
+    "supervise": None,      # disco/supervise.py policy table
+    "chaos": None,          # utils/chaos.py fault plan
+    "cpu_idx": None,        # launch: sched_setaffinity pin
+    "sandbox": None,        # launch: utils/sandbox hardening
+    "sandbox_files": None,
+    "lazy_ns": None,        # stem: pinned housekeeping cadence
+    "lazy_auto": None,      # stem: depth-derived cadence
+}
+
+TILE_ARGS: dict[str, dict[str, str | None]] = {
+    "synth": {"count": None, "burst": None, "unique": None, "seed": None},
+    "verify": {"batch": None, "max_len": None, "tcache": TCACHE,
+               "device_retries": None, "device_timeout_s": None,
+               "device_fail_limit": None, "rr_cnt": None, "rr_idx": None,
+               "devices": None},
+    "dedup": {"tcache": TCACHE, "batch": None},
+    "pack": {"txn_in": IN, "bank_links": OUT_LIST, "done_links": IN_LIST,
+             "slot_in": IN, "bundle_in": IN, "slot_ms": None,
+             "batch": None, "max_txn_per_microblock": None},
+    "bank": {"exec": None, "poh_link": OUT, "forward_payloads": None,
+             "slots_per_epoch": None, "genesis_ckpt": None,
+             "genesis": None, "genesis_synth": None, "rpc_port": None,
+             "ws_port": None},
+    "sock": {"port": None, "bind_addr": None, "batch": None, "mtu": None},
+    "quic": {"port": None, "bind_addr": None, "batch": None, "mtu": None},
+    "poh": {"hashes_per_tick": None, "ticks_per_slot": None,
+            "seed": None, "slot_link": OUT},
+    "shred": {"mode": None, "req": OUT, "resp": IN,
+              "shreds_link": OUT, "batches_link": OUT,
+              "turbine_in": IN, "identity_hex": None, "cluster": None,
+              "shred_version": None, "fanout": None, "flush_bytes": None,
+              "drop_slot_every": None, "leader_pubkey_hex": None},
+    "sign": {"seed": None, "clients": None},   # clients resolved specially
+    "tower": {"total_stake": None},
+    "repair": {"req": OUT, "resp": IN, "identity_hex": None,
+               "port": None, "bind_addr": None, "peers": None,
+               "root_slot": None},
+    "replay": {"genesis": None, "genesis_synth": None,
+               "hashes_per_tick": None, "verify_poh": None,
+               "slots_per_epoch": None},
+    "send": {"req": OUT, "resp": IN, "identity_hex": None,
+             "vote_account_hex": None, "dest": None},
+    "archiver": {"path": None},
+    "playback": {"path": None},
+    "gossip": {"seed": None, "port": None, "bind_addr": None,
+               "entrypoints": None, "publish": None,
+               "device_verify": None},
+    "snapld": {"path": None, "chunk": None},
+    "snapdc": {},
+    "snapin": {"format": None},
+    "metric": {"port": None, "bind_addr": None},
+    "bundle": {"engine": None, "path": None, "authority": None},
+    "plugin": {"sock_path": None, "data_hex_max": None},
+    "netlnk": {},
+    "vinyl": {"path": None, "gc": None},
+    "gui": {"port": None, "bind_addr": None, "tps_tile": TILE,
+            "tps_metric": None},                # validated against TILE's kind
+    "cswtch": {},
+    "ipecho": {"shred_version": None, "port": None, "bind_addr": None},
+    "pcap": {"path": None, "realtime": None, "loop": None},
+    "sink": {"batch": None},
+}
+
+
+def known_keys(kind: str) -> set[str]:
+    """All valid [[tile]] keys for a kind (structural + common + args);
+    empty set means the kind itself is unknown."""
+    if kind not in TILE_ARGS:
+        return set()
+    return ({"name", "kind", "ins", "outs"} | set(COMMON_KEYS)
+            | set(TILE_ARGS[kind]))
+
+
+def suggest(key: str, candidates) -> str:
+    """did-you-mean suffix for an unknown key/kind ('' if no close
+    match)."""
+    import difflib
+    close = difflib.get_close_matches(key, sorted(candidates), n=1)
+    return f" (did you mean {close[0]!r}?)" if close else ""
+
+
+# Frame-growth contracts for the mtu-underflow rule: tiles that re-wrap
+# an in-link payload into a larger out-link frame, mirrored from the
+# adapters' own boot-time checks (disco/tiles.py Bank/Poh) and the
+# verbatim-forwarding hot paths (verify/dedup publish the original
+# payload). Checked statically so a too-small link fails review, not
+# boot (or worse, mid-flight publish).
+FORWARD_VERBATIM = {"verify", "dedup"}    # every out mtu >= max in mtu
+BANK_POH_GROWTH = -20 + 42     # microblock hdr 20 -> poh frame hdr 42
+POH_ENTRY_GROWTH = -42 + 116   # poh frame hdr 42 -> entry frame hdr 116

@@ -655,7 +655,7 @@ def _tab_spec():
                         memory_space=pltpu.VMEM)
 
 
-@functools.partial(jax.jit, static_argnames=("tb", "interpret"))
+@functools.partial(jax.jit, static_argnames=("tb", "interpret"))  # fdlint: disable=missing-donate — inputs are host numpy (copied on transfer), nothing device-resident to donate
 def verify_tpu(pub_t, r_t, k64_t, s32_t, tb=DEFAULT_TB, interpret=False):
     """Fused verify core. pub_t/r_t/s32_t (32, B) and k64_t (64, B)
     int32 LE byte rows (pub/R encodings, sha512(R||A||M) output, S).

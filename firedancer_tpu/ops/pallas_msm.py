@@ -248,7 +248,7 @@ def _scratch(tb):
     return [pltpu.VMEM((64, NL, tb), jnp.int32) for _ in range(4)]
 
 
-@functools.partial(jax.jit, static_argnames=("tb", "interpret"))
+@functools.partial(jax.jit, static_argnames=("tb", "interpret"))  # fdlint: disable=missing-donate — inputs are host numpy (copied on transfer), nothing device-resident to donate
 def msm_tpu(y_a, sign_a, r_y, r_sign, zk_w, z_w, mask, s_w_lanes,
             tb=DEFAULT_TB, interpret=False):
     """Stage-1 + stage-2 dispatch. All inputs lane-major (…, B) with B

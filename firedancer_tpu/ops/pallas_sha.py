@@ -86,7 +86,7 @@ def _sha512_kernel(whi_ref, wlo_ref, act_ref, out_ref):
         out_ref[i] = final[i]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret",))  # fdlint: disable=missing-donate — inputs are host numpy (copied on transfer), nothing device-resident to donate
 def _sha512_call(whi, wlo, act, interpret=False):
     nblock, _, sub, b8 = whi.shape
     grid = (b8 // LANE,)

@@ -84,7 +84,7 @@ def _apply_bit_matrix(mat_bits, shreds):
     return _bits_to_bytes(par)
 
 
-@functools.partial(jax.jit, static_argnames=("p",))
+@functools.partial(jax.jit, static_argnames=("p",))  # fdlint: disable=missing-donate — inputs are host numpy (copied on transfer), nothing device-resident to donate
 def encode(data, p: int):
     """data (..., d, sz) uint8 shred set(s) -> (..., p, sz) parity.
 

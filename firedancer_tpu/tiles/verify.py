@@ -176,7 +176,10 @@ class VerifyTile:
                 except TypeError:
                     vb = shard_map(vb, **skw, check_rep=False)
             self.devices = ndev
-            self._fn = jax.jit(vb)
+            # lane buffers are rotating HOST staging arrays re-fed
+            # across dispatches; donation would invalidate an
+            # in-flight transfer's source
+            self._fn = jax.jit(vb)  # fdlint: disable=missing-donate
         else:
             raise ValueError(backend)
         # pipelined dispatch: keep up to `inflight` device batches in

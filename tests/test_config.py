@@ -87,6 +87,32 @@ def test_unknown_section_rejected(tmp_path):
         load_config(p)
 
 
+def test_unknown_tile_key_rejected_with_hint():
+    """A typo'd tile arg used to pass through silently as an arg the
+    adapter never reads; the schema gate (key registry shared with
+    fdlint) rejects it with a did-you-mean."""
+    with pytest.raises(ValueError, match=r"bacth.*did you mean 'batch'"):
+        build_topology({"tile": [{"name": "v", "kind": "verify",
+                                  "bacth": 32}]})
+
+
+def test_unknown_tile_kind_rejected_with_hint():
+    with pytest.raises(ValueError, match=r"verfy.*did you mean 'verify'"):
+        build_topology({"tile": [{"name": "v", "kind": "verfy"}]})
+
+
+def test_common_tile_keys_accepted():
+    # supervise/chaos/cpu_idx etc. are stem/launcher keys valid on any kind
+    topo = build_topology({
+        "link": [{"name": "a_b"}],
+        "tile": [{"name": "s", "kind": "synth", "outs": ["a_b"],
+                  "supervise": {"policy": "restart"},
+                  "chaos": {"events": []}, "cpu_idx": 0,
+                  "lazy_auto": True},
+                 {"name": "d", "kind": "sink", "ins": ["a_b"]}]})
+    assert topo.tiles["s"].args["supervise"]["policy"] == "restart"
+
+
 def test_overrides_dict(cfgdir):
     cfg = load_config(cfgdir / "base.toml",
                       overrides={"topology": {"wksp_size": 1 << 25}})

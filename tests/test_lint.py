@@ -1,0 +1,619 @@
+"""fdlint tier-1 gate + per-rule fixtures.
+
+Two halves: (1) the shipped tree — `cfg/*.toml` + `firedancer_tpu/`
+— must lint clean (zero non-baselined findings), so topology/contract/
+purity regressions fail CI before they can wedge a topology at
+runtime; (2) every shipped rule has a deliberately broken fixture
+proving it fires exactly once (a rule that cannot fire is a rule that
+silently rotted)."""
+import json
+import textwrap
+
+import pytest
+
+from firedancer_tpu.lint import core
+from firedancer_tpu.lint.cli import main as lint_main
+from firedancer_tpu.lint.contracts import (adapter_summaries,
+                                           lint_tiles_source)
+from firedancer_tpu.lint.graph import (lint_config, lint_config_file,
+                                       lint_topology)
+from firedancer_tpu.lint.jaxlint import lint_jax_source
+
+pytestmark = pytest.mark.lint
+
+
+def rule_count(findings, rule):
+    return sum(1 for f in findings if f.rule == rule)
+
+
+def fires_once(findings, rule):
+    assert rule_count(findings, rule) == 1, \
+        f"{rule}: expected exactly 1, got " \
+        f"{[f.render() for f in findings]}"
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree lints clean
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_is_clean(capsys):
+    rc = lint_main(["--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["findings"] == [], doc["findings"]
+
+
+def test_every_rule_has_severity_and_family():
+    for rule, (family, sev, desc) in core.RULES.items():
+        assert family in ("graph", "contract", "jax", "core")
+        assert sev in core.SEVERITIES
+        assert desc
+    assert len(core.RULES) >= 12          # ISSUE 2 acceptance floor
+
+
+def test_every_rule_has_a_fixture():
+    """A rule without a broken-fixture test is a rule that can rot
+    silently: scan this module's own source for fires_once(..., rule)
+    call sites per catalog entry (a mere quoted mention — e.g. a
+    does-NOT-fire assertion — must not count)."""
+    import re
+    with open(__file__) as f:
+        src = f.read()
+    exercised = set()
+    for rule in core.RULES:
+        # fires_once closes with `, "<rule>")` — either after the
+        # findings expression's closing paren or a bare name
+        if re.search(r'fires_once\(\w+,\s*"' + rule + r'"\)', src) or \
+                re.search(r'\),\s*"' + rule + r'"\)', src):
+            exercised.add(rule)
+    missing = set(core.RULES) - exercised
+    assert not missing, f"rules without fixtures: {sorted(missing)}"
+
+
+def test_sup_constants_match_supervise():
+    """The contract analyzer mirrors the supervisor slot ABI without
+    importing the native runtime — keep the mirror honest."""
+    from firedancer_tpu.disco.supervise import SUP_SLOT_MIN, SUP_SLOTS
+    from firedancer_tpu.lint import contracts
+    assert set(contracts.SUP_NAMES) == set(SUP_SLOTS)
+    assert contracts.SUP_SLOT_MIN == SUP_SLOT_MIN
+
+
+def test_registry_covers_every_adapter_kind():
+    """lint/registry.py TILE_ARGS and the @register'd adapters are the
+    same kind set — a new adapter must declare its arg keys."""
+    from firedancer_tpu.lint.registry import TILE_ARGS
+    kinds = set(adapter_summaries())
+    assert kinds == set(TILE_ARGS), \
+        kinds.symmetric_difference(TILE_ARGS)
+
+
+# ---------------------------------------------------------------------------
+# graph-family fixtures
+# ---------------------------------------------------------------------------
+
+def _cfg(links=None, tiles=None, **extra):
+    cfg = {
+        "link": links if links is not None else [
+            {"name": "a_b", "depth": 64, "mtu": 1280}],
+        "tile": tiles if tiles is not None else [
+            {"name": "src", "kind": "synth", "outs": ["a_b"]},
+            {"name": "dst", "kind": "sink", "ins": ["a_b"]}],
+    }
+    cfg.update(extra)
+    return cfg
+
+
+def test_graph_base_fixture_is_clean():
+    assert lint_config(_cfg(), "<fixture>") == []
+
+
+def test_dead_link():
+    cfg = _cfg(tiles=[{"name": "src", "kind": "synth", "outs": ["a_b"]}])
+    fires_once(lint_config(cfg, "<fixture>"), "dead-link")
+
+
+def test_orphan_link():
+    cfg = _cfg(tiles=[{"name": "dst", "kind": "sink", "ins": ["a_b"]}])
+    fires_once(lint_config(cfg, "<fixture>"), "orphan-link")
+
+
+def test_dup_producer():
+    cfg = _cfg(tiles=[
+        {"name": "s1", "kind": "synth", "outs": ["a_b"]},
+        {"name": "s2", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"]}])
+    fires_once(lint_config(cfg, "<fixture>"), "dup-producer")
+
+
+def test_depth_pow2():
+    cfg = _cfg(links=[{"name": "a_b", "depth": 96, "mtu": 1280}])
+    fires_once(lint_config(cfg, "<fixture>"), "depth-pow2")
+
+
+def test_mtu_underflow():
+    cfg = _cfg(
+        links=[{"name": "a_b", "depth": 64, "mtu": 1280},
+               {"name": "b_c", "depth": 64, "mtu": 512}],
+        tiles=[{"name": "src", "kind": "synth", "outs": ["a_b"]},
+               {"name": "v", "kind": "verify", "ins": ["a_b"],
+                "outs": ["b_c"]},
+               {"name": "dst", "kind": "sink", "ins": ["b_c"]}])
+    fires_once(lint_config(cfg, "<fixture>"), "mtu-underflow")
+
+
+def test_backpressure_cycle():
+    cfg = _cfg(
+        links=[{"name": "a", "depth": 64, "mtu": 1280},
+               {"name": "b", "depth": 64, "mtu": 1280}],
+        tiles=[{"name": "t1", "kind": "dedup", "ins": ["b"],
+                "outs": ["a"]},
+               {"name": "t2", "kind": "dedup", "ins": ["a"],
+                "outs": ["b"]}])
+    fires_once(lint_config(cfg, "<fixture>"), "backpressure-cycle")
+
+
+def test_reliable_sink():
+    # metric never consumes rings: a RELIABLE in wedges the producer
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "m", "kind": "metric", "ins": ["a_b"]}])
+    fires_once(lint_config(cfg, "<fixture>"), "reliable-sink")
+
+
+def test_unread_in():
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "m", "kind": "metric", "ins": [["a_b", False]]}])
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "unread-in")
+    assert rule_count(findings, "reliable-sink") == 0
+
+
+def test_unknown_kind():
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synht", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"]}])
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "unknown-kind")
+    assert "did you mean 'synth'" in findings[0].message
+
+
+def test_bad_supervise():
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"],
+         "supervise": {"policy": "sometimes"}}])
+    fires_once(lint_config(cfg, "<fixture>"), "bad-supervise")
+
+
+def test_bad_chaos_unknown_action():
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"],
+         "chaos": {"events": [{"action": "explode"}]}}])
+    fires_once(lint_config(cfg, "<fixture>"), "bad-chaos")
+
+
+def test_bad_chaos_stall_fseq_unknown_link():
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"],
+         "chaos": {"events": [{"action": "stall_fseq",
+                               "link": "ghost", "at_rx": 4}]}}])
+    fires_once(lint_config(cfg, "<fixture>"), "bad-chaos")
+
+
+def test_dangling_ref():
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"]},
+        {"name": "g", "kind": "gui", "tps_tile": "nosuch"}])
+    fires_once(lint_config(cfg, "<fixture>"), "dangling-ref")
+
+
+def test_lint_topology_programmatic():
+    """Programmatic Topology builds get the same pass as TOML."""
+    from firedancer_tpu.disco import Topology
+    topo = (Topology("lintfix")
+            .link("a_b", depth=64, mtu=1280)
+            .tile("src", "synth", outs=["a_b"]))
+    fires_once(lint_topology(topo), "dead-link")
+
+
+# ---------------------------------------------------------------------------
+# contract-family fixtures
+# ---------------------------------------------------------------------------
+
+def _tiles_findings(src: str):
+    return lint_tiles_source(textwrap.dedent(src), "<fixture.py>")
+
+
+def test_reserved_metric():
+    fires_once(_tiles_findings("""
+        class T:
+            METRICS = ["rx", "sup_down"]
+        """), "reserved-metric")
+
+
+def test_metrics_overflow():
+    names = ", ".join(f'"m{i}"' for i in range(62))
+    fires_once(_tiles_findings(f"""
+        class T:
+            METRICS = [{names}]
+        """), "metrics-overflow")
+
+
+def test_undeclared_gauge():
+    fires_once(_tiles_findings("""
+        class T:
+            METRICS = ["rx"]
+            GAUGES = ["port"]
+        """), "undeclared-gauge")
+
+
+def test_dup_metric():
+    fires_once(_tiles_findings("""
+        class T:
+            METRICS = ["rx", "rx"]
+        """), "dup-metric")
+
+
+def test_uncredited_publish():
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                self.out_ring.publish(b"x", sig=1)
+                return 1
+        """), "uncredited-publish")
+
+
+def test_credited_publish_is_clean():
+    assert _tiles_findings("""
+        class T:
+            def poll_once(self):
+                while self.fseqs and \\
+                        self.out_ring.credits(self.fseqs) <= 0:
+                    pass
+                self.out_ring.publish(b"x", sig=1)
+                return 1
+        """) == []
+
+
+def test_uncredited_publish_nested_credit_does_not_exempt():
+    """A credit check inside a never-called nested helper must not
+    exempt the OUTER function's publish (scope-sensitive scan)."""
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                def helper():
+                    return self.out_ring.credits(self.fseqs)
+                self.out_ring.publish(b"x", sig=1)
+                return 1
+        """), "uncredited-publish")
+
+
+def test_uncredited_publish_in_nested_fn_reported_once():
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                def helper():
+                    self.out_ring.publish(b"x", sig=1)
+                return helper()
+        """), "uncredited-publish")
+
+
+def test_stale_outside_supervision():
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                self.fseq.mark_stale()
+        """), "stale-outside-supervision")
+
+
+def test_silent_consumer():
+    fires_once(_tiles_findings("""
+        @register("demo")
+        class D:
+            def __init__(self, ctx, args):
+                self.ring = ctx.in_rings["a"]
+
+            def poll_once(self):
+                return 0
+        """), "silent-consumer")
+
+
+def test_silent_consumer_with_in_seqs_is_clean():
+    assert _tiles_findings("""
+        @register("demo")
+        class D:
+            def __init__(self, ctx, args):
+                self.ring = ctx.in_rings["a"]
+                self.seq = 0
+
+            def in_seqs(self):
+                return {"a": self.seq}
+        """) == []
+
+
+# ---------------------------------------------------------------------------
+# jax-family fixtures
+# ---------------------------------------------------------------------------
+
+def _jax_findings(src: str):
+    return lint_jax_source(textwrap.dedent(src), "<fixture.py>")
+
+
+def test_host_sync_item():
+    fires_once(_jax_findings("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum().item()
+        """), "host-sync-item")
+
+
+def test_host_cast_traced():
+    fires_once(_jax_findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return float(jnp.sum(x))
+        """), "host-cast-traced")
+
+
+def test_numpy_in_jit():
+    fires_once(_jax_findings("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+        """), "numpy-in-jit")
+
+
+def test_numpy_outside_jit_is_clean():
+    assert _jax_findings("""
+        import numpy as np
+
+        def host_prep(x):
+            return np.asarray(x, np.int64)
+        """) == []
+
+
+def test_traced_bool():
+    fires_once(_jax_findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+        """), "traced-bool")
+
+
+def test_x64_in_kernel():
+    fires_once(_jax_findings("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.int64)
+        """), "x64-in-kernel")
+
+
+def test_x64_in_pallas_kernel_body():
+    # kernels are regions through the pallas_call reference, not a
+    # decorator
+    fires_once(_jax_findings("""
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...].astype(jnp.float64)
+
+        def entry(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """), "x64-in-kernel")
+
+
+def test_prng_key_reuse():
+    fires_once(_jax_findings("""
+        import jax
+
+        def f(key):
+            a = jax.random.uniform(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """), "prng-key-reuse")
+
+
+def test_prng_split_is_clean():
+    assert _jax_findings("""
+        import jax
+
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.uniform(k1, (2,))
+            b = jax.random.normal(k2, (2,))
+            return a + b
+        """) == []
+
+
+def test_prng_rebinding_idiom_is_clean():
+    """The standard `key, sub = split(key)` loop rebinds sub between
+    draws — not reuse."""
+    assert _jax_findings("""
+        import jax
+
+        def f(key):
+            key, sub = jax.random.split(key)
+            a = jax.random.uniform(sub, (2,))
+            key, sub = jax.random.split(key)
+            b = jax.random.normal(sub, (2,))
+            return a + b
+        """) == []
+
+
+def test_prng_reuse_in_nested_fn_reported_once():
+    fires_once(_jax_findings("""
+        import jax
+
+        def outer(key):
+            def inner():
+                a = jax.random.uniform(key, (2,))
+                b = jax.random.normal(key, (2,))
+                return a + b
+            return inner()
+        """), "prng-key-reuse")
+
+
+def test_missing_donate():
+    fires_once(_jax_findings("""
+        import jax
+
+        def f(x):
+            return x + 1
+
+        g = jax.jit(f)
+        """), "missing-donate")
+
+
+def test_donated_jit_is_clean():
+    assert _jax_findings("""
+        import jax
+
+        def f(x):
+            return x + 1
+
+        g = jax.jit(f, donate_argnums=(0,))
+        """) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions, baseline, CLI
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_same_line():
+    assert _tiles_findings("""
+        class T:
+            def poll_once(self):
+                self.out_ring.publish(b"x")  # fdlint: disable=uncredited-publish — req/resp ring, depth-bounded
+        """) == []
+
+
+def test_inline_suppression_prev_line():
+    assert _tiles_findings("""
+        class T:
+            def poll_once(self):
+                # fdlint: disable=uncredited-publish — depth-bounded
+                self.out_ring.publish(b"x")
+        """) == []
+
+
+def test_suppression_does_not_leak_to_other_rules():
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                self.out_ring.publish(b"x")  # fdlint: disable=dup-metric
+        """), "uncredited-publish")
+
+
+def test_baseline_filters_by_rule_path_line():
+    f = core.finding("dead-link", "cfg/x.toml", 7, "m")
+    assert core.filter_baselined(
+        [f], [{"rule": "dead-link", "path": "x.toml"}]) == []
+    assert core.filter_baselined(
+        [f], [{"rule": "dead-link", "path": "x.toml", "line": 9}]) == [f]
+    assert core.filter_baselined(
+        [f], [{"rule": "orphan-link", "path": "x.toml"}]) == [f]
+
+
+def test_baseline_path_needs_component_boundary():
+    """An entry for demo.toml must not swallow cluster-demo.toml."""
+    f = core.finding("dead-link", "cfg/cluster-demo.toml", 7, "m")
+    assert core.filter_baselined(
+        [f], [{"rule": "dead-link", "path": "demo.toml"}]) == [f]
+    assert core.filter_baselined(
+        [f], [{"rule": "dead-link", "path": "cluster-demo.toml"}]) == []
+
+
+def test_bad_suppression():
+    fires_once(core.check_suppressions(
+        "x = 1  # fdlint: disable=missing-donte\n", "<fixture>"),
+        "bad-suppression")
+    assert core.check_suppressions(
+        "x = 1  # fdlint: disable=missing-donate\n", "<fixture>") == []
+    assert core.check_suppressions(
+        "x = 1  # fdlint: disable=all\n", "<fixture>") == []
+
+
+BROKEN_TOML = """
+[[link]]
+name = "a_b"
+depth = 96
+mtu = 1280
+
+[[tile]]
+name = "src"
+kind = "synth"
+outs = ["a_b"]
+"""
+
+
+def test_cli_nonzero_on_broken_fixture(tmp_path, capsys):
+    p = tmp_path / "broken.toml"
+    p.write_text(BROKEN_TOML)
+    assert lint_main([str(p)]) == 1
+    out = capsys.readouterr().out
+    assert "depth-pow2" in out and "dead-link" in out
+
+
+def test_cli_baseline_grandfathers(tmp_path, capsys):
+    p = tmp_path / "broken.toml"
+    p.write_text(BROKEN_TOML)
+    bl = tmp_path / "bl.toml"
+    bl.write_text('[[finding]]\nrule = "depth-pow2"\n'
+                  'path = "broken.toml"\n'
+                  '[[finding]]\nrule = "dead-link"\n'
+                  'path = "broken.toml"\n')
+    assert lint_main([str(p), "--baseline", str(bl)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_json_is_stable(tmp_path, capsys):
+    p = tmp_path / "broken.toml"
+    p.write_text(BROKEN_TOML)
+    lint_main([str(p), "--format", "json"])
+    one = capsys.readouterr().out
+    lint_main([str(p), "--format", "json"])
+    two = capsys.readouterr().out
+    assert one == two
+    doc = json.loads(one)
+    assert doc["fdlint"] == 1
+    assert doc["counts"]["error"] == 2
+    assert [sorted(f) for f in doc["findings"]] == [
+        ["line", "message", "path", "rule", "severity"]] * 2
+
+
+def test_overlay_layer_directive(tmp_path):
+    """`# fdlint: layers=` loads the base stack; findings attribute to
+    the layer that declares the entity, so ONE suppression in the base
+    covers every stack (the cfg/cluster-demo.toml pattern)."""
+    base = tmp_path / "base.toml"
+    base.write_text(BROKEN_TOML)
+    overlay = tmp_path / "over.toml"
+    overlay.write_text("# fdlint: layers=base.toml\n"
+                       '[[tile]]\nname = "dst"\nkind = "sink"\n'
+                       'ins = ["a_b"]\n')
+    findings = lint_config_file(str(overlay))
+    assert rule_count(findings, "dead-link") == 0    # overlay consumes
+    fires_once(findings, "depth-pow2")
+    assert findings[0].path == str(base)             # attributed to base

@@ -14,7 +14,7 @@ import os
 import numpy as np
 import pytest
 
-from firedancer_tpu.runtime import Fseq, Ring, Workspace
+from firedancer_tpu.runtime import FSEQ_STALE, Fseq, Ring, Workspace
 
 DEPTH = 8
 
@@ -87,6 +87,110 @@ def test_forced_lap_is_detected_not_corrupt(ring):
         rc, frag = ring.consume(s)
         assert rc == 0
         assert bytes(ring.payload(frag))[:frag.sz] == payload_for(s)
+
+
+def test_stale_consumer_unwedges_producer(ring):
+    """The PR 1 FSEQ_STALE contract, smallest case: a consumer dies
+    mid-credit (fseq frozen), the producer runs out of credits exactly
+    at depth; mark_stale excludes the dead fseq from fctl and the full
+    window returns immediately."""
+    w = ring.wksp
+    fs = Fseq(w)
+    # consumer advances a little, then dies with its cursor frozen
+    for s in range(3):
+        ring.publish(payload_for(s), sig=s)
+    fs.update(3)
+    pub = 3
+    while ring.credits([fs]) > 0:
+        ring.publish(payload_for(pub), sig=pub)
+        pub += 1
+    assert pub == 3 + DEPTH          # wedged exactly at the window
+    fs.mark_stale()                  # supervisor's _mark_down step
+    assert fs.is_stale()
+    assert ring.credits([fs]) > 0    # sentinel skipped by native fctl
+    ring.publish(payload_for(pub), sig=pub)
+
+
+def test_restarted_consumer_rejoins_at_tail(ring):
+    """Consumer dies, producer keeps flowing over the stale window,
+    restarted consumer rejoins at the producer's CURRENT seq (the
+    TileCtx rejoin_at_tail seeding): frags published while down are
+    skipped — never replayed, never torn — and the fseq update clears
+    the sentinel so credits gate on the consumer again."""
+    w = ring.wksp
+    fs = Fseq(w)
+    con = 0
+    for s in range(5):
+        ring.publish(payload_for(s), sig=s)
+        rc, frag = ring.consume(con)
+        assert rc == 0
+        con += 1
+        fs.update(con)
+    fs.mark_stale()                              # consumer died
+    pub = 5
+    for _ in range(3 * DEPTH):                   # producer flows on
+        assert ring.credits([fs]) > 0
+        ring.publish(payload_for(pub), sig=pub)
+        pub += 1
+    # rejoin: seed the cursor AND the fseq from ring.seq (TileCtx)
+    con = ring.seq
+    fs.update(con)
+    assert not fs.is_stale()
+    assert ring.credits([fs]) == DEPTH           # full window at tail
+    ring.publish(payload_for(pub), sig=pub)
+    pub += 1
+    rc, frag = ring.consume(con)
+    assert rc == 0 and frag.seq == con
+    assert bytes(ring.payload(frag))[:frag.sz] == payload_for(con)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_seeded_stale_rejoin_interleavings(ring, seed):
+    """Seeded schedules over the full die/skip/rejoin protocol: the
+    producer only publishes within credits, the consumer randomly dies
+    (fseq -> STALE) and later rejoins at tail. Invariants after every
+    step: a LIVE consumer is never lapped (exact payload readback), a
+    stale fseq never blocks the producer for more than the depth
+    window, and every rejoin lands exactly at the producer's seq."""
+    rng = np.random.default_rng(seed + 100)
+    w = ring.wksp
+    fs = Fseq(w)
+    pub = con = 0
+    alive = True
+    rejoins = deaths = 0
+    for _ in range(600):
+        r = rng.random()
+        if r < 0.45:
+            if ring.credits([fs]) > 0:
+                ring.publish(payload_for(pub), sig=pub)
+                pub += 1
+            else:
+                # blocked: only ever on a LIVE consumer's window
+                assert alive
+                assert pub - con == DEPTH
+        elif r < 0.80:
+            if alive and con < pub:
+                rc, frag = ring.consume(con)
+                assert rc == 0, \
+                    f"live reliable consumer lapped at {con}"
+                assert bytes(ring.payload(frag))[:frag.sz] \
+                    == payload_for(con)
+                con += 1
+                fs.update(con)
+        elif r < 0.90:
+            if alive:                       # die mid-credit
+                fs.mark_stale()
+                alive = False
+                deaths += 1
+        else:
+            if not alive:                   # respawn: rejoin at tail
+                con = ring.seq
+                fs.update(con)
+                assert fs.query() == con != FSEQ_STALE
+                alive = True
+                rejoins += 1
+    assert deaths and rejoins               # schedules exercised both
+    assert con <= pub
 
 
 def test_reliable_consumer_is_never_lapped(ring):
