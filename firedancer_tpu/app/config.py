@@ -43,6 +43,15 @@ Schema:
     [topology.supervise]     # optional topology-wide defaults,
     policy = "restart"       #  deep-merged under each tile's table
 
+    [trace]                  # fdtrace flight recorder (trace/recorder.py)
+    enable = true            # default false: untraced topologies pay
+    depth = 2048             #  NOTHING per frag (hooks stay None)
+    sample = 1               # record every Nth frag-scoped event
+    tiles = ["verify"]       # optional allowlist (default: all tiles)
+
+    [tile.trace]             # per-tile override (opt out/in, depth,
+    sample = 16              #  sample) — highest precedence
+
     [[tile.chaos.events]]    # seeded fault plan (utils/chaos.py):
     action = "crash"         #  crash | freeze_hb | wedge | stall_fseq
     at_rx = 24               #  | fail_dispatch (verify tile); fire at
@@ -71,7 +80,7 @@ except ModuleNotFoundError:          # py<3.11
                 "no TOML parser available on this Python (<3.11): "
                 "install 'tomli'") from e
 
-_TOP_SECTIONS = {"topology", "link", "tcache", "tile"}
+_TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -120,9 +129,9 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
             if key in layer:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
-        if "topology" in layer:
-            cfg["topology"] = _deep_merge(cfg.get("topology", {}),
-                                          layer["topology"])
+        for key in ("topology", "trace"):
+            if key in layer:
+                cfg[key] = _deep_merge(cfg.get(key, {}), layer[key])
     return cfg
 
 
@@ -152,8 +161,16 @@ def build_topology(cfg: dict, name: str | None = None):
     from ..disco import Topology
 
     top = cfg.get("topology", {})
+    # [trace] flight-recorder section — validated here (fail at config
+    # load with a did-you-mean, like every other schema gate) and again
+    # by topo.build
+    from ..trace import normalize_trace
+    trace_cfg = cfg.get("trace")
+    if trace_cfg is not None:
+        normalize_trace(trace_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
-                    wksp_size=int(top.get("wksp_size", 1 << 26)))
+                    wksp_size=int(top.get("wksp_size", 1 << 26)),
+                    trace=trace_cfg)
     for ln in cfg.get("link", []):
         topo.link(ln["name"], depth=int(ln.get("depth", 128)),
                   mtu=int(ln.get("mtu", 1280)))

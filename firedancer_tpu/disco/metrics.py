@@ -78,7 +78,11 @@ def read_hists(wksp, plan: dict, tile_name: str) -> dict:
 
 
 def quantile_ns(hist: dict, q: float) -> int:
-    """Upper-bound estimate of the q-quantile from log2 buckets."""
+    """Upper-bound estimate of the q-quantile from log2 buckets.
+    Edges: an empty histogram is 0; q=0.0 is the minimum sample's
+    bucket bound (the `cum > 0` guard — a bare `cum >= 0` would hand
+    back bucket 0 even when every sample sits higher); q=1.0 is the
+    maximum sample's bucket bound."""
     count = hist["count"]
     if not count:
         return 0
@@ -86,7 +90,7 @@ def quantile_ns(hist: dict, q: float) -> int:
     cum = 0
     for i, c in enumerate(hist["buckets"]):
         cum += c
-        if cum >= target:
+        if cum >= target and cum > 0:
             return 1 << (i + 1)
     return 1 << NBUCKETS
 

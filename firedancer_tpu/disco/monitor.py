@@ -36,7 +36,16 @@ def snapshot(plan: dict, wksp: Workspace) -> dict:
         # slot names come from the plan ABI, not adapter class order
         names = spec.get("metrics_names", [])
         hists = read_hists(wksp, plan, tn)
+        trace = None
+        if spec.get("trace_off") is not None:
+            # flight-recorder liveness: total events ever written (the
+            # ring keeps the last trace_depth; tools/fdtrace drains)
+            from ..runtime import TraceRing
+            trace = {"events": TraceRing(
+                wksp, spec["trace_off"], spec["trace_depth"]).cursor,
+                "depth": spec["trace_depth"]}
         out[tn] = {
+            "trace": trace,
             "kind": spec["kind"],
             "state": _STATE.get(cnc.state, f"?{cnc.state}"),
             # clamp: clock reads race across processes by a few ticks

@@ -67,12 +67,29 @@ class Stem:
         if args.get("chaos"):
             from ..utils.chaos import ChaosPlan
             self._chaos = ChaosPlan(args["chaos"])
+        # fdtrace flight recorder: None on untraced tiles — the whole
+        # disabled path is this one cached attribute staying None
+        # (trace/__init__.py contract; no per-frag cost when off)
+        self._trace = getattr(ctx, "trace", None)
+        self._wait_t0: int | None = None      # idle-streak start (ns)
+        # WORK attribution accumulators: with sample>1 one EV_WORK
+        # record aggregates the last `sample` productive polls
+        # (sum-preserving — wait/work attribution stays exact, only
+        # the record RATE is thinned)
+        self._work_ns = 0
+        self._work_frags = 0
+        self._work_polls = 0
 
     def _apply_chaos(self, iters: int, rx: int):
         from ..utils import log
         for ev in self._chaos.poll(iters, rx):
             act = ev["action"]
             log.warning(f"chaos: firing {act} (iter={iters} rx={rx})")
+            if self._trace is not None:
+                # record the injection BEFORE acting so even a crash
+                # leaves its footprint for the black-box dump
+                from ..trace import chaos_event
+                chaos_event(self._trace, act, at=iters)
             if act == "crash":
                 import os
                 os._exit(ev["code"])
@@ -85,6 +102,21 @@ class Stem:
                 if self._stalled_links is None:
                     self._stalled_links = set()
                 self._stalled_links.add(ev["link"])   # None = all links
+
+    def _trace_flush(self, tr):
+        """Close out pending trace state on any loop exit (halt, fail,
+        external FAIL): the aggregated-but-unemitted work window and an
+        open wait streak are exactly 'the last thing the tile was
+        doing' — the black-box dump must not lose them."""
+        from ..trace import events as trace_ev
+        if self._work_polls:
+            tr.event(trace_ev.EV_WORK, arg=self._work_ns,
+                     count=self._work_frags)
+            self._work_ns = self._work_frags = self._work_polls = 0
+        if self._wait_t0 is not None:
+            tr.event(trace_ev.EV_WAIT,
+                     arg=time.perf_counter_ns() - self._wait_t0)
+            self._wait_t0 = None
 
     def _flush_metrics(self):
         items = getattr(self.tile, "metrics_items", None)
@@ -116,9 +148,13 @@ class Stem:
                 fs.update(seqs()[ln])
 
     def run(self, max_iters: int | None = None):
+        from ..trace import events as trace_ev
+        tr = self._trace
         cnc = self.ctx.cnc
         cnc.heartbeat()
         cnc.state = CNC_RUN
+        if tr is not None:
+            tr.event(trace_ev.EV_BOOT)
         # jittered lazy interval: same reasoning as the reference's
         # randomized housekeeping (fd_stem.c — avoid phase-locking tiles)
         next_hk = 0.0
@@ -137,13 +173,21 @@ class Stem:
                         # externally failed (wedge watchdog): exit NOW,
                         # leaving the FAIL state visible — on_halt and
                         # the HALT transition are for clean shutdowns
+                        if tr is not None:
+                            self._trace_flush(tr)
+                            tr.event(trace_ev.EV_FAIL)
                         self._flush_metrics()
                         return
+                    hk_t0 = time.perf_counter_ns() if tr is not None \
+                        else 0
                     self._update_in_fseqs()
                     hk = getattr(self.tile, "housekeeping", None)
                     if hk is not None:
                         hk()
                     self._flush_metrics()
+                    if tr is not None:
+                        tr.event(trace_ev.EV_HOUSEKEEP,
+                                 arg=time.perf_counter_ns() - hk_t0)
                     next_hk = now + self.hk_interval_s * (
                         0.7 + 0.6 * random.random())
                 if self._wedged:
@@ -154,11 +198,40 @@ class Stem:
                     continue
                 t0 = time.perf_counter_ns()
                 n = self.tile.poll_once()
+                t1 = time.perf_counter_ns()
                 # wait/work latency attribution: an idle poll is time
                 # spent waiting on upstream, a productive one is work
                 # (the reference's per-link regime split)
-                self._hists["work" if n else "wait"].add(
-                    time.perf_counter_ns() - t0)
+                self._hists["work" if n else "wait"].add(t1 - t0)
+                if tr is not None:
+                    # trace shape: one WAIT span per idle STREAK
+                    # (credit-wait begin at the first empty poll, end
+                    # at the next productive one) + one WORK span per
+                    # `sample` productive polls carrying their SUMMED
+                    # duration and frag count
+                    if n:
+                        if self._wait_t0 is not None:
+                            # stamped at t0 — the poll START where the
+                            # streak actually ended — so the rendered
+                            # span never overlaps the work that ended
+                            # it. perf_counter_ns and monotonic_ns are
+                            # both CLOCK_MONOTONIC on this platform
+                            # (pinned by tests/test_trace.py).
+                            tr.ring.append(t0, trace_ev.EV_WAIT,
+                                           arg=t0 - self._wait_t0)
+                            self._wait_t0 = None
+                        self._work_ns += t1 - t0
+                        self._work_frags += n
+                        self._work_polls += 1
+                        if self._work_polls >= tr.sample:
+                            tr.event(trace_ev.EV_WORK,
+                                     arg=self._work_ns,
+                                     count=self._work_frags)
+                            self._work_ns = 0
+                            self._work_frags = 0
+                            self._work_polls = 0
+                    elif self._wait_t0 is None:
+                        self._wait_t0 = t0
                 if not n:
                     time.sleep(self.idle_sleep_s)
                 iters += 1
@@ -169,6 +242,9 @@ class Stem:
                     break
         except Exception as e:
             cnc.state = CNC_FAIL
+            if tr is not None:
+                self._trace_flush(tr)
+                tr.event(trace_ev.EV_FAIL)
             self._flush_metrics()
             from ..utils import log
             log.err(f"tile failed: {e!r}")
@@ -179,4 +255,7 @@ class Stem:
         on_halt = getattr(self.tile, "on_halt", None)
         if on_halt is not None:
             on_halt()
+        if tr is not None:
+            self._trace_flush(tr)
+            tr.event(trace_ev.EV_HALT)
         cnc.state = CNC_HALT

@@ -157,6 +157,15 @@ class Supervisor:
                             for tn in plan["tiles"]}
         self._slot_views = {tn: self._build_slots(tn)
                             for tn in plan["tiles"]}
+        # fdtrace: writers over each traced tile's flight-recorder
+        # ring (None when untraced). The supervisor only appends AFTER
+        # the owning tile is dead/killed, so the single-writer rule
+        # holds at every instant that matters; blackbox holds the
+        # last dump path per tile (the post-mortem artifact).
+        from ..trace import writer_for
+        self._trace = {tn: writer_for(plan, wksp, tn)
+                       for tn in plan["tiles"]}
+        self.blackbox: dict[str, str] = {}
 
     # -- shm counter helpers ------------------------------------------------
 
@@ -194,6 +203,27 @@ class Supervisor:
 
     def _in_fseqs(self, tn: str):
         return self._tile_fseqs[tn]
+
+    # -- flight-recorder integration ----------------------------------------
+
+    def _trace_mark(self, tn: str, etype: int):
+        tr = self._trace.get(tn)
+        if tr is not None:
+            tr.event(etype)
+
+    def _dump_blackbox(self, tn: str, reason: str):
+        """Snapshot the dying tile's last-N trace events out of shm
+        before any restart — the black-box record the watchdog used to
+        lack: when it trips we now know the last thing the tile did."""
+        from ..trace import dump_blackbox
+        if self._trace.get(tn) is None:
+            return
+        try:
+            path = dump_blackbox(self.plan, self.wksp, tn, reason)
+        except OSError:
+            return                        # dump must never block recovery
+        if path:
+            self.blackbox[tn] = path
 
     # -- policy machinery ---------------------------------------------------
 
@@ -248,6 +278,8 @@ class Supervisor:
                 st.next_restart_t = now + st.backoff_s
                 return
         self._bump(tn, "sup_restarts")
+        from ..trace.events import EV_RESTART
+        self._trace_mark(tn, EV_RESTART)   # before the respawn owns it
         self._spawn(tn, rejoin=True)
         st.down_since = None
         st.fseq_marks.clear()
@@ -272,23 +304,37 @@ class Supervisor:
         cnc = self._cnc(tn)
         if cnc.state != CNC_RUN:
             return None                 # boot compile / halting: exempt
-        from . import topo as topo_mod
-        age_s = max(0, topo_mod.now_ticks() - cnc.last_heartbeat) / 1e9
+        # heartbeats are stamped with the SAME monotonic-ns source
+        # (utils/tempo.monotonic_ns == native fdtpu_ticks) that fdtrace
+        # events carry, so a watchdog decision and the dumped trace
+        # share one timeline
+        from ..utils.tempo import monotonic_ns
+        age_s = max(0, monotonic_ns() - cnc.last_heartbeat) / 1e9
         if age_s > deadline:
             return f"heartbeat stale {age_s:.2f}s"
         # consumer-progress watch: an fseq that stopped advancing while
         # its producer sits blocked on it (ring full against this
-        # consumer) is a wedged consumer even with fresh heartbeats
+        # consumer) is a wedged consumer even with fresh heartbeats.
+        # The staleness clock starts when the consumer first becomes
+        # BLOCKED-AGAINST (same fseq value AND backlog >= depth), not
+        # when the value was first observed — a consumer idle behind a
+        # slow-starting producer is waiting, not wedged, and must not
+        # be killed the instant the ring fills (mark = (val, t_blocked);
+        # t_blocked is None while the ring is not full against it)
         st = self.state[tn]
         for ln, fs in self._in_fseqs(tn):
             val = fs.query()
-            prev = st.fseq_marks.get(ln)
-            if prev is None or prev[0] != val:
-                st.fseq_marks[ln] = (val, now)
-                continue
             ring = self._rings[ln]
             backlog = ring.seq - val
-            if backlog >= ring.depth and now - prev[1] > deadline:
+            blocked = backlog >= ring.depth   # stale sentinel: negative
+            prev = st.fseq_marks.get(ln)
+            if prev is None or prev[0] != val or not blocked:
+                st.fseq_marks[ln] = (val, now if blocked else None)
+                continue
+            if prev[1] is None:
+                st.fseq_marks[ln] = (val, now)
+                continue
+            if now - prev[1] > deadline:
                 return (f"consumer stalled on {ln} "
                         f"(backlog {backlog} >= depth {ring.depth})")
         return None
@@ -316,6 +362,9 @@ class Supervisor:
                 code = p.exitcode
                 if code in (0, None) or self._cnc(tn).state == CNC_HALT:
                     continue             # clean exit: not a failure
+                from ..trace.events import EV_DOWN
+                self._trace_mark(tn, EV_DOWN)
+                self._dump_blackbox(tn, f"died (exit {code})")
                 if pol["policy"] == "restart":
                     events.append(f"died {tn} (exit {code})")
                     self._mark_down(tn, now, code)
@@ -328,6 +377,13 @@ class Supervisor:
                 self._bump(tn, "sup_watchdog_trips")
                 self._cnc(tn).state = CNC_FAIL
                 self._kill(tn)
+                # black-box record: the wedged tile's final events,
+                # stamped with the trip, BEFORE any restart reuses the
+                # ring (the trip's raison d'etre — we finally know the
+                # last thing the tile was doing)
+                from ..trace.events import EV_WATCHDOG
+                self._trace_mark(tn, EV_WATCHDOG)
+                self._dump_blackbox(tn, f"watchdog: {reason}")
                 if pol["policy"] == "restart":
                     self._mark_down(tn, now, self._procs()[tn].exitcode)
                 else:

@@ -38,7 +38,11 @@ def _single(d: dict, what: str, tile: str):
 def _gather_all(ctx, seqs: dict, mtus: dict, batch: int, handle,
                 m: dict) -> int:
     """Shared multi-in-link poll loop: gather each ring, count
-    overruns into m['overruns'], dispatch every frame to handle."""
+    overruns into m['overruns'], dispatch every frame to handle.
+    With tracing on, every consumed frag leaves a (sampled) lineage
+    record keyed by its sig — the downstream half of the cross-tile
+    frag-lineage chain."""
+    tr = getattr(ctx, "trace", None)
     total = 0
     for ln, ring in ctx.in_rings.items():
         if ln not in seqs:
@@ -46,6 +50,11 @@ def _gather_all(ctx, seqs: dict, mtus: dict, batch: int, handle,
         n, seqs[ln], buf, sizes, sigs, ovr = ring.gather(
             seqs[ln], batch, mtus[ln])
         m["overruns"] += ovr
+        if tr is not None and n:
+            from ..trace.events import EV_CONSUME
+            lid = tr.link_id(ln)
+            for i in range(n):
+                tr.frag(EV_CONSUME, sig=int(sigs[i]), link=lid)
         for i in range(n):
             handle(bytes(buf[i, :sizes[i]]))
         total += n
@@ -128,6 +137,12 @@ class SynthAdapter:
             np.ones(b, np.uint8), fseqs=self.fseqs)
         if stop < b:
             self.bp += 1
+        tr = getattr(self.ctx, "trace", None)
+        if tr is not None and pub:
+            from ..trace.events import EV_PUBLISH
+            lid = tr.link_id(next(iter(self.ctx.out_rings)))
+            for s in range(self.sent, self.sent + pub):
+                tr.frag(EV_PUBLISH, sig=s, link=lid)
         self.sent += pub
         return pub
 
@@ -160,6 +175,7 @@ class VerifyAdapter:
         kw = {}
         if "device_timeout_s" in args:
             kw["device_timeout_s"] = float(args["device_timeout_s"])
+        out_ln = next(iter(ctx.out_rings))
         self.tile = VerifyTile(
             in_ring, out_ring, tc,
             batch=int(args.get("batch", 256)),
@@ -171,7 +187,12 @@ class VerifyAdapter:
             devices=int(args.get("devices", 1)),
             device_retries=int(args.get("device_retries", 2)),
             device_fail_limit=int(args.get("device_fail_limit", 3)),
-            chaos=args.get("chaos"), **kw)
+            chaos=args.get("chaos"),
+            trace=ctx.trace,
+            trace_link=(ctx.trace.link_id(out_ln)
+                        if ctx.trace is not None else 0),
+            trace_link_in=(ctx.trace.link_id(next(iter(ctx.in_rings)))
+                           if ctx.trace is not None else 0), **kw)
         self.tile._cnc = ctx.cnc
         self.in_link = next(iter(ctx.in_rings))
         self.tile.seq = ctx.in_seq0.get(self.in_link, 0)
@@ -210,8 +231,19 @@ class DedupAdapter:
         self.seqs = ctx.in_seqs0()
         self.mtu = max(ctx.plan["links"][ln]["mtu"] for ln in ctx.in_rings)
         self.m = {k: 0 for k in self.METRICS}
+        # trace link ids resolved ONCE — the per-frag hook below must
+        # stay a bare method call on the traced path
+        self._tr = getattr(ctx, "trace", None)
+        if self._tr is not None:
+            out_ln = next(iter(ctx.out_rings))
+            self._tr_out = self._tr.link_id(out_ln)
+            self._tr_ins = {ln: self._tr.link_id(ln)
+                            for ln in ctx.in_rings}
 
     def poll_once(self) -> int:
+        tr = self._tr
+        if tr is not None:
+            from ..trace.events import EV_CONSUME, EV_PUBLISH
         total = 0
         for ln, ring in self.ctx.in_rings.items():
             n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
@@ -222,15 +254,20 @@ class DedupAdapter:
             total += n
             self.m["rx"] += n
             for i in range(n):
-                if self.tcache.insert(int(sigs[i])):
+                sig = int(sigs[i])
+                if tr is not None:
+                    tr.frag(EV_CONSUME, sig=sig, link=self._tr_ins[ln])
+                if self.tcache.insert(sig):
                     self.m["dup"] += 1
                     continue
                 while self.out_fseqs and \
                         self.out.credits(self.out_fseqs) <= 0:
                     self.m["backpressure"] += 1
                     time.sleep(20e-6)
-                self.out.publish(buf[i, :sizes[i]], sig=int(sigs[i]))
+                self.out.publish(buf[i, :sizes[i]], sig=sig)
                 self.m["tx"] += 1
+                if tr is not None:
+                    tr.frag(EV_PUBLISH, sig=sig, link=self._tr_out)
         return total
 
     def in_seqs(self):

@@ -66,12 +66,16 @@ class TileSpec:
 class Topology:
     """Builder. Declare links/tiles/objects, then build() into a wksp."""
 
-    def __init__(self, name: str, wksp_size: int = 1 << 26):
+    def __init__(self, name: str, wksp_size: int = 1 << 26,
+                 trace: dict | None = None):
         self.name = name
         self.wksp_size = wksp_size
         self.links: dict[str, LinkSpec] = {}
         self.tiles: dict[str, TileSpec] = {}
         self.tcaches: dict[str, int] = {}           # name -> depth
+        # [trace] flight-recorder config (trace/recorder.py schema);
+        # validated at build so a typo fails before launch
+        self.trace = trace
 
     def link(self, name: str, depth: int = 128, mtu: int = 1280,
              external: bool = False):
@@ -148,8 +152,17 @@ class Topology:
             for name, depth in self.tcaches.items():
                 tc = Tcache(w, depth=depth)
                 plan["tcaches"][name] = {"off": tc.off, "depth": depth}
+            from ..trace import effective_trace, normalize_trace
+            from ..runtime import TraceRing
             from .metrics import HIST_REGION_U64
             from .supervise import SUP_SLOT_MIN, normalize_policy
+            trace_cfg = normalize_trace(self.trace)
+            unknown = set(trace_cfg["tiles"] or ()) - set(self.tiles)
+            if unknown:
+                raise ValueError(
+                    f"trace.tiles names unknown tile(s) "
+                    f"{sorted(unknown)}")
+            plan["trace"] = trace_cfg
             for tn, t in self.tiles.items():
                 for i in t.ins:
                     if i["reliable"]:
@@ -183,6 +196,18 @@ class Topology:
                     "metrics_names": names,
                     "metrics_gauges": _metric_gauges(t.kind),
                 }
+                # flight-recorder ring, carved next to the metric
+                # slots (trace/recorder.py resolves topology default
+                # + per-tile override; untraced tiles get NO region
+                # and NO plan keys — TileCtx.trace stays None)
+                eff = effective_trace(
+                    trace_cfg, tn,
+                    normalize_trace(t.args.get("trace"), per_tile=True))
+                if eff is not None:
+                    tr = TraceRing.create(w, eff["depth"])
+                    plan["tiles"][tn]["trace_off"] = tr.off
+                    plan["tiles"][tn]["trace_depth"] = eff["depth"]
+                    plan["tiles"][tn]["trace_sample"] = eff["sample"]
                 if t.kind == "sign":
                     # live identity hot-swap region (fd_keyswitch)
                     from ..keyguard.keyswitch import FOOTPRINT as KS_FP
@@ -257,6 +282,12 @@ class TileCtx:
             for name, tc in plan["tcaches"].items()
         }
 
+        # flight recorder (fdtrace): None unless topo.build carved a
+        # ring for this tile — the None IS the disabled fast path
+        # (every hook is a single attribute check, trace/__init__.py)
+        from ..trace import writer_for
+        self.trace = writer_for(plan, self.wksp, tile_name)
+
     def in_seqs0(self) -> dict[str, int]:
         """Initial consume cursor per in link: 0 on a fresh boot, the
         producer's current seq on a supervised restart (ring rejoin)."""
@@ -293,4 +324,7 @@ def read_heartbeat(wksp: Workspace, plan: dict, tile_name: str) -> int:
 
 
 def now_ticks() -> int:
-    return lib.fdtpu_ticks()
+    # ONE clock for heartbeats, watchdog staleness, and trace
+    # timestamps (utils/tempo.monotonic_ns == native fdtpu_ticks)
+    from ..utils.tempo import monotonic_ns
+    return monotonic_ns()

@@ -78,6 +78,11 @@ RULES: dict[str, tuple[str, str, str]] = {
         "graph", "error",
         "tile arg references an unknown link/tile/tcache, or a link "
         "outside the tile's declared ins/outs"),
+    "bad-trace": (
+        "graph", "error",
+        "[trace] section or [tile.trace] table rejected by the fdtrace "
+        "schema (unknown key, non-power-of-two depth, sample < 1) or "
+        "trace.tiles names an undeclared tile"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

@@ -74,7 +74,8 @@ def model_from_config(cfg: dict) -> dict:
                             "ins": _norm_ins(t.get("ins")),
                             "outs": list(t.get("outs", ())),
                             "args": args}
-    return {"links": links, "tcaches": tcaches, "tiles": tiles}
+    return {"links": links, "tcaches": tcaches, "tiles": tiles,
+            "trace": cfg.get("trace")}
 
 
 def model_from_topology(topo) -> dict:
@@ -87,7 +88,7 @@ def model_from_topology(topo) -> dict:
                   "outs": list(t.outs), "args": dict(t.args)}
              for tn, t in topo.tiles.items()}
     return {"links": links, "tcaches": set(topo.tcaches),
-            "tiles": tiles}
+            "tiles": tiles, "trace": getattr(topo, "trace", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +229,35 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_mtus(model, lines))
     out.extend(_check_cycles(model, producers, lines))
     out.extend(_check_tiles(model, kinds, lines))
+    out.extend(_check_trace(model, path, lines))
+    return out
+
+
+def _check_trace(model, path, lines) -> list[Finding]:
+    """[trace] section + [tile.trace] overrides: the fdtrace schema
+    gate (trace/recorder.py is the one validator) plus tile-name
+    resolution for the `tiles` allowlist."""
+    from ..trace import normalize_trace
+    out: list[Finding] = []
+    spec = model.get("trace")
+    if spec is not None:
+        try:
+            norm = normalize_trace(spec)
+        except Exception as e:
+            out.append(finding("bad-trace", path, 0, f"[trace]: {e}"))
+        else:
+            for tn in norm["tiles"] or ():
+                if tn not in model["tiles"]:
+                    _emit(out, lines, "bad-trace", tn,
+                          f"[trace] tiles entry {tn!r} is not a "
+                          f"declared tile"
+                          + reg.suggest(str(tn), model["tiles"]))
+    for tn, t in model["tiles"].items():
+        if "trace" in t["args"]:
+            try:
+                normalize_trace(t["args"]["trace"], per_tile=True)
+            except Exception as e:
+                _emit(out, lines, "bad-trace", tn, f"tile {tn!r}: {e}")
     return out
 
 

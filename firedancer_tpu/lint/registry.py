@@ -27,12 +27,20 @@ IN, OUT, IN_LIST, OUT_LIST, TCACHE, TILE = (
 COMMON_KEYS: dict[str, str | None] = {
     "supervise": None,      # disco/supervise.py policy table
     "chaos": None,          # utils/chaos.py fault plan
+    "trace": None,          # trace/recorder.py per-tile override table
     "cpu_idx": None,        # launch: sched_setaffinity pin
     "sandbox": None,        # launch: utils/sandbox hardening
     "sandbox_files": None,
     "lazy_ns": None,        # stem: pinned housekeeping cadence
     "lazy_auto": None,      # stem: depth-derived cadence
 }
+
+# [trace] topology-section keys (mirror of trace/recorder.py
+# TRACE_DEFAULTS / TILE_TRACE_KEYS — tests/test_trace.py keeps the
+# mirror honest). `tiles` entries are tile-name references, resolved by
+# the graph analyzer's bad-trace check.
+TRACE_SECTION_KEYS = ("enable", "depth", "sample", "tiles")
+TILE_TRACE_KEYS = ("enable", "depth", "sample")
 
 TILE_ARGS: dict[str, dict[str, str | None]] = {
     "synth": {"count": None, "burst": None, "unique": None, "seed": None},

@@ -91,7 +91,9 @@ class VerifyTile:
                  rr_cnt: int = 1, rr_idx: int = 0, devices: int = 1,
                  device_retries: int = 2,
                  device_timeout_s: float | None = None,
-                 device_fail_limit: int = 3, chaos: dict | None = None):
+                 device_fail_limit: int = 3, chaos: dict | None = None,
+                 trace=None, trace_link: int = 0,
+                 trace_link_in: int = 0):
         self.in_ring, self.out_ring, self.tcache = in_ring, out_ring, tcache
         # horizontal sharding: N verify tiles consume the SAME ingest
         # link; tile rr_idx owns frags with seq % rr_cnt == rr_idx
@@ -139,6 +141,13 @@ class VerifyTile:
         if chaos:
             from ..utils.chaos import ChaosPlan
             self._chaos = ChaosPlan(chaos)
+        # fdtrace flight recorder (None = untraced, zero hot-path cost:
+        # every hook below is one attribute check). Device dispatch /
+        # readback / fallback transitions are the TPU-observability
+        # events the host-side trace exists for.
+        self._trace = trace
+        self._trace_link = trace_link
+        self._trace_link_in = trace_link_in
         if backend == "jax":
             import jax
             if jax.devices()[0].platform == "cpu":
@@ -250,6 +259,9 @@ class VerifyTile:
         if not self.degraded:
             self.degraded = True
             self.metrics["cpu_fallback"] = 1
+            if self._trace is not None:
+                from ..trace.events import EV_CPU_FALLBACK
+                self._trace.event(EV_CPU_FALLBACK)
             from ..utils import log
             log.warning(f"verify: degrading to CPU reference path ({why})")
 
@@ -291,7 +303,17 @@ class VerifyTile:
             try:
                 if self._chaos is not None and \
                         self._chaos.take_dispatch_failure():
+                    if self._trace is not None:
+                        from ..trace import chaos_event
+                        chaos_event(self._trace, "fail_dispatch")
                     raise ChaosDeviceError("injected dispatch failure")
+                if self._trace is not None:
+                    from ..trace.events import EV_TPU_DISPATCH
+                    from ..utils.tempo import monotonic_ns
+                    t0 = monotonic_ns()
+                    fut = self._device_verify(sig, pub, msg, ln)
+                    self._trace.span(EV_TPU_DISPATCH, t0, count=lanes)
+                    return fut
                 return self._device_verify(sig, pub, msg, ln)
             except Exception:
                 self.metrics["device_errors"] += 1
@@ -377,8 +399,15 @@ class VerifyTile:
             if not n:
                 return consumed
         else:
-            buf, sizes = buf[:n], sizes[:n]
+            buf, sizes, sigs = buf[:n], sizes[:n], sigs[:n]
         self.metrics["rx"] += n
+        if self._trace is not None:
+            # ingest lineage anchors (sampled): the upstream producer's
+            # sig, so synth/quic -> verify hand-offs correlate too
+            from ..trace.events import EV_CONSUME
+            for s in sigs:
+                self._trace.frag(EV_CONSUME, sig=int(s),
+                                 link=self._trace_link_in)
 
         sizes = np.asarray(sizes, np.uint32)
         meta, tags = parse_batch(buf, sizes, self.dedup_seed)
@@ -520,6 +549,19 @@ class VerifyTile:
         n, cand = rec["n"], rec["cand"]
         txn_ok = cand.copy()
         covered = np.zeros(n, bool)
+        rb_t0 = 0
+        if self._trace is not None:
+            from ..utils.tempo import monotonic_ns
+            rb_t0 = monotonic_ns()
+
+        def _rb_span():
+            # TPU-attributed time ONLY: closes at the end of the
+            # device-verdict wait — never around the CPU re-verify
+            # fallback, which would blame the device for host work
+            if self._trace is not None:
+                from ..trace.events import EV_TPU_READBACK
+                self._trace.span(EV_TPU_READBACK, rb_t0,
+                                 count=len(rec["chunks"]))
         try:
             had_device = False
             for fut, live in rec["chunks"]:
@@ -532,10 +574,14 @@ class VerifyTile:
             txn_ok &= covered
             if had_device:
                 self._consec_fail = 0    # a healthy device round-trip
+                _rb_span()
         except Exception:
             # lost verdicts (device died mid-flight / readback timeout):
             # recompute the whole record on the CPU reference path — the
-            # batch still serves rather than dropping or crashing
+            # batch still serves rather than dropping or crashing. The
+            # readback span closes HERE (the device wait up to the
+            # failure), before the CPU re-verify starts.
+            _rb_span()
             self.metrics["device_errors"] += 1
             self._consec_fail += 1
             if self._consec_fail >= self.device_fail_limit:
@@ -568,6 +614,15 @@ class VerifyTile:
             if not self._wait_credits():
                 break               # halted while backpressured
         self.metrics["tx"] += fwd
+        if self._trace is not None and fwd:
+            # frag-lineage anchors: one (sampled) publish record per
+            # forwarded txn, keyed by its dedup tag — the sig the
+            # downstream consume hooks carry, so one microbatch is
+            # followable verify -> dedup -> pack across rings
+            from ..trace.events import EV_PUBLISH
+            for i in np.nonzero(mask)[0]:
+                self._trace.frag(EV_PUBLISH, sig=int(rec["tags"][i]),
+                                 link=self._trace_link)
 
     def _resolve_deferred(self, released_tags):
         """Decide duplicates parked while their tag was in flight: the
@@ -605,6 +660,10 @@ class VerifyTile:
         if not self.out_fseqs or self.out_ring.credits(self.out_fseqs) > 0:
             return True
         self.metrics["backpressure"] += 1
+        bp_t0 = 0
+        if self._trace is not None:
+            from ..utils.tempo import monotonic_ns
+            bp_t0 = monotonic_ns()
         spins = 0
         while self.out_ring.credits(self.out_fseqs) <= 0:
             spins += 1
@@ -615,6 +674,12 @@ class VerifyTile:
                     if self._cnc.state != CNC_RUN:
                         return False
                 time.sleep(50e-6)
+        if self._trace is not None:
+            # backpressure-wait attribution: the whole credit stall as
+            # ONE span on the out link (not one event per spin)
+            from ..trace.events import EV_BACKPRESSURE
+            self._trace.span(EV_BACKPRESSURE, bp_t0,
+                             link=self._trace_link)
         return True
 
     def flush(self):

@@ -14,6 +14,26 @@ from __future__ import annotations
 
 import time
 
+_MONO = None
+
+
+def monotonic_ns() -> int:
+    """THE shared monotonic-ns clock (CLOCK_MONOTONIC): cnc heartbeats
+    are stamped with the native fdtpu_ticks, so every reader that
+    compares against them — the supervisor's staleness checks, the
+    fdtrace event timestamps — must read the SAME source or watchdog
+    decisions and traces drift apart. Falls back to time.monotonic_ns
+    (the same kernel clock on Linux) when the native runtime is not
+    loadable (pure-python tooling contexts)."""
+    global _MONO
+    if _MONO is None:
+        try:
+            from ..runtime.tango import lib
+            _MONO = lib.fdtpu_ticks
+        except Exception:
+            _MONO = time.monotonic_ns
+    return int(_MONO())
+
 
 def tick_per_ns(trials: int = 9, window_s: float = 0.002) -> float:
     """Median ratio of perf_counter ticks to wallclock ns (the joint

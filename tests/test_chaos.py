@@ -206,11 +206,22 @@ def test_stalled_consumer_fseq_recovers_via_watchdog():
     heartbeating and consuming: the producer backpressures on a full
     ring, the watchdog's consumer-progress check trips, the sink is
     restarted with a tail rejoin, and the producer finishes every
-    send — the topology never wedges."""
+    send — the topology never wedges.
+
+    With the flight recorder armed, the WHOLE causal chain must also be
+    reconstructable post-hoc: the chaos injection and the watchdog trip
+    land in the supervisor's black-box dump (snapshotted from shm at
+    trip time, before the restart reuses the ring), and the restart +
+    respawned boot land in the live ring after it — fault ->
+    watchdog-trip -> restart, in timestamp order, from trace data
+    alone."""
+    import json
+
     from firedancer_tpu.disco import Topology, TopologyRunner
     n = 600
     topo = (
-        Topology(f"cs{os.getpid()}", wksp_size=1 << 22)
+        Topology(f"cs{os.getpid()}", wksp_size=1 << 22,
+                 trace={"enable": True, "depth": 1024, "sample": 1})
         .link("a_b", depth=32, mtu=256)
         .tile("a", "synth", outs=["a_b"], count=n, unique=16, burst=8)
         .tile("b", "sink", ins=["a_b"],
@@ -237,6 +248,40 @@ def test_stalled_consumer_fseq_recovers_via_watchdog():
         b = runner.metrics("b")
         assert b["sup_watchdog_trips"] >= 1
         assert b["sup_restarts"] >= 1
+
+        # -- black-box reconstruction (fdtrace) ---------------------------
+        path = runner.supervisor.blackbox["b"]
+        with open(path) as f:
+            dump = json.load(f)
+        assert dump["tile"] == "b" and "watchdog" in dump["reason"]
+        evs = dump["events"]
+        chaos_ts = [e["ts"] for e in evs if e["ev"] == "chaos"]
+        trip_ts = [e["ts"] for e in evs if e["ev"] == "watchdog"]
+        assert chaos_ts and trip_ts, [e["ev"] for e in evs]
+        assert chaos_ts[0] < trip_ts[-1]       # fault BEFORE the trip
+        # the injected action is named in the dump's chrome view
+        from firedancer_tpu.trace.events import CHAOS_ACTION_IDS
+        assert [e["count"] for e in evs if e["ev"] == "chaos"][0] \
+            == CHAOS_ACTION_IDS["stall_fseq"]
+        # ...and the dump is directly Perfetto-openable
+        assert any(e.get("name") == "watchdog"
+                   for e in dump["chrome"]["traceEvents"])
+
+        # live ring: restart marker + the respawned tile's boot, both
+        # AFTER the trip — the recorder survives the tile's death
+        from firedancer_tpu.trace import read_rings
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            live = read_rings(runner.plan, runner.wksp)["b"]
+            boots = [e["ts"] for e in live if e["ev"] == "boot"
+                     and e["ts"] > trip_ts[-1]]
+            if boots:
+                break
+            time.sleep(0.05)
+        restarts = [e["ts"] for e in live if e["ev"] == "restart"]
+        assert restarts and boots, [e["ev"] for e in live[-12:]]
+        assert trip_ts[-1] <= restarts[-1] <= boots[-1]
+        os.unlink(path)                    # test hygiene (/dev/shm)
     finally:
         runner.halt(join_timeout_s=10)
         runner.close()

@@ -212,6 +212,36 @@ def test_dangling_ref():
     fires_once(lint_config(cfg, "<fixture>"), "dangling-ref")
 
 
+def test_bad_trace_unknown_key():
+    cfg = _cfg(trace={"enable": True, "dept": 64})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-trace")
+    assert "did you mean 'depth'" in findings[0].message
+
+
+def test_bad_trace_depth_and_tile_override():
+    fires_once(lint_config(_cfg(trace={"enable": True, "depth": 100}),
+                           "<fixture>"), "bad-trace")
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"],
+         "trace": {"sample": 0}}])
+    fires_once(lint_config(cfg, "<fixture>"), "bad-trace")
+
+
+def test_bad_trace_unknown_tile_allowlist():
+    cfg = _cfg(trace={"enable": True, "tiles": ["ghost"]})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-trace")
+    assert "not a declared tile" in findings[0].message
+
+
+def test_trace_section_is_clean_when_valid():
+    cfg = _cfg(trace={"enable": True, "depth": 256, "sample": 4,
+                      "tiles": ["dst"]})
+    assert lint_config(cfg, "<fixture>") == []
+
+
 def test_lint_topology_programmatic():
     """Programmatic Topology builds get the same pass as TOML."""
     from firedancer_tpu.disco import Topology

@@ -8,13 +8,16 @@ import urllib.request
 
 import pytest
 
-pytestmark = pytest.mark.slow
-
 from firedancer_tpu.disco import Topology, TopologyRunner
 from firedancer_tpu.disco.metrics import (
-    NBUCKETS, HistAccum, bucket_of, quantile_ns, read_hists,
+    HIST_U64, NBUCKETS, HistAccum, bucket_of, quantile_ns, read_hists,
 )
 from firedancer_tpu.disco.monitor import attach, snapshot
+
+# the histogram/quantile unit tests below run in tier-1; only the
+# live-topology pipeline tests are slow-marked (the fixture spawns
+# processes)
+slow = pytest.mark.slow
 
 
 def test_bucket_of_log2():
@@ -35,6 +38,46 @@ def test_quantile_upper_bound():
     assert quantile_ns(d, 0.99) == 16384      # 2^(13+1): bucket of 10_000
     assert quantile_ns({"count": 0, "sum_ns": 0,
                         "buckets": [0] * NBUCKETS}, 0.5) == 0
+
+
+def test_quantile_edges_empty_and_q0_q1():
+    """Histogram edge pins: an EMPTY histogram is 0 at every q; q=0.0
+    is the minimum sample's bucket bound — NOT bucket 0's bound when
+    bucket 0 is empty — and q=1.0 is the maximum sample's bound."""
+    empty = {"count": 0, "sum_ns": 0, "buckets": [0] * NBUCKETS}
+    assert quantile_ns(empty, 0.0) == 0
+    assert quantile_ns(empty, 1.0) == 0
+    h = HistAccum()
+    for ns in [10, 10_000]:
+        h.add(ns)
+    d = {"count": h.count, "sum_ns": h.sum_ns, "buckets": h.buckets}
+    assert quantile_ns(d, 0.0) == 16          # min sample's bucket (10)
+    assert quantile_ns(d, 1.0) == 16384       # max sample's bucket (10k)
+    # a single sample far from bucket 0: q=0 must still find it
+    h1 = HistAccum()
+    h1.add(1 << 20)
+    d1 = {"count": h1.count, "sum_ns": h1.sum_ns, "buckets": h1.buckets}
+    assert quantile_ns(d1, 0.0) == quantile_ns(d1, 1.0) == 1 << 21
+
+
+def test_flush_into_is_idempotent():
+    """flush_into overwrites (cumulative counts, single writer): a
+    second flush with no new samples must add NOTHING — a += bug here
+    would double every counter each housekeeping pass."""
+    import numpy as np
+    h = HistAccum()
+    for ns in [5, 50, 500]:
+        h.add(ns)
+    view = np.zeros(HIST_U64, np.uint64)
+    h.flush_into(view)
+    first = view.copy()
+    h.flush_into(view)                    # no adds in between
+    assert (view == first).all()
+    assert int(view[0]) == 3 and int(view[1]) == 555
+    assert int(view[2:].sum()) == 3
+    h.add(7)                              # and a real add still lands
+    h.flush_into(view)
+    assert int(view[0]) == 4 and int(view[2:].sum()) == 4
 
 
 @pytest.fixture(scope="module")
@@ -58,6 +101,7 @@ def pipeline():
         runner.close()
 
 
+@slow
 def test_plan_carries_slot_names(pipeline):
     tiles = pipeline.plan["tiles"]
     assert tiles["synth"]["metrics_names"] == ["tx", "backpressure"]
@@ -67,6 +111,7 @@ def test_plan_carries_slot_names(pipeline):
     assert pipeline.metrics("sink")["rx"] == 32
 
 
+@slow
 def test_histograms_populate(pipeline):
     # one housekeeping flush after the traffic
     deadline = time.time() + 30
@@ -90,6 +135,7 @@ def test_histograms_populate(pipeline):
         wksp.close()
 
 
+@slow
 def test_prometheus_endpoint(pipeline):
     port = pipeline.metrics("metric")["port"]
     assert port > 0
