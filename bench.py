@@ -192,7 +192,9 @@ def _e2e_bench():
     inferred from the env the tiles will see."""
     sys.path.insert(0, HERE)
     from firedancer_tpu.disco import Topology, TopologyRunner
-    from firedancer_tpu.disco.metrics import quantile_ns, read_hists
+    from firedancer_tpu.disco.metrics import (link_lag, merge_hists,
+                                              quantile_ns, read_hists,
+                                              read_link_metrics)
 
     # sizing against the ~60 ms tunnel dispatch latency: throughput
     # ceiling ~= batch * inflight / latency, so 2048 * 3 / 60ms ~= 100K
@@ -247,12 +249,35 @@ def _e2e_bench():
                 if work else 0,
                 "occupancy": round(busy, 3),
             }
+        # per-link attribution (fdmetrics v2): WHERE the hot-path time
+        # and backpressure went, hop by hop — published/consumed (loss
+        # per hop), producer backpressure ticks, and the consumer-side
+        # consume-latency quantiles — so the bench trajectory records
+        # which hop throttles end-to-end TPS, not just the number
+        link_budget = {}
+        for ln, rec in read_link_metrics(runner.wksp,
+                                         runner.plan).items():
+            cons = rec["consumers"]
+            # link-level quantiles over ALL consumers (rr-sharded
+            # verify), loss = the shared per-consumer lag definition
+            h = merge_hists(c["hist"] for c in cons.values())
+            link_budget[ln] = {
+                "pub": rec["pub"],
+                "consumed": sum(c["consumed"] for c in cons.values()),
+                "lost": sum(link_lag(rec, tn) for tn in cons),
+                "backpressure": rec["backpressure"],
+                "consume_p50_us": round(quantile_ns(h, 0.50) / 1e3, 1)
+                if h else 0,
+                "consume_p99_us": round(quantile_ns(h, 0.99) / 1e3, 1)
+                if h else 0,
+            }
         out = {
             "e2e_tps": round(count / wall, 1),
             "e2e_count": count,
             "e2e_wall_s": round(wall, 2),
             "e2e_verify_work_p99_ms": round(p99_ms, 2),
             "e2e_stage_budget": budget,
+            "e2e_link_budget": link_budget,
             "platform": os.environ.get("FDTPU_JAX_PLATFORM") or "device",
         }
     finally:

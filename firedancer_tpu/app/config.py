@@ -52,6 +52,18 @@ Schema:
     [tile.trace]             # per-tile override (opt out/in, depth,
     sample = 16              #  sample) — highest precedence
 
+    [slo]                    # service-level objectives (disco/slo.py),
+    fast_window_s = 5.0      #  evaluated by the metric tile; breaches
+    slow_window_s = 60.0     #  flip its slo_breach gauge, leave an
+    burn_fast = 1.0          #  EV_SLO trace event, and dump next to
+    burn_slow = 0.5          #  the supervisor black boxes
+
+    [[slo.target]]           # one objective per table (merged by name
+    name = "verify-latency"  #  across layers); expr grammar:
+    expr = "verify.work p99 < 500us"   # <source> [agg] <op> <threshold>
+                             #  sources: tile.metric, tile.wait|work|tpu,
+                             #  link.<link>.<counter>
+
     [[tile.chaos.events]]    # seeded fault plan (utils/chaos.py):
     action = "crash"         #  crash | freeze_hb | wedge | stall_fseq
     at_rx = 24               #  | fail_dispatch (verify tile); fire at
@@ -80,7 +92,7 @@ except ModuleNotFoundError:          # py<3.11
                 "no TOML parser available on this Python (<3.11): "
                 "install 'tomli'") from e
 
-_TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace"}
+_TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -129,9 +141,17 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
             if key in layer:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
-        for key in ("topology", "trace"):
+        for key in ("topology", "trace", "slo"):
             if key in layer:
-                cfg[key] = _deep_merge(cfg.get(key, {}), layer[key])
+                merged = _deep_merge(cfg.get(key, {}), layer[key])
+                if key == "slo" and "target" in layer[key]:
+                    # [[slo.target]] arrays merge by name like
+                    # [[link]]/[[tile]]: an overlay can tighten one
+                    # objective without restating the rest
+                    merged["target"] = _merge_named_lists(
+                        cfg.get(key, {}).get("target", []),
+                        layer[key]["target"], str(p))
+                cfg[key] = merged
     return cfg
 
 
@@ -168,9 +188,16 @@ def build_topology(cfg: dict, name: str | None = None):
     trace_cfg = cfg.get("trace")
     if trace_cfg is not None:
         normalize_trace(trace_cfg)
+    # [slo] objectives — schema-validated here (fail at config load
+    # with a did-you-mean); target references resolve at topo.build
+    # once the declared tiles/links/metrics are known
+    from ..disco.slo import normalize_slo
+    slo_cfg = cfg.get("slo")
+    if slo_cfg is not None:
+        normalize_slo(slo_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
                     wksp_size=int(top.get("wksp_size", 1 << 26)),
-                    trace=trace_cfg)
+                    trace=trace_cfg, slo=slo_cfg)
     for ln in cfg.get("link", []):
         topo.link(ln["name"], depth=int(ln.get("depth", 128)),
                   mtu=int(ln.get("mtu", 1280)))

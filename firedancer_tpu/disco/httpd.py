@@ -1,0 +1,95 @@
+"""Shared HTTP plumbing for reader-side tiles (metric, gui).
+
+Both HTTP-serving tiles follow the reference's metric-tile shape
+(ref: src/disco/metrics/fd_metric_tile.c): the server renders straight
+from shared memory on a daemon thread while the tile loop stays idle,
+so the endpoint survives any other tile's death. This module is the
+ONE implementation of that shape — route table, ephemeral-port bind,
+clean shutdown — so adapters stop duplicating ThreadingHTTPServer
+boilerplate.
+
+Request counting is thread-safe by construction (`Counter` below):
+ThreadingHTTPServer runs each request on its own thread, so a bare
+`self.requests += 1` on the adapter is a read-modify-write race that
+loses counts under concurrent scrapes (the GuiAdapter bug this module
+retires).
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Counter:
+    """Lock-guarded monotone counter (handler threads bump, the tile
+    loop reads — plain `+=` would drop increments under concurrency)."""
+
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def bump(self) -> int:
+        with self._lock:
+            self._v += 1
+            return self._v
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class TileHttpServer:
+    """ThreadingHTTPServer on a daemon thread over a GET route table.
+
+    routes: {path: handler}; a handler takes no arguments and returns
+    (status, content_type, body_bytes). Handler exceptions become 500s
+    (a rendering bug must not kill the serving thread). `requests`
+    counts every handled request, thread-safely.
+    """
+
+    def __init__(self, routes: dict, port: int = 0,
+                 bind_addr: str = "127.0.0.1"):
+        self.routes = dict(routes)
+        self.requests = Counter()
+        plumbing = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                handler = plumbing.routes.get(self.path)
+                if handler is None:
+                    plumbing.requests.bump()
+                    self.send_error(404)
+                    return
+                try:
+                    status, ctype, body = handler()
+                except Exception as e:   # noqa: BLE001 — keep serving
+                    # the 500 must not be undiagnosable: this endpoint
+                    # IS the alerting surface, so a permanently-failing
+                    # renderer needs its cause in the tile's output
+                    from ..utils import log
+                    log.warning(f"http {self.path}: render failed: "
+                                f"{e!r}")
+                    status, ctype, body = (
+                        500, "text/plain", b"render failed\n")
+                plumbing.requests.bump()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # keep tile stdout quiet
+                pass
+
+        self.server = ThreadingHTTPServer((bind_addr, int(port)),
+                                          Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()

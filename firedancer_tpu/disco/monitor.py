@@ -5,7 +5,14 @@ shm and diff-prints per-tile status snapshots
 (ref: src/app/shared/commands/monitor/monitor.c:61,100,296-338).
 
 Usage:
-  python -m firedancer_tpu.disco.monitor <topology-name> [--watch SECS]
+  python -m firedancer_tpu.disco.monitor <topology-name> \
+      [--watch SECS] [--json]
+
+--watch clears and redraws the terminal each tick, marking counter
+deltas since the previous frame (the reference's diff-print); --json
+emits one machine-readable snapshot document per tick to stdout
+(NDJSON under --watch) — tiles, per-link telemetry, everything the
+table shows.
 
 Attaches via the plan JSON the runner drops in /dev/shm, so it works
 from any process with no coordination beyond the topology name.
@@ -58,21 +65,97 @@ def snapshot(plan: dict, wksp: Workspace) -> dict:
                 kind: {"count": h["count"],
                        "p50_us": quantile_ns(h, 0.50) / 1e3,
                        "p99_us": quantile_ns(h, 0.99) / 1e3}
-                for kind, h in hists.items()
+                for kind, h in hists.items() if h["count"]
             },
         }
     return out
 
 
-def format_table(snap: dict) -> str:
+def links_table(link_metrics: dict) -> dict:
+    """read_link_metrics output -> one JSON-able row per (link,
+    consumer): publish/consume counters, per-hop loss, backpressure,
+    and consume-latency quantiles — the fdmetrics v2 surface shared by
+    the monitor table, --json, and the metric tile's /summary.json."""
+    from .metrics import link_lag, quantile_ns
+    rows: dict = {}
+    for ln, rec in link_metrics.items():
+        consumers = {}
+        for tn, c in rec["consumers"].items():
+            h = c["hist"]
+            consumers[tn] = {
+                "consumed": c["consumed"],
+                "bytes": c["bytes"],
+                "overruns": c["overruns"],
+                "lag": link_lag(rec, tn),
+                "p50_us": quantile_ns(h, 0.50) / 1e3 if h["count"]
+                else 0.0,
+                "p99_us": quantile_ns(h, 0.99) / 1e3 if h["count"]
+                else 0.0,
+            }
+        rows[ln] = {
+            "producer": rec["producer"],
+            "pub": rec["pub"],
+            "pub_bytes": rec["pub_bytes"],
+            "backpressure": rec["backpressure"],
+            "consumers": consumers,
+        }
+    return rows
+
+
+def full_snapshot(plan: dict, wksp: Workspace) -> dict:
+    """Everything: tiles + per-link telemetry (the --json document)."""
+    from .metrics import read_link_metrics
+    return {
+        "topology": plan.get("topology", "?"),
+        "tiles": snapshot(plan, wksp),
+        "links": links_table(read_link_metrics(wksp, plan)),
+    }
+
+
+def _delta_str(v: int, prev: int | None) -> str:
+    if prev is None or v == prev:
+        return str(v)
+    return f"{v}(+{v - prev})" if v > prev else f"{v}({v - prev})"
+
+
+def format_table(snap: dict, prev: dict | None = None) -> str:
     lines = [f"{'tile':<14}{'kind':<10}{'state':<7}{'hb_age':>12}"
              f"{'work_p99us':>12}  metrics"]
     for tn, row in snap.items():
-        ms = " ".join(f"{k}={v}" for k, v in row["metrics"].items() if v)
+        pm = (prev or {}).get(tn, {}).get("metrics", {})
+        ms = " ".join(f"{k}={_delta_str(v, pm.get(k))}"
+                      for k, v in row["metrics"].items() if v)
         work = row.get("latency", {}).get("work", {})
         p99 = f"{work.get('p99_us', 0):.0f}" if work.get("count") else "-"
         lines.append(f"{tn:<14}{row['kind']:<10}{row['state']:<7}"
                      f"{row['hb_age_ticks']:>12}{p99:>12}  {ms}")
+    return "\n".join(lines)
+
+
+def format_links(links: dict) -> str:
+    """Per-link table: one row per (link, consumer) with publish /
+    consume / loss / backpressure and the consume-latency quantiles."""
+    if not links:
+        return ""
+    lines = [f"{'link':<18}{'producer':<12}{'consumer':<12}"
+             f"{'pub':>10}{'consumed':>10}{'lost':>7}{'bp':>8}"
+             f"{'p50us':>8}{'p99us':>8}"]
+    for ln in sorted(links):
+        rec = links[ln]
+        cons = rec["consumers"] or {"-": None}
+        for tn in sorted(cons):
+            c = cons[tn]
+            if c is None:
+                lines.append(
+                    f"{ln:<18}{rec['producer'] or '-':<12}{'-':<12}"
+                    f"{rec['pub']:>10}{'-':>10}{'-':>7}"
+                    f"{rec['backpressure']:>8}{'-':>8}{'-':>8}")
+                continue
+            lines.append(
+                f"{ln:<18}{rec['producer'] or '-':<12}{tn:<12}"
+                f"{rec['pub']:>10}{c['consumed']:>10}{c['lag']:>7}"
+                f"{rec['backpressure']:>8}{c['p50_us']:>8.0f}"
+                f"{c['p99_us']:>8.0f}")
     return "\n".join(lines)
 
 
@@ -92,14 +175,33 @@ def main(argv=None):
     name = argv[0]
     watch = float(argv[argv.index("--watch") + 1]) if "--watch" in argv \
         else None
+    as_json = "--json" in argv
     plan, wksp = attach(name)
+    prev = None
     try:
         while True:
-            print(format_table(snapshot(plan, wksp)))
+            if as_json:
+                print(json.dumps(full_snapshot(plan, wksp)))
+            else:
+                snap = snapshot(plan, wksp)
+                from .metrics import read_link_metrics
+                links = links_table(read_link_metrics(wksp, plan))
+                frame = format_table(snap, prev)
+                lt = format_links(links)
+                if lt:
+                    frame += "\n\n" + lt
+                if watch is not None:
+                    # diff-print: clear + redraw with counter deltas
+                    # (the reference monitor's terminal discipline)
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(frame)
+                prev = snap
             if watch is None:
                 return 0
+            sys.stdout.flush()
             time.sleep(watch)
-            print()
+            if not as_json:
+                print()
     finally:
         wksp.close()
 

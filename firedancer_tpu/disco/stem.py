@@ -56,8 +56,37 @@ class Stem:
         # insertion order of its first metrics_items() result
         self._metrics_names: list[str] | None = \
             list(ctx.spec.get("metrics_names", [])) or None
-        # wait/work poll latency histograms (flushed at housekeeping)
+        # wait/work poll latency histograms (flushed at housekeeping);
+        # seeded from shm so a supervised restart RESUMES the
+        # cumulative series (flush_into writes wholesale — a fresh
+        # accumulator would rewind readers to zero), same continuity
+        # contract as the link counters below. The tile-owned tpu
+        # histogram (verify's tpu_hist) gets the same seeding.
         self._hists = {"wait": HistAccum(), "work": HistAccum()}
+        hv = ctx.hist_view()
+        if hv is not None:
+            self._hists["wait"].seed_from(hv[0:HIST_U64])
+            self._hists["work"].seed_from(hv[HIST_U64:2 * HIST_U64])
+            tpu = getattr(tile, "tpu_hist", None)
+            if tpu is not None and len(hv) >= 3 * HIST_U64:
+                tpu.seed_from(hv[2 * HIST_U64:3 * HIST_U64])
+        # per-link consume-latency histograms (fdmetrics v2): one
+        # accumulator per in link, fed in the poll loop by attributing
+        # each productive poll's duration to every link whose consume
+        # counter advanced — no extra timestamp beyond the t0/t1 the
+        # wait/work split already takes (the reference's per-link-pair
+        # regime attribution, fd_stem.c)
+        self._link_hists = {ln: HistAccum()
+                            for ln in ctx.link_cons_views} \
+            if getattr(ctx, "link_cons_views", None) else {}
+        # restart continuity: resume the cumulative consume-latency
+        # series from shm, and start the seen-cursor at the (seeded,
+        # TileCtx) consume counter so the first poll after a respawn
+        # isn't falsely attributed to every link
+        for ln, h in self._link_hists.items():
+            h.seed_from(ctx.link_cons_views[ln][3:3 + HIST_U64])
+        self._link_seen = {ln: ctx.in_rings[ln].m_consumed
+                          for ln in self._link_hists}
         # chaos harness: a seeded fault plan injected purely via tile
         # args (utils/chaos.py) — fires deterministically in run()
         self._chaos = None
@@ -133,6 +162,30 @@ class Stem:
         if hv is not None:
             self._hists["wait"].flush_into(hv[0:HIST_U64])
             self._hists["work"].flush_into(hv[HIST_U64:2 * HIST_U64])
+            # device-time attribution: a tile that drives an
+            # accelerator exposes a `tpu_hist` HistAccum (verify tile's
+            # dispatch+readback spans); host-only tiles leave slot 3
+            # zero and the renderer skips the empty series
+            tpu = getattr(self.tile, "tpu_hist", None)
+            if tpu is not None and len(hv) >= 3 * HIST_U64:
+                tpu.flush_into(hv[2 * HIST_U64:3 * HIST_U64])
+        # per-link telemetry blocks: the Ring join's instance-local
+        # counters (runtime/tango.py) are THE per-link truth for this
+        # tile; flushing them wholesale keeps the hot path free of any
+        # shm write (same single-writer cumulative contract as hists)
+        for ln, view in getattr(self.ctx, "link_cons_views",
+                                {}).items():
+            r = self.ctx.in_rings[ln]
+            view[0] = r.m_consumed
+            view[1] = r.m_bytes
+            view[2] = r.m_overruns
+            self._link_hists[ln].flush_into(view[3:3 + HIST_U64])
+        for ln, view in getattr(self.ctx, "link_prod_views",
+                                {}).items():
+            r = self.ctx.out_rings[ln]
+            view[0] = r.m_pub
+            view[1] = r.m_pub_bytes
+            view[2] = r.m_backpressure
 
     def _update_in_fseqs(self):
         """Publish consumer progress so upstream producers see credits."""
@@ -203,6 +256,15 @@ class Stem:
                 # spent waiting on upstream, a productive one is work
                 # (the reference's per-link regime split)
                 self._hists["work" if n else "wait"].add(t1 - t0)
+                if n and self._link_hists:
+                    # per-link consume latency: attribute this poll's
+                    # duration to every in link whose Ring consume
+                    # counter advanced (one int compare per link)
+                    for ln, h in self._link_hists.items():
+                        c = self.ctx.in_rings[ln].m_consumed
+                        if c != self._link_seen[ln]:
+                            self._link_seen[ln] = c
+                            h.add(t1 - t0)
                 if tr is not None:
                     # trace shape: one WAIT span per idle STREAK
                     # (credit-wait begin at the first empty poll, end

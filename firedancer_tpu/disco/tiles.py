@@ -158,8 +158,17 @@ class VerifyAdapter:
 
     METRICS = ["rx", "parse_fail", "dedup_drop", "verify_fail", "tx",
                "overruns", "batches", "backpressure", "device_errors",
-               "cpu_fallback"]
-    GAUGES = ["cpu_fallback"]
+               "cpu_fallback",
+               # device telemetry (fdmetrics v2): promoted by the
+               # prometheus renderer to fdtpu_tile_tpu_* series
+               "tpu_jit_compiles", "tpu_jit_cache_miss",
+               "tpu_inflight", "tpu_mem_bytes"]
+    GAUGES = ["cpu_fallback", "tpu_jit_compiles", "tpu_jit_cache_miss",
+              "tpu_inflight", "tpu_mem_bytes"]
+    # declared (not name-sniffed) device-telemetry slots: the renderer
+    # promotes these to first-class fdtpu_tile_<name> families
+    DEVICE_SERIES = ["tpu_jit_compiles", "tpu_jit_cache_miss",
+                     "tpu_inflight", "tpu_mem_bytes"]
 
     def __init__(self, ctx, args):
         _setup_jax()
@@ -196,6 +205,9 @@ class VerifyAdapter:
         self.tile._cnc = ctx.cnc
         self.in_link = next(iter(ctx.in_rings))
         self.tile.seq = ctx.in_seq0.get(self.in_link, 0)
+        # device-time attribution: the stem flushes this accumulator
+        # into the tile's third (tpu) histogram slot
+        self.tpu_hist = self.tile.tpu_hist
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
@@ -206,8 +218,33 @@ class VerifyAdapter:
     def in_seqs(self):
         return {self.in_link: self.tile.seq}
 
+    def _jit_compiles(self) -> int:
+        """Compiled-variant count of the verify jit (the steady-state
+        contract is ONE shape — anything past the warmed entry is a
+        recompile the padding discipline should have prevented)."""
+        try:
+            return int(self.tile._fn._cache_size())
+        except Exception:                # noqa: BLE001 — jax-version API
+            return 0
+
+    def _device_mem(self) -> int:
+        """Device bytes in use via memory_stats(); gracefully 0 on
+        backends (CPU) that expose none."""
+        try:
+            import jax
+            st = jax.local_devices()[0].memory_stats()
+            return int(st.get("bytes_in_use", 0)) if st else 0
+        except Exception:                # noqa: BLE001
+            return 0
+
     def metrics_items(self):
-        return dict(self.tile.metrics)
+        m = dict(self.tile.metrics)
+        compiles = self._jit_compiles()
+        m["tpu_jit_compiles"] = compiles
+        m["tpu_jit_cache_miss"] = max(0, compiles - 1)
+        m["tpu_inflight"] = len(self.tile._pending)
+        m["tpu_mem_bytes"] = self._device_mem()
+        return m
 
 
 @register("dedup")
@@ -1655,62 +1692,112 @@ class SnapInAdapter:
 
 @register("metric")
 class MetricAdapter:
-    """Prometheus scrape endpoint (ref: src/disco/metrics/fd_metric_tile.c
-    + fd_prometheus.c): serves GET /metrics with every tile's named
-    counters and wait/work latency histograms, rendered straight from the
-    shared-memory metrics regions. The HTTP server runs on a daemon
-    thread; the tile loop itself is idle (all state lives in shm).
+    """The observability tile (ref: src/disco/metrics/fd_metric_tile.c
+    + fd_prometheus.c): an HTTP endpoint rendered straight from the
+    shared-memory metrics/cnc/link regions — reader-side only, so it
+    survives any other tile's death — plus the SLO engine evaluated at
+    the housekeeping cadence.
+
+      GET /metrics       prometheus text (tile counters, wait/work/tpu
+                         histograms, fdtpu_link_* per-link telemetry)
+      GET /summary.json  the monitor snapshot + link table + SLO state
+      GET /healthz       CNC + heartbeat-staleness roll-up: 200 when
+                         every tile is RUN with a fresh heartbeat,
+                         503 (with per-tile detail) otherwise
 
     args: port (0 = ephemeral; bound port published in the "port"
-    metric), bind_addr."""
+    metric), bind_addr, healthz_stale_s (heartbeat age that flips a
+    tile unhealthy, default 5s)."""
 
-    METRICS = ["port", "scrapes"]
-    GAUGES = ["port"]
+    METRICS = ["port", "scrapes", "requests", "slo_breach",
+               "slo_breaches", "slo_evals"]
+    GAUGES = ["port", "slo_breach"]
 
     def __init__(self, ctx, args):
-        import threading
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
+        from .httpd import Counter, TileHttpServer
         from .metrics import render_prometheus
+        from .slo import SloEngine
         self.ctx = ctx
-        self.scrapes = 0
-        adapter = self
+        self._scrapes = Counter()
+        self.stale_ticks = int(
+            float(args.get("healthz_stale_s", 5.0)) * 1e9)
+        # SLO objectives ride the plan ([slo] section, validated at
+        # build); breaches land in THIS tile's flight-recorder ring
+        self.engine = SloEngine(ctx.plan, ctx.wksp,
+                                trace=getattr(ctx, "trace", None))
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path not in ("/metrics", "/"):
-                    self.send_error(404)
-                    return
-                body = render_prometheus(
-                    adapter.ctx.plan, adapter.ctx.wksp).encode()
-                adapter.scrapes += 1
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+        def metrics_route():
+            self._scrapes.bump()
+            body = render_prometheus(ctx.plan, ctx.wksp).encode()
+            return 200, "text/plain; version=0.0.4", body
 
-            def log_message(self, *a):       # keep tile stdout quiet
-                pass
+        def summary_route():
+            # the ONE summary-document shape (monitor --json emits the
+            # same), plus the SLO state only this tile can evaluate
+            from .monitor import full_snapshot
+            body = json.dumps({
+                **full_snapshot(ctx.plan, ctx.wksp),
+                "slo": self.engine.status(),
+            }).encode()
+            return 200, "application/json", body
 
-        self.server = ThreadingHTTPServer(
-            (args.get("bind_addr", "127.0.0.1"), int(args.get("port", 0))),
-            Handler)
-        self.port = self.server.server_address[1]
-        self.thread = threading.Thread(
-            target=self.server.serve_forever, daemon=True)
-        self.thread.start()
+        def healthz_route():
+            doc = self._healthz()
+            return (200 if doc["ok"] else 503), "application/json", \
+                json.dumps(doc).encode()
+
+        self.server = TileHttpServer(
+            {"/metrics": metrics_route, "/": metrics_route,
+             "/summary.json": summary_route, "/healthz": healthz_route},
+            port=int(args.get("port", 0)),
+            bind_addr=args.get("bind_addr", "127.0.0.1"))
+        self.port = self.server.port
+
+    def _healthz(self) -> dict:
+        from ..runtime import Cnc, CNC_RUN
+        from . import topo as topo_mod
+        from .monitor import _STATE
+        now = topo_mod.now_ticks()
+        tiles = {}
+        ok = True
+        for tn, spec in self.ctx.plan["tiles"].items():
+            cnc = Cnc(self.ctx.wksp, off=spec["cnc_off"])
+            state = cnc.state
+            age = max(0, now - cnc.last_heartbeat)
+            stale = age > self.stale_ticks
+            healthy = state == CNC_RUN and not stale
+            ok = ok and healthy
+            tiles[tn] = {
+                "state": _STATE.get(state, f"?{state}"),
+                "hb_age_ticks": age, "stale": stale,
+                "healthy": healthy,
+            }
+        return {"ok": ok, "tiles": tiles,
+                # informational: a burning SLO is a service problem,
+                # not a liveness one — it must not flip readiness
+                "slo_breached": [n for n, s in
+                                 self.engine.status().items()
+                                 if s["breached"]]}
+
+    def housekeeping(self):
+        for ev in self.engine.sample():
+            from ..utils import log
+            log.warning(f"slo {ev['kind']}: {ev['target']} "
+                        f"({ev['expr']}) value={ev['value']}")
 
     def poll_once(self) -> int:
         return 0
 
     def on_halt(self):
-        self.server.shutdown()
-        self.server.server_close()
+        self.server.close()
 
     def metrics_items(self):
-        return {"port": self.port, "scrapes": self.scrapes}
+        return {"port": self.port,
+                "scrapes": self._scrapes.value,
+                "requests": self.server.requests.value,
+                "slo_breach": self.engine.breached,
+                "slo_breaches": self.engine.total_breaches,
+                "slo_evals": self.engine.evals}
 
 
 @register("bundle")
@@ -2142,53 +2229,36 @@ class GuiAdapter:
     GAUGES = ["port"]
 
     def __init__(self, ctx, args):
-        import threading
         import time as _t
-        from http.server import BaseHTTPRequestHandler, \
-            ThreadingHTTPServer
 
+        from .httpd import TileHttpServer
         from .monitor import snapshot
         self.ctx = ctx
-        self.requests = 0
         self.tps_tile = args.get("tps_tile", "sink")
         self.tps_metric = args.get("tps_metric", "rx")
         self._tps = 0.0
         self._last = (None, 0.0)       # (count, t)
-        adapter = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path in ("/", "/index.html"):
-                    body = _GUI_HTML.encode()
-                    ctype = "text/html"
-                elif self.path == "/summary.json":
-                    snap = snapshot(adapter.ctx.plan, adapter.ctx.wksp)
-                    body = json.dumps({
-                        "topology": adapter.ctx.plan["topology"],
-                        "tps": adapter._tps,
-                        "tiles": snap,
-                    }).encode()
-                    ctype = "application/json"
-                else:
-                    self.send_error(404)
-                    return
-                adapter.requests += 1
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+        def page_route():
+            return 200, "text/html", _GUI_HTML.encode()
 
-            def log_message(self, *a):
-                pass
+        def summary_route():
+            body = json.dumps({
+                "topology": ctx.plan["topology"],
+                "tps": self._tps,
+                "tiles": snapshot(ctx.plan, ctx.wksp),
+            }).encode()
+            return 200, "application/json", body
 
-        self.server = ThreadingHTTPServer(
-            (args.get("bind_addr", "127.0.0.1"),
-             int(args.get("port", 0))), Handler)
-        self.port = self.server.server_address[1]
-        self.thread = threading.Thread(
-            target=self.server.serve_forever, daemon=True)
-        self.thread.start()
+        # the shared reader-side HTTP plumbing (disco/httpd.py) also
+        # owns the request counter — handler threads used to race a
+        # bare `requests += 1` here and drop counts
+        self.server = TileHttpServer(
+            {"/": page_route, "/index.html": page_route,
+             "/summary.json": summary_route},
+            port=int(args.get("port", 0)),
+            bind_addr=args.get("bind_addr", "127.0.0.1"))
+        self.port = self.server.port
         self._time = _t
 
     def housekeeping(self):
@@ -2212,11 +2282,11 @@ class GuiAdapter:
         return 0
 
     def on_halt(self):
-        self.server.shutdown()
-        self.server.server_close()
+        self.server.close()
 
     def metrics_items(self):
-        return {"port": self.port, "requests": self.requests}
+        return {"port": self.port,
+                "requests": self.server.requests.value}
 
 
 @register("cswtch")

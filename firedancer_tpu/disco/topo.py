@@ -44,6 +44,22 @@ def _metric_gauges(kind: str) -> list[str]:
     return list(getattr(REGISTRY.get(kind, object), "GAUGES", []))
 
 
+# fdtpu_tile_<name> families the renderer already emits; a promoted
+# device series may not shadow them (checked at build, below)
+_RESERVED_TILE_FAMILIES = ("metric", "gauge", "liveness_seconds",
+                           "tpu_seconds")
+
+
+def _metric_device(kind: str) -> list[str]:
+    """Slot names the adapter declares as DEVICE_SERIES: promoted by
+    the prometheus renderer to first-class fdtpu_tile_<name> families
+    (device telemetry dashboards key on) instead of the generic
+    name-labeled series — explicit declaration, never name sniffing."""
+    from .tiles import REGISTRY
+    return list(getattr(REGISTRY.get(kind, object), "DEVICE_SERIES",
+                        []))
+
+
 @dataclass
 class LinkSpec:
     name: str
@@ -67,7 +83,7 @@ class Topology:
     """Builder. Declare links/tiles/objects, then build() into a wksp."""
 
     def __init__(self, name: str, wksp_size: int = 1 << 26,
-                 trace: dict | None = None):
+                 trace: dict | None = None, slo: dict | None = None):
         self.name = name
         self.wksp_size = wksp_size
         self.links: dict[str, LinkSpec] = {}
@@ -76,6 +92,10 @@ class Topology:
         # [trace] flight-recorder config (trace/recorder.py schema);
         # validated at build so a typo fails before launch
         self.trace = trace
+        # [slo] objectives (disco/slo.py schema); targets resolve
+        # against the declared tiles/links/metrics at build, so a typo
+        # or a dangling reference fails before launch too
+        self.slo = slo
 
     def link(self, name: str, depth: int = 128, mtu: int = 1280,
              external: bool = False):
@@ -143,11 +163,17 @@ class Topology:
             "links": {}, "fseqs": {}, "tcaches": {}, "tiles": {},
         }
         try:
+            from .metrics import LINK_CONS_U64, LINK_PROD_U64
             for ln, spec in self.links.items():
                 r = Ring.create(w, depth=spec.depth, mtu=spec.mtu)
+                # per-link producer telemetry block (single writer:
+                # links are SPMC, the one producing tile's stem owns it)
+                po = w.alloc(LINK_PROD_U64 * 8)
+                w.view(po, LINK_PROD_U64 * 8)[:] = 0
                 plan["links"][ln] = {
                     "ring_off": r.off, "arena_off": r.arena_off,
                     "depth": spec.depth, "mtu": r.mtu,
+                    "prod_metrics_off": po,
                 }
             for name, depth in self.tcaches.items():
                 tc = Tcache(w, depth=depth)
@@ -179,11 +205,21 @@ class Topology:
                         f"tile kind {t.kind}: {len(names)} metric slots "
                         f"collide with supervisor slots (max "
                         f"{SUP_SLOT_MIN})")
+                # per-(consumer, in-link) telemetry block: consume
+                # counters + a consume-latency histogram, fed by this
+                # tile's stem (single writer) — the reader side matches
+                # by (tile, link) from the plan, never by order
+                link_metrics = {}
+                for i in t.ins:
+                    lo = w.alloc(LINK_CONS_U64 * 8)
+                    w.view(lo, LINK_CONS_U64 * 8)[:] = 0
+                    link_metrics[i["link"]] = lo
                 plan["tiles"][tn] = {
                     "kind": t.kind,
                     "ins": list(t.ins),
                     "outs": list(t.outs),
                     "args": dict(t.args),
+                    "link_metrics": link_metrics,
                     # per-tile restart/watchdog policy, validated at
                     # build so a config typo fails before launch
                     "supervise": normalize_policy(
@@ -191,11 +227,27 @@ class Topology:
                     "cnc_off": cnc.off,
                     "metrics_off": metrics_off,
                     "hist_off": hist_off,
+                    # region length in u64 — readers and the stem size
+                    # their views from the PLAN so a newer build
+                    # attaching to an older topology (fewer hist
+                    # kinds) never reads past the carved region
+                    "hist_u64": HIST_REGION_U64,
                     # explicit slot-name ABI: readers match by these names,
                     # never by adapter class declaration order (r2 W7)
                     "metrics_names": names,
                     "metrics_gauges": _metric_gauges(t.kind),
+                    "metrics_device": _metric_device(t.kind),
                 }
+                for nm in plan["tiles"][tn]["metrics_device"]:
+                    if nm not in names:
+                        raise ValueError(
+                            f"tile kind {t.kind}: DEVICE_SERIES "
+                            f"{nm!r} is not a declared metric slot")
+                    if nm in _RESERVED_TILE_FAMILIES:
+                        raise ValueError(
+                            f"tile kind {t.kind}: DEVICE_SERIES "
+                            f"{nm!r} would shadow the built-in "
+                            f"fdtpu_tile_{nm} family")
                 # flight-recorder ring, carved next to the metric
                 # slots (trace/recorder.py resolves topology default
                 # + per-tile override; untraced tiles get NO region
@@ -214,6 +266,14 @@ class Topology:
                     ks_off = w.alloc(KS_FP)
                     w.view(ks_off, KS_FP)[:] = 0
                     plan["tiles"][tn]["keyswitch_off"] = ks_off
+            # [slo] objectives: schema-validate AND resolve every
+            # target's source against the tiles/metrics/links this plan
+            # actually declares — a dangling objective fails the build,
+            # not the first housekeeping pass of the metric tile
+            from .slo import normalize_slo, resolve_slo
+            slo_cfg = normalize_slo(self.slo)
+            resolve_slo(slo_cfg, plan)
+            plan["slo"] = slo_cfg
         except Exception:
             w.close()
             w.unlink()
@@ -288,6 +348,41 @@ class TileCtx:
         from ..trace import writer_for
         self.trace = writer_for(plan, self.wksp, tile_name)
 
+        # per-link telemetry views (fdmetrics v2): consumer blocks for
+        # this tile's in links, producer blocks for its out links —
+        # single-writer by construction, flushed by the stem. Plans
+        # built before the link ABI existed leave both dicts empty.
+        import numpy as np
+        from .metrics import LINK_CONS_U64, LINK_PROD_U64
+        self.link_cons_views = {
+            ln: self.wksp.view(off, LINK_CONS_U64 * 8).view(np.uint64)
+            for ln, off in (self.spec.get("link_metrics") or {}).items()
+        }
+        self.link_prod_views = {}
+        for ln in self.spec["outs"]:
+            off = plan["links"][ln].get("prod_metrics_off")
+            if off is not None:
+                self.link_prod_views[ln] = self.wksp.view(
+                    off, LINK_PROD_U64 * 8).view(np.uint64)
+        # restart continuity: a supervised respawn joins fresh Ring
+        # instances whose telemetry counters start at 0, but the shm
+        # blocks hold the link's cumulative history and the stem
+        # flushes the instance counters WHOLESALE — seed them from shm
+        # so the series resumes instead of resetting (a zeroed consumed
+        # counter would count everything consumed before the restart as
+        # per-hop loss). Fresh boots seed zeros: a no-op.
+        for ln, view in self.link_cons_views.items():
+            r = self.in_rings.get(ln)
+            if r is not None:
+                r.m_consumed = int(view[0])
+                r.m_bytes = int(view[1])
+                r.m_overruns = int(view[2])
+        for ln, view in self.link_prod_views.items():
+            r = self.out_rings[ln]
+            r.m_pub = int(view[0])
+            r.m_pub_bytes = int(view[1])
+            r.m_backpressure = int(view[2])
+
     def in_seqs0(self) -> dict[str, int]:
         """Initial consume cursor per in link: 0 on a fresh boot, the
         producer's current seq on a supervised restart (ring rejoin)."""
@@ -299,14 +394,19 @@ class TileCtx:
             .view(np.uint64)
 
     def hist_view(self):
-        """u64 view of this tile's wait/work histogram region (or None
-        for plans built before histograms existed)."""
+        """u64 view of this tile's wait/work[/tpu] histogram region (or
+        None for plans built before histograms existed). Sized by the
+        plan-recorded region length, NOT the current HIST_REGION_U64:
+        attaching to a plan carved by an older build (fewer hist kinds)
+        must not read/write past its region into the adjacent
+        allocation."""
         import numpy as np
         off = self.spec.get("hist_off")
         if off is None:
             return None
-        from .metrics import HIST_REGION_U64
-        return self.wksp.view(off, HIST_REGION_U64 * 8).view(np.uint64)
+        from .metrics import HIST_U64
+        n = int(self.spec.get("hist_u64", 2 * HIST_U64))
+        return self.wksp.view(off, n * 8).view(np.uint64)
 
     def close(self):
         self.wksp.close()

@@ -83,9 +83,10 @@ def _attr_used(node: ast.AST, attr: str) -> bool:
 
 
 def _class_metrics(cls: ast.ClassDef):
-    """(METRICS list|None, its line, GAUGES list|None, its line)."""
-    metrics = gauges = None
-    mline = gline = cls.lineno
+    """(METRICS, line, GAUGES, line, DEVICE_SERIES, line) — each list
+    or None when the class doesn't declare it."""
+    metrics = gauges = device = None
+    mline = gline = dline = cls.lineno
     for st in cls.body:
         if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
                 isinstance(st.targets[0], ast.Name):
@@ -93,7 +94,9 @@ def _class_metrics(cls: ast.ClassDef):
                 metrics, mline = _const_str_list(st.value), st.lineno
             elif st.targets[0].id == "GAUGES":
                 gauges, gline = _const_str_list(st.value), st.lineno
-    return metrics, mline, gauges, gline
+            elif st.targets[0].id == "DEVICE_SERIES":
+                device, dline = _const_str_list(st.value), st.lineno
+    return metrics, mline, gauges, gline, device, dline
 
 
 def lint_tiles_source(source: str, path: str) -> list[Finding]:
@@ -119,7 +122,7 @@ def lint_tiles_source(source: str, path: str) -> list[Finding]:
 
 def _lint_class(cls: ast.ClassDef, path: str) -> list[Finding]:
     out: list[Finding] = []
-    metrics, mline, gauges, gline = _class_metrics(cls)
+    metrics, mline, gauges, gline, device, dline = _class_metrics(cls)
     if metrics is not None:
         for nm in metrics:
             if nm in SUP_NAMES:
@@ -146,6 +149,15 @@ def _lint_class(cls: ast.ClassDef, path: str) -> list[Finding]:
                         "undeclared-gauge", path, gline,
                         f"{cls.name}.GAUGES entry {nm!r} is not a "
                         f"declared metric"))
+        if device is not None:
+            # same declared-subset contract as GAUGES; topo.build
+            # additionally rejects reserved-family shadowing at launch
+            for nm in device:
+                if nm not in metrics:
+                    out.append(finding(
+                        "undeclared-gauge", path, dline,
+                        f"{cls.name}.DEVICE_SERIES entry {nm!r} is "
+                        f"not a declared metric"))
     kind = _is_registered(cls)
     if kind is not None and _attr_used(cls, "in_rings"):
         has_in_seqs = any(
@@ -216,7 +228,7 @@ def adapter_summaries(path: str | None = None) -> dict[str, dict]:
         kind = _is_registered(node)
         if kind is None:
             continue
-        metrics, _, gauges, _ = _class_metrics(node)
+        metrics, _, gauges, _, _, _ = _class_metrics(node)
         out[kind] = {
             "metrics": metrics or [],
             "gauges": gauges or [],

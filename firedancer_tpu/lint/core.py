@@ -83,6 +83,11 @@ RULES: dict[str, tuple[str, str, str]] = {
         "[trace] section or [tile.trace] table rejected by the fdtrace "
         "schema (unknown key, non-power-of-two depth, sample < 1) or "
         "trace.tiles names an undeclared tile"),
+    "bad-slo": (
+        "graph", "error",
+        "[slo] section rejected by the disco/slo.py schema (unknown "
+        "key, bad expression grammar, out-of-range window/burn) or a "
+        "target references an undeclared tile/metric/link"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",
@@ -94,8 +99,8 @@ RULES: dict[str, tuple[str, str, str]] = {
         "topology builder will reject the kind at build"),
     "undeclared-gauge": (
         "contract", "error",
-        "GAUGES entry is not a declared METRICS name (the prometheus "
-        "renderer matches gauges by name)"),
+        "GAUGES or DEVICE_SERIES entry is not a declared METRICS name "
+        "(the prometheus renderer matches both declarations by name)"),
     "dup-metric": (
         "contract", "error",
         "duplicate name in a tile's METRICS declaration (slots are "

@@ -75,7 +75,7 @@ def model_from_config(cfg: dict) -> dict:
                             "outs": list(t.get("outs", ())),
                             "args": args}
     return {"links": links, "tcaches": tcaches, "tiles": tiles,
-            "trace": cfg.get("trace")}
+            "trace": cfg.get("trace"), "slo": cfg.get("slo")}
 
 
 def model_from_topology(topo) -> dict:
@@ -88,7 +88,8 @@ def model_from_topology(topo) -> dict:
                   "outs": list(t.outs), "args": dict(t.args)}
              for tn, t in topo.tiles.items()}
     return {"links": links, "tcaches": set(topo.tcaches),
-            "tiles": tiles, "trace": getattr(topo, "trace", None)}
+            "tiles": tiles, "trace": getattr(topo, "trace", None),
+            "slo": getattr(topo, "slo", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -230,6 +231,7 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_cycles(model, producers, lines))
     out.extend(_check_tiles(model, kinds, lines))
     out.extend(_check_trace(model, path, lines))
+    out.extend(_check_slo(model, kinds, path, lines))
     return out
 
 
@@ -258,6 +260,34 @@ def _check_trace(model, path, lines) -> list[Finding]:
                 normalize_trace(t["args"]["trace"], per_tile=True)
             except Exception as e:
                 _emit(out, lines, "bad-trace", tn, f"tile {tn!r}: {e}")
+    return out
+
+
+def _check_slo(model, kinds, path, lines) -> list[Finding]:
+    """[slo] section: the disco/slo.py schema gate (one validator,
+    same as topo.build) plus target-source resolution against the
+    DECLARED topology — tile metric slot names come from the adapter
+    registry's static summaries, so a target naming a metric the tile
+    kind never exports is a review-time finding with a did-you-mean."""
+    from ..disco.slo import check_target, normalize_slo
+    out: list[Finding] = []
+    spec = model.get("slo")
+    if spec is None:
+        return out
+    try:
+        norm = normalize_slo(spec)
+    except Exception as e:
+        out.append(finding("bad-slo", path, 0, f"[slo]: {e}"))
+        return out
+    tiles_metrics = {
+        tn: kinds.get(t["kind"], {}).get("metrics", [])
+        for tn, t in model["tiles"].items()
+    }
+    for t in norm["target"]:
+        err = check_target(t["parsed"], tiles_metrics, model["links"])
+        if err:
+            _emit(out, lines, "bad-slo", t["name"],
+                  f"slo target {t['name']!r}: {err}")
     return out
 
 

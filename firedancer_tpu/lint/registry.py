@@ -42,6 +42,15 @@ COMMON_KEYS: dict[str, str | None] = {
 TRACE_SECTION_KEYS = ("enable", "depth", "sample", "tiles")
 TILE_TRACE_KEYS = ("enable", "depth", "sample")
 
+# [slo] topology-section keys (mirror of disco/slo.py SLO_DEFAULTS /
+# TARGET_KEYS — tests/test_metrics.py keeps the mirror honest).
+# Target expressions reference tiles/metrics/links, resolved by the
+# graph analyzer's bad-slo check.
+SLO_SECTION_KEYS = ("fast_window_s", "slow_window_s", "burn_fast",
+                    "burn_slow", "target")
+SLO_TARGET_KEYS = ("name", "expr", "fast_window_s", "slow_window_s",
+                   "burn_fast", "burn_slow")
+
 TILE_ARGS: dict[str, dict[str, str | None]] = {
     "synth": {"count": None, "burst": None, "unique": None, "seed": None},
     "verify": {"batch": None, "max_len": None, "tcache": TCACHE,
@@ -83,7 +92,7 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
     "snapld": {"path": None, "chunk": None},
     "snapdc": {},
     "snapin": {"format": None},
-    "metric": {"port": None, "bind_addr": None},
+    "metric": {"port": None, "bind_addr": None, "healthz_stale_s": None},
     "bundle": {"engine": None, "path": None, "authority": None},
     "plugin": {"sock_path": None, "data_hex_max": None},
     "netlnk": {},

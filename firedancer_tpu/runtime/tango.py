@@ -155,12 +155,30 @@ class Workspace:
 
 
 class Ring:
-    """Single-producer frag ring + payload arena inside a workspace."""
+    """Single-producer frag ring + payload arena inside a workspace.
+
+    Every join additionally carries INSTANCE-LOCAL telemetry counters
+    (m_pub/m_pub_bytes/m_backpressure on the publish side,
+    m_consumed/m_bytes/m_overruns on the gather side) bumped by the
+    hot-path methods below. They are plain Python ints — no shared
+    memory, no cross-process cost — and because each tile process joins
+    its own Ring per link, they ARE that tile's per-link counters: the
+    stem flushes them into the per-link shm telemetry blocks at the
+    housekeeping cadence (disco/metrics.py link ABI, the reference's
+    per-link-pair regime counters, src/disco/metrics/fd_metrics.h)."""
 
     def __init__(self, wksp: Workspace, off: int, depth: int,
                  arena_off: int = 0, mtu: int = 0, init: bool = False):
         self.wksp, self.off, self.depth = wksp, off, depth
         self.arena_off, self.mtu = arena_off, mtu
+        # producer-side link telemetry (publish/publish_batch/credits)
+        self.m_pub = 0
+        self.m_pub_bytes = 0
+        self.m_backpressure = 0
+        # consumer-side link telemetry (gather/consume)
+        self.m_consumed = 0
+        self.m_bytes = 0
+        self.m_overruns = 0
         if init:
             rc = lib.fdtpu_ring_init(wksp.base, off, depth)
             if rc:
@@ -190,6 +208,8 @@ class Ring:
             payload, (bytes, bytearray)) else payload
         assert data.nbytes <= self.mtu
         self.wksp.view(slot_off, data.nbytes)[:] = data
+        self.m_pub += 1
+        self.m_pub_bytes += data.nbytes
         return lib.fdtpu_ring_publish(self.wksp.base, self.off, sig,
                                       slot_off, data.nbytes, ctl, orig)
 
@@ -220,13 +240,25 @@ class Ring:
             mask.ctypes.data_as(ct.POINTER(ct.c_uint8)),
             start, n, self.arena_off, self.mtu,
             offs, len(fseqs) if fseqs else 0, ct.byref(pub))
-        return int(stop), int(pub.value)
+        stop, pub = int(stop), int(pub.value)
+        if pub:
+            self.m_pub += pub
+            live = mask[start:stop] != 0
+            self.m_pub_bytes += int(sizes[start:stop][live].sum())
+        if stop < n:
+            self.m_backpressure += 1     # credits ran out mid-batch
+        return stop, pub
 
     def consume(self, seq: int):
         """-> (rc, Frag). rc 0=ok, 1=not yet, -1=overrun."""
         frag = Frag()
         rc = lib.fdtpu_ring_consume(self.wksp.base, self.off, seq,
                                     ct.byref(frag))
+        if rc == 0:
+            self.m_consumed += 1
+            self.m_bytes += frag.sz
+        elif rc == -1:
+            self.m_overruns += 1
         return rc, frag
 
     def payload(self, frag: Frag) -> np.ndarray:
@@ -253,14 +285,21 @@ class Ring:
             sigs.ctypes.data_as(ct.POINTER(ct.c_uint64)), ct.byref(ovr),
             seqs.ctypes.data_as(ct.POINTER(ct.c_uint64))
             if want_seqs else None)
+        if n:
+            self.m_consumed += int(n)
+            self.m_bytes += int(sizes[:n].sum())
+        self.m_overruns += int(ovr.value)
         if want_seqs:
             return n, seq_io.value, buf, sizes, sigs, ovr.value, seqs
         return n, seq_io.value, buf, sizes, sigs, ovr.value
 
     def credits(self, fseqs: list["Fseq"]) -> int:
         offs = (ct.c_uint64 * len(fseqs))(*[f.off for f in fseqs])
-        return lib.fdtpu_fctl_credits(self.wksp.base, self.off, offs,
-                                      len(fseqs))
+        c = lib.fdtpu_fctl_credits(self.wksp.base, self.off, offs,
+                                   len(fseqs))
+        if c <= 0:
+            self.m_backpressure += 1     # a blocked publish attempt
+        return c
 
 
 TRACE_REC_U64 = 4             # ts_ns | sig | arg | meta(etype/link/count)

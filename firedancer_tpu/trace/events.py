@@ -36,6 +36,9 @@ EV_CHAOS = 13        # instant: chaos fault fired (count = action id)
 EV_WATCHDOG = 14     # instant: supervisor wedge-watchdog trip (sup-written)
 EV_RESTART = 15      # instant: supervisor respawned the tile (sup-written)
 EV_DOWN = 16         # instant: supervisor observed abnormal death
+EV_SLO = 17          # instant: SLO breach (metric-tile-written;
+                     #   arg = measured value, count = target index
+                     #   into the plan's [slo] target list)
 
 NAMES = {
     EV_BOOT: "boot", EV_HALT: "halt", EV_FAIL: "fail",
@@ -45,6 +48,7 @@ NAMES = {
     EV_TPU_DISPATCH: "tpu_dispatch", EV_TPU_READBACK: "tpu_readback",
     EV_CPU_FALLBACK: "cpu_fallback", EV_CHAOS: "chaos",
     EV_WATCHDOG: "watchdog", EV_RESTART: "restart", EV_DOWN: "down",
+    EV_SLO: "slo",
 }
 
 # span events: record.ts is the END, record.arg the duration in ns

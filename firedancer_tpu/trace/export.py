@@ -179,6 +179,8 @@ def summary(events_by_tile: dict[str, list[dict]]) -> str:
             elif e["etype"] in (ev.EV_WATCHDOG, ev.EV_RESTART,
                                 ev.EV_DOWN):
                 notes.append(e["ev"])
+            elif e["etype"] == ev.EV_SLO:
+                notes.append(f"SLO-BREACH#{e['count']}")
         lines.append(
             f"{tn:<14}{len(evs):>8}{acc['wait'] / 1e6:>10.2f}"
             f"{acc['backpressure'] / 1e6:>8.2f}"

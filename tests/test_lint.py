@@ -242,6 +242,39 @@ def test_trace_section_is_clean_when_valid():
     assert lint_config(cfg, "<fixture>") == []
 
 
+def test_bad_slo_unknown_key_and_grammar():
+    cfg = _cfg(slo={"fast_windw_s": 1.0})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-slo")
+    assert "did you mean 'fast_window_s'" in findings[0].message
+    # broken expression grammar is a schema failure too
+    fires_once(lint_config(_cfg(slo={"target": [
+        {"name": "t", "expr": "dst.rx frobnicate > 1"}]}),
+        "<fixture>"), "bad-slo")
+
+
+def test_bad_slo_unknown_tile_metric_link():
+    # target naming a metric the tile kind never exports: did-you-mean
+    cfg = _cfg(slo={"target": [{"name": "t", "expr": "dst.bytez > 1"}]})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-slo")
+    assert "did you mean 'bytes'" in findings[0].message
+    fires_once(lint_config(_cfg(slo={"target": [
+        {"name": "t", "expr": "link.ghost.backpressure rate < 1/s"}]}),
+        "<fixture>"), "bad-slo")
+    fires_once(lint_config(_cfg(slo={"target": [
+        {"name": "t", "expr": "ghost.work p99 < 1ms"}]}),
+        "<fixture>"), "bad-slo")
+
+
+def test_slo_section_is_clean_when_valid():
+    cfg = _cfg(slo={"fast_window_s": 1.0, "target": [
+        {"name": "lat", "expr": "dst.work p99 < 5ms"},
+        {"name": "bp", "expr": "link.a_b.backpressure rate < 10/s"},
+        {"name": "rx", "expr": "dst.rx rate > 1/s"}]})
+    assert lint_config(cfg, "<fixture>") == []
+
+
 def test_lint_topology_programmatic():
     """Programmatic Topology builds get the same pass as TOML."""
     from firedancer_tpu.disco import Topology
