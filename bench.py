@@ -175,35 +175,17 @@ def _child_bench():
     sys.stdout.flush()
 
 
-def _e2e_bench():
-    """End-to-end tile pipeline TPS on the resolved backend: synth ->
-    verify(device) -> dedup -> sink across four OS processes over shm
-    rings (BASELINE config 3/4 — the verify-tile replay measurement;
-    ref: src/app/shared_dev/commands/bench/ bencho TPS observation).
-
-    Prints one JSON line: {"e2e_tps", "e2e_count", "e2e_wall_s",
-    "e2e_verify_work_p99_ms", "platform"}. TPS counts frags INGESTED by
-    the verify tile (rx, incl. dup drops — the tile's real workload);
-    the clock starts when every tile reaches RUN (compile excluded) and
-    stops when the last unique txn reaches the sink.
-
-    NOTE: this process must NOT initialize the jax backend — the verify
-    tile's process owns the (exclusive) device tunnel; platform is
-    inferred from the env the tiles will see."""
-    sys.path.insert(0, HERE)
+def _e2e_run(count: int, unique: int, batch: int,
+             rate_tps: float = 0.0, coalesce_us: float = 0.0):
+    """One synth -> verify -> dedup -> sink topology run; returns the
+    measured record (tps, stage budget, link budget). rate_tps > 0
+    paces the synth (the offered axis of the sweep); 0 lets it rip
+    (capacity measurement)."""
     from firedancer_tpu.disco import Topology, TopologyRunner
     from firedancer_tpu.disco.metrics import (link_lag, merge_hists,
                                               quantile_ns, read_hists,
                                               read_link_metrics)
 
-    # sizing against the ~60 ms tunnel dispatch latency: throughput
-    # ceiling ~= batch * inflight / latency, so 2048 * 3 / 60ms ~= 100K
-    # frags/s of device headroom; the ingest ring must hold several
-    # in-flight batches or the batch can never fill (VERDICT r4 item 2)
-    count = int(os.environ.get("FDTPU_BENCH_E2E_COUNT", "65536"))
-    unique = int(os.environ.get("FDTPU_BENCH_E2E_UNIQUE", "256"))
-    batch = int(os.environ.get("FDTPU_BENCH_E2E_BATCH", "2048"))
-    os.environ.setdefault("FDTPU_VERIFY_INFLIGHT", "3")
     topo = (
         Topology(f"bench{os.getpid()}", wksp_size=1 << 26)
         .link("ingest", depth=8192, mtu=1280)
@@ -212,9 +194,9 @@ def _e2e_bench():
         .tcache("verify_tc", depth=8192)
         .tcache("dedup_tc", depth=8192)
         .tile("synth", "synth", outs=["ingest"], count=count,
-              unique=unique, burst=1024, seed=17)
+              unique=unique, burst=1024, seed=17, rate_tps=rate_tps)
         .tile("verify", "verify", ins=["ingest"], outs=["verify_dedup"],
-              batch=batch, tcache="verify_tc")
+              batch=batch, tcache="verify_tc", coalesce_us=coalesce_us)
         .tile("dedup", "dedup", ins=["verify_dedup"], outs=["dedup_sink"],
               tcache="dedup_tc", batch=1024)
         .tile("sink", "sink", ins=["dedup_sink"], batch=1024)
@@ -271,18 +253,108 @@ def _e2e_bench():
                 "consume_p99_us": round(quantile_ns(h, 0.99) / 1e3, 1)
                 if h else 0,
             }
-        out = {
+        return {
             "e2e_tps": round(count / wall, 1),
             "e2e_count": count,
             "e2e_wall_s": round(wall, 2),
             "e2e_verify_work_p99_ms": round(p99_ms, 2),
             "e2e_stage_budget": budget,
             "e2e_link_budget": link_budget,
-            "platform": os.environ.get("FDTPU_JAX_PLATFORM") or "device",
         }
     finally:
         runner.halt()
         runner.close()
+
+
+def _saturating_hop(rec: dict):
+    """Attribute a sweep point's bottleneck: the highest-occupancy tile
+    and the first link (in hop order) showing producer backpressure —
+    the two answers 'which hop saturates first' decomposes into."""
+    budget = rec.get("e2e_stage_budget", {})
+    top_tile = max(budget, key=lambda t: budget[t]["occupancy"]) \
+        if budget else None
+    links = rec.get("e2e_link_budget", {})
+    bp_link = None
+    for ln in ("ingest", "verify_dedup", "dedup_sink"):
+        if links.get(ln, {}).get("backpressure", 0) > 0:
+            bp_link = ln
+            break
+    return top_tile, bp_link
+
+
+def _e2e_bench():
+    """End-to-end tile pipeline TPS on the resolved backend: synth ->
+    verify(device) -> dedup -> sink across four OS processes over shm
+    rings (BASELINE config 3/4 — the verify-tile replay measurement;
+    ref: src/app/shared_dev/commands/bench/ bencho TPS observation).
+
+    Prints one JSON line: {"e2e_tps", "e2e_count", "e2e_wall_s",
+    "e2e_verify_work_p99_ms", "e2e_offered_sweep", "e2e_knee_tps",
+    "platform"}. TPS counts frags INGESTED by the verify tile (rx,
+    incl. dup drops — the tile's real workload); the clock starts when
+    every tile reaches RUN (compile excluded) and stops when the last
+    unique txn reaches the sink.
+
+    The offered-load sweep (r10) re-runs the topology with the synth
+    paced at fractions of the measured capacity and records, per
+    point, achieved-vs-offered plus which hop saturated first (top
+    occupancy tile, first backpressured link). The knee — the highest
+    offered load still served at >= 90% — is the number future PRs
+    must move, and the per-point hop attribution says what to fix.
+
+    NOTE: this process must NOT initialize the jax backend — the verify
+    tile's process owns the (exclusive) device tunnel; platform is
+    inferred from the env the tiles will see."""
+    sys.path.insert(0, HERE)
+    # sizing against the ~60 ms tunnel dispatch latency: throughput
+    # ceiling ~= batch * inflight / latency, so 2048 * 3 / 60ms ~= 100K
+    # frags/s of device headroom; the ingest ring must hold several
+    # in-flight batches or the batch can never fill (VERDICT r4 item 2)
+    count = int(os.environ.get("FDTPU_BENCH_E2E_COUNT", "65536"))
+    unique = int(os.environ.get("FDTPU_BENCH_E2E_UNIQUE", "256"))
+    batch = int(os.environ.get("FDTPU_BENCH_E2E_BATCH", "2048"))
+    coalesce_us = float(os.environ.get("FDTPU_BENCH_E2E_COALESCE_US",
+                                       "500"))
+    os.environ.setdefault("FDTPU_VERIFY_INFLIGHT", "3")
+    out = _e2e_run(count, unique, batch, coalesce_us=coalesce_us)
+    out["platform"] = os.environ.get("FDTPU_JAX_PLATFORM") or "device"
+
+    # offered-load sweep: fractions of the measured capacity (override:
+    # FDTPU_BENCH_E2E_SWEEP="0.5,0.8,1.1" — empty string disables)
+    fracs_env = os.environ.get("FDTPU_BENCH_E2E_SWEEP", "0.5,0.8,1.2")
+    fracs = [float(f) for f in fracs_env.split(",") if f.strip()]
+    if fracs:
+        cap = out["e2e_tps"]
+        sweep = []
+        for frac in fracs:
+            offered = cap * frac
+            # ~2 s of traffic per point, floored so the batch pipeline
+            # actually engages; compile is warm from the first run
+            n_pt = int(max(8192, min(count, offered * 2)))
+            try:
+                rec = _e2e_run(n_pt, unique, batch, rate_tps=offered,
+                               coalesce_us=coalesce_us)
+            except Exception as e:  # noqa: BLE001 — annotate the point
+                sweep.append({"offered_tps": round(offered, 1),
+                              "error": f"{e!r}"[:200]})
+                continue
+            top_tile, bp_link = _saturating_hop(rec)
+            sweep.append({
+                "offered_tps": round(offered, 1),
+                "achieved_tps": rec["e2e_tps"],
+                "served_frac": round(rec["e2e_tps"] / offered, 3)
+                if offered else 0.0,
+                "top_occupancy_tile": top_tile,
+                "first_backpressured_link": bp_link,
+            })
+        out["e2e_offered_sweep"] = sweep
+        served = [p for p in sweep if p.get("served_frac", 0) >= 0.9]
+        # no point served >= 90% (all errored, or pacing never kept
+        # up): the knee is UNKNOWN-BAD, reported null — falling back
+        # to raw capacity would report the most optimistic number
+        # exactly when the sweep proved no offered load is sustained
+        knee = max((p["achieved_tps"] for p in served), default=None)
+        out["e2e_knee_tps"] = round(knee, 1) if knee is not None else None
     print(json.dumps(out))
     sys.stdout.flush()
 
@@ -375,6 +447,22 @@ def main():
             result["e2e_error"] = f"{e3!r}"[:300]
     print(json.dumps(result))
     sys.stdout.flush()
+    sys.exit(_gate_rc(result, os.environ.get("FDTPU_BENCH_GATE_E2E")))
+
+
+def _gate_rc(result: dict, floor: str | None) -> int:
+    """Regression gate: nonzero when an e2e floor is set and the
+    measured (or witnessed-fallback) e2e_tps is below it — so the
+    harness can fail a PR that regresses the pipeline. A skipped e2e
+    stage falls back to the witnessed record's tps; no number at all
+    under a floor is itself a failure (a gate that silently passes on
+    a broken bench gates nothing)."""
+    if not floor:
+        return 0
+    tps = result.get("e2e_tps")
+    if tps is None:
+        tps = result.get("witnessed_tpu", {}).get("e2e_tps")
+    return 0 if tps is not None and float(tps) >= float(floor) else 1
 
 
 if __name__ == "__main__":

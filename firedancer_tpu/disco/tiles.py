@@ -39,9 +39,12 @@ def _gather_all(ctx, seqs: dict, mtus: dict, batch: int, handle,
                 m: dict) -> int:
     """Shared multi-in-link poll loop: gather each ring, count
     overruns into m['overruns'], dispatch every frame to handle.
-    With tracing on, every consumed frag leaves a (sampled) lineage
-    record keyed by its sig — the downstream half of the cross-tile
-    frag-lineage chain."""
+    With tracing on, each gathered batch leaves its (sampled) lineage
+    records via ONE vectorized frag_batch append — the downstream half
+    of the cross-tile frag-lineage chain, with no per-frag Python on
+    the traced path. The per-frame `handle` dispatch remains: callers
+    of this helper (shred/tower/…) do inherently frame-granular work
+    (parse + state machine per frame), not batchable ring I/O."""
     tr = getattr(ctx, "trace", None)
     total = 0
     for ln, ring in ctx.in_rings.items():
@@ -52,9 +55,7 @@ def _gather_all(ctx, seqs: dict, mtus: dict, batch: int, handle,
         m["overruns"] += ovr
         if tr is not None and n:
             from ..trace.events import EV_CONSUME
-            lid = tr.link_id(ln)
-            for i in range(n):
-                tr.frag(EV_CONSUME, sig=int(sigs[i]), link=lid)
+            tr.frag_batch(EV_CONSUME, sigs[:n], link=tr.link_id(ln))
         for i in range(n):
             handle(bytes(buf[i, :sizes[i]]))
         total += n
@@ -97,7 +98,8 @@ def _setup_jax():
 class SynthAdapter:
     """Load generator (the reference's benchg tile,
     ref: src/app/shared_dev/commands/bench/fd_benchg_tile.c).
-    args: count (total txns), seed, burst."""
+    args: count (total txns), seed, burst, rate_tps (0 = unpaced;
+    token-bucket pacing for bench.py's offered-load sweep)."""
 
     METRICS = ["tx", "backpressure"]
 
@@ -108,13 +110,16 @@ class SynthAdapter:
         self.ctx = ctx
         self.count = int(args.get("count", 1024))
         self.burst = int(args.get("burst", 32))
+        self.rate_tps = float(args.get("rate_tps", 0.0))
+        self._t0 = None               # pacing clock starts on first poll
         n_unique = min(self.count, int(args.get("unique", 64)))
         txns = make_signed_txns(n_unique, seed=int(args.get("seed", 0)))
         self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
         self.fseqs = _single(ctx.out_fseqs, "out link", ctx.tile_name)
-        # pre-pack unique txns into one padded buffer so each burst is
-        # a native credit-gated batch publish, not a per-txn Python
-        # loop (the benchg hot loop is C for the same reason)
+        # pre-render the unique-frame pool ONCE into one padded buffer
+        # and replay it: each burst is a native credit-gated batch
+        # publish, never a per-txn Python loop (the benchg hot loop is
+        # C for the same reason)
         stride = max((len(t) for t in txns), default=1)
         self._buf = np.zeros((n_unique, stride), np.uint8)
         self._sizes = np.zeros(n_unique, np.uint32)
@@ -130,6 +135,16 @@ class SynthAdapter:
         if self.sent >= self.count or not self._n_unique:
             return 0
         b = min(self.burst, self.count - self.sent)
+        if self.rate_tps > 0:
+            # offered-load pacing: publish no faster than the token
+            # budget elapsed wall time has earned (the sweep's offered
+            # axis; an unpaced synth measures capacity, not the knee)
+            if self._t0 is None:
+                self._t0 = time.perf_counter()
+            earned = int((time.perf_counter() - self._t0) * self.rate_tps)
+            b = min(b, earned - self.sent)
+            if b <= 0:
+                return 0
         idx = np.arange(self.sent, self.sent + b) % self._n_unique
         stop, pub = self.out.publish_batch(
             self._buf[idx], self._sizes[idx],
@@ -140,9 +155,10 @@ class SynthAdapter:
         tr = getattr(self.ctx, "trace", None)
         if tr is not None and pub:
             from ..trace.events import EV_PUBLISH
-            lid = tr.link_id(next(iter(self.ctx.out_rings)))
-            for s in range(self.sent, self.sent + pub):
-                tr.frag(EV_PUBLISH, sig=s, link=lid)
+            tr.frag_batch(
+                EV_PUBLISH,
+                np.arange(self.sent, self.sent + pub, dtype=np.uint64),
+                link=tr.link_id(next(iter(self.ctx.out_rings))))
         self.sent += pub
         return pub
 
@@ -196,6 +212,7 @@ class VerifyAdapter:
             devices=int(args.get("devices", 1)),
             device_retries=int(args.get("device_retries", 2)),
             device_fail_limit=int(args.get("device_fail_limit", 3)),
+            coalesce_us=float(args.get("coalesce_us", 0.0)),
             chaos=args.get("chaos"),
             trace=ctx.trace,
             trace_link=(ctx.trace.link_id(out_ln)
@@ -290,21 +307,32 @@ class DedupAdapter:
                 continue
             total += n
             self.m["rx"] += n
-            for i in range(n):
-                sig = int(sigs[i])
-                if tr is not None:
-                    tr.frag(EV_CONSUME, sig=sig, link=self._tr_ins[ln])
-                if self.tcache.insert(sig):
-                    self.m["dup"] += 1
-                    continue
-                while self.out_fseqs and \
-                        self.out.credits(self.out_fseqs) <= 0:
-                    self.m["backpressure"] += 1
-                    time.sleep(20e-6)
-                self.out.publish(buf[i, :sizes[i]], sig=sig)
-                self.m["tx"] += 1
-                if tr is not None:
-                    tr.frag(EV_PUBLISH, sig=sig, link=self._tr_out)
+            if tr is not None:
+                tr.frag_batch(EV_CONSUME, sigs[:n],
+                              link=self._tr_ins[ln])
+            # the whole gather dedups as ONE native insert-or-dup call
+            # and forwards as credit-gated native batch publishes — no
+            # per-frag Python on the global dedup stage (the reference
+            # dedup hot loop is C, src/disco/dedup/fd_dedup_tile.c)
+            dup = self.tcache.insert_batch(sigs[:n])
+            self.m["dup"] += int(dup.sum())
+            mask = (dup == 0).astype(np.uint8)
+            start = 0
+            while True:
+                stop, pub = self.out.publish_batch(
+                    buf[:n], sizes[:n], sigs[:n], mask,
+                    fseqs=self.out_fseqs, start=start)
+                self.m["tx"] += pub
+                if tr is not None and pub:
+                    live = sigs[start:stop][mask[start:stop] != 0]
+                    tr.frag_batch(EV_PUBLISH, live, link=self._tr_out)
+                start = stop
+                if start >= n:
+                    break
+                # out of downstream credits mid-batch: stall visibly,
+                # resume from the stop row (fd_fctl discipline)
+                self.m["backpressure"] += 1
+                time.sleep(20e-6)
         return total
 
     def in_seqs(self):
@@ -440,7 +468,10 @@ class PackAdapter:
                 (done_slot,) = struct.unpack_from("<Q", buf[i], 0)
                 self.cur_slot = done_slot + 1
             total += k
-        # 3) fill idle banks
+        # 3) fill idle banks: bank-count grain (one microblock per
+        # idle bank per poll), not frag grain — each publish carries a
+        # freshly scheduled microblock, there is nothing to batch
+        # fdlint: disable=per-frag-loop — bank-count control grain
         for bank, ln in enumerate(self.bank_links):
             if self.busy[bank] is not None:
                 continue
@@ -703,6 +734,10 @@ class BankAdapter:
         n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
             self.seq, 8, self.mtu)
         self.m["overruns"] += ovr
+        # microblock grain (<= 8 frames/poll): each iteration runs the
+        # SVM executor over the frame and emits its poh + completion
+        # control frags — the per-frame work IS the execution stage
+        # fdlint: disable=per-frag-loop — microblock execution grain
         for i in range(n):
             frame = bytes(buf[i, :sizes[i]])
             bank, txn_cnt, mb_id, slot = struct.unpack_from("<HHQQ",
@@ -999,7 +1034,12 @@ class PohAdapter:
     def poll_once(self) -> int:
         total = 0
         # 1) mix in executed microblocks (one hash consumed per record;
-        # fd_poh mixin semantics, src/ballet/poh/fd_poh.c)
+        # fd_poh mixin semantics, src/ballet/poh/fd_poh.c). The loop is
+        # inherently sequential: each mixin extends the hash CHAIN from
+        # the previous state, and every entry publish is an individually
+        # framed protocol object cut at a chain position — there is no
+        # batchable form of a strictly ordered recurrence
+        # fdlint: disable=per-frag-loop — sequential PoH chain grain
         for ln, ring in self.ctx.in_rings.items():
             n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
                 self.seqs[ln], 16, self.mtu)
@@ -2089,6 +2129,10 @@ class VinylAdapter:
         n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
             self.seq, 16, self.mtu)
         self.m["overruns"] += ovr
+        # request/response server grain: each frame is one db request
+        # (get/put/scan) whose parse, db call, and completion publish
+        # are a per-request protocol exchange, not batchable ring I/O
+        # fdlint: disable=per-frag-loop — req/resp serving grain
         for i in range(n):
             frame = bytes(buf[i, :sizes[i]])
             self._serve(frame)
@@ -2502,14 +2546,27 @@ class SinkAdapter:
         self.seqs = ctx.in_seqs0()
         self.mtu = max(ctx.plan["links"][ln]["mtu"] for ln in ctx.in_rings)
         self.m = {k: 0 for k in self.METRICS}
+        self._tr = getattr(ctx, "trace", None)
 
     def poll_once(self) -> int:
-        def count(frame):
-            self.m["rx"] += 1
-            self.m["bytes"] += len(frame)
-        return _gather_all(self.ctx, self.seqs,
-                           {ln: self.mtu for ln in self.seqs},
-                           self.batch, count, self.m)
+        # counting consumer: the whole gather tallies vectorized (one
+        # sizes-sum per batch) — the bencho TPS observation must never
+        # itself be the per-frag-Python bottleneck it measures
+        total = 0
+        for ln, ring in self.ctx.in_rings.items():
+            n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
+                self.seqs[ln], self.batch, self.mtu)
+            self.m["overruns"] += ovr
+            if not n:
+                continue
+            self.m["rx"] += n
+            self.m["bytes"] += int(sizes[:n].sum())
+            if self._tr is not None:
+                from ..trace.events import EV_CONSUME
+                self._tr.frag_batch(EV_CONSUME, sigs[:n],
+                                    link=self._tr.link_id(ln))
+            total += n
+        return total
 
     def in_seqs(self):
         return dict(self.seqs)

@@ -16,6 +16,16 @@ that runs inside a tile process. Three contract groups:
   * consumer progress: a registered adapter that reads `ctx.in_rings`
     must define `in_seqs()` — otherwise the stem never publishes its
     fseq progress and any reliable upstream producer wedges.
+  * per-frag loops: inside the poll_once call closure (poll_once plus
+    every same-module function it transitively calls), a Python `for`
+    loop may not call the single-item hot-path APIs — `.frag(` on a
+    trace writer, `.publish(` on a ring, `.insert(`/`.query(` on a
+    tcache — because batched equivalents exist (frag_batch,
+    publish_batch, insert_batch/query_batch) and per-txn Python on the
+    batched ingest/egress path is exactly the host bottleneck the r10
+    pipeline work removed. Frame-granular control work (parse + state
+    machine per microblock, per-socket syscalls) suppresses inline
+    with a justification.
 
 The same AST pass also exports `adapter_summaries()` — the per-kind
 facts (metrics, in_seqs, ring usage) the graph analyzer cross-checks
@@ -37,6 +47,7 @@ SUP_NAMES = ("sup_restarts", "sup_watchdog_trips", "sup_down")
 SUP_SLOT_MIN = 61
 
 _RING_RECEIVER = re.compile(r"ring|out|\brq\b|\bcq\b", re.I)
+_TCACHE_RECEIVER = re.compile(r"tcache|\btc\b", re.I)
 
 
 def own_nodes(fn: ast.AST):
@@ -117,7 +128,144 @@ def lint_tiles_source(source: str, path: str) -> list[Finding]:
         if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
                            ast.Lambda)):
             out.extend(_lint_function(fn, path))
+    out.extend(_lint_per_frag_loops(tree, path))
     return filter_suppressed(out, source)
+
+
+def _called_names(fn: ast.AST):
+    """Names this function's own body calls OR hands off as callback
+    arguments: bare names, self.attr methods, and Name/Attribute
+    arguments of calls — the intra-module edges of the poll_once call
+    closure (a handler passed into a gather helper is just as hot as
+    one called directly)."""
+    for node in own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            yield f.id
+        elif isinstance(f, ast.Attribute):
+            yield f.attr
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, ast.Name):
+                yield a.id
+            elif isinstance(a, ast.Attribute):
+                yield a.attr
+
+
+def _hot_closure(tree: ast.Module):
+    """Functions reachable from any poll_once by same-module calls
+    (matched by bare name — class boundaries ignored on purpose: a
+    helper shared by two adapters is hot if either reaches it)."""
+    defs: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, []).append(node)
+    hot: set[str] = set()
+    work = ["poll_once"]
+    while work:
+        name = work.pop()
+        if name in hot or name not in defs:
+            continue
+        hot.add(name)
+        for fn in defs[name]:
+            work.extend(_called_names(fn))
+    return [fn for name in hot for fn in defs[name]]
+
+
+def _single_item_call(node: ast.AST):
+    """-> (receiver, name, batched) when `node` is a single-item
+    hot-path API call with a batched equivalent, else None."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return None
+    name = node.func.attr
+    recv = ast.unparse(node.func.value)
+    if name == "frag":
+        return recv, name, "frag_batch"
+    if name == "publish" and _RING_RECEIVER.search(recv):
+        return recv, name, "publish_batch"
+    if name in ("insert", "query") and _TCACHE_RECEIVER.search(recv):
+        return recv, name, f"{name}_batch"
+    return None
+
+
+def _tainted_fns(tree: ast.Module) -> set[str]:
+    """Same-module function names whose call closure reaches a
+    single-item hot-path API — so a for loop calling such a helper
+    per iteration is per-frag work even though the .publish itself
+    lives a frame lower."""
+    direct: set[str] = set()
+    edges: dict[str, set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if any(_single_item_call(x) for x in own_nodes(node)):
+            direct.add(node.name)
+        edges.setdefault(node.name, set()).update(_called_names(node))
+    tainted = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for name, calls in edges.items():
+            if name not in tainted and calls & tainted:
+                tainted.add(name)
+                changed = True
+    return tainted
+
+
+def _lint_per_frag_loops(tree: ast.Module, path: str) -> list[Finding]:
+    """Flag single-item hot-path API calls inside `for` loops of the
+    poll_once call closure — each has a batched equivalent, and one
+    per-frag Python iteration costs more than the whole native batch
+    call it should have been. Indirect forms count too: a loop calling
+    a same-module helper whose closure reaches a single-item API is
+    the same defect one frame deeper (the nested-closure-handed-to-a-
+    gather-helper pattern rides the closure walk in _called_names)."""
+    out: list[Finding] = []
+    tainted = _tainted_fns(tree)
+    seen: set[tuple[int, int]] = set()   # nested fors see a call twice
+    for fn in _hot_closure(tree):
+        for loop in own_nodes(fn):
+            if not isinstance(loop, ast.For):
+                continue
+            for node in own_nodes(loop):
+                if (getattr(node, "lineno", 0),
+                        getattr(node, "col_offset", 0)) in seen:
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _single_item_call(node)
+                if hit:
+                    recv, name, batched = hit
+                    seen.add((node.lineno, node.col_offset))
+                    # anchor on the LOOP line: the loop is the defect
+                    # (and the suppression point), the call is the
+                    # evidence
+                    out.append(finding(
+                        "per-frag-loop", path, loop.lineno,
+                        f"{recv}.{name}() (line {node.lineno}) inside "
+                        f"a for loop in {fn.name}() (poll_once hot "
+                        f"path) — use the batched {recv}.{batched}() "
+                        f"outside the loop"))
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    callee = node.func.attr
+                if callee in tainted:
+                    seen.add((node.lineno, node.col_offset))
+                    out.append(finding(
+                        "per-frag-loop", path, loop.lineno,
+                        f"{callee}() (line {node.lineno}) called per "
+                        f"iteration in {fn.name}() (poll_once hot "
+                        f"path) reaches a single-item .frag/.publish/"
+                        f"tcache API — hoist to the batched form "
+                        f"outside the loop"))
+    return out
 
 
 def _lint_class(cls: ast.ClassDef, path: str) -> list[Finding]:

@@ -114,6 +114,14 @@ RULES: dict[str, tuple[str, str, str]] = {
         "contract", "error",
         "Fseq.mark_stale called from tile code — the STALE sentinel "
         "is supervision-owned (supervisor marks, rejoin clears)"),
+    "per-frag-loop": (
+        "contract", "error",
+        "per-frag Python for loop calling a single-item hot-path API "
+        "(.frag/.publish/tcache .insert/.query) inside a tile's "
+        "poll_once call closure — batched equivalents exist "
+        "(frag_batch/publish_batch/insert_batch/query_batch); "
+        "per-txn Python is the host-pipeline bottleneck the batched "
+        "tile contract forbids"),
     "silent-consumer": (
         "contract", "error",
         "registered adapter reads ctx.in_rings but defines no "

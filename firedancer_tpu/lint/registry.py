@@ -52,11 +52,12 @@ SLO_TARGET_KEYS = ("name", "expr", "fast_window_s", "slow_window_s",
                    "burn_fast", "burn_slow")
 
 TILE_ARGS: dict[str, dict[str, str | None]] = {
-    "synth": {"count": None, "burst": None, "unique": None, "seed": None},
+    "synth": {"count": None, "burst": None, "unique": None, "seed": None,
+              "rate_tps": None},
     "verify": {"batch": None, "max_len": None, "tcache": TCACHE,
                "device_retries": None, "device_timeout_s": None,
                "device_fail_limit": None, "rr_cnt": None, "rr_idx": None,
-               "devices": None},
+               "devices": None, "coalesce_us": None},
     "dedup": {"tcache": TCACHE, "batch": None},
     "pack": {"txn_in": IN, "bank_links": OUT_LIST, "done_links": IN_LIST,
              "slot_in": IN, "bundle_in": IN, "slot_ms": None,

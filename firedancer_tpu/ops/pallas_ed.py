@@ -85,22 +85,31 @@ _ONE = None
 
 
 def fadd(a, b):
-    # Kernel-wide loose bound B = 10650: every fe value entering fmul has
-    # limbs in [0, B]. fadd: 2B < 2^15, one pass leaves limbs ≤ 8193 and
-    # limb0 ≤ 8191 + 2·608 = 9407 ≤ B. Multiply safety is 20·B² < 2^32
-    # with the wrap-tolerant reduction in _reduce39.
+    # Kernel-wide loose bound B = 10624 (r10 carry tightening): every fe
+    # value entering fmul has limbs in [0, B], and multiply safety is
+    # 20·B² ≈ 2^31.07 < 2^32 — past int32 max but inside the
+    # wrap-tolerant uint32 window _reduce39's 2-pass carry recovers
+    # (B may grow to ⌊√(2^32/20)⌋ = 14654 before that window closes,
+    # so 10624 carries ~1.4× slack). fadd: 2B < 2^15, one pass leaves
+    # limbs ≤ 8193 and limb0 ≤ 8191 + 2·608 = 9407 ≤ B.
     return _carry(a + b, passes=1)
 
 
 def fsub(a, b):
-    # a + C − b with C ≡ 0 (mod p), per-limb 22752..65535 > B so the
-    # difference stays non-negative limb-wise; sum < 2^17; two passes
-    # leave limbs ≤ 8200, limb0 ≤ 8799 ≤ B
-    return _carry(a + _const_col(fe.SUB_C) - b, passes=2)
+    # a + C − b with C ≡ 0 (mod p), per-limb 22752..24573 > B so the
+    # difference stays non-negative limb-wise; sum ≤ B + 24573 = 35197,
+    # ONE pass (r10 — previously two) leaves limbs ≤ 8195 and
+    # limb0 ≤ 8191 + 608·(35197>>13) = 10623 ≤ B: the loose bound is
+    # DEFINED by this worst case (tests/test_pallas_bounds.py), and
+    # the dropped pass is ~60 elem-ops off every subtraction in the
+    # point formulas (~8% of the dsm budget).
+    return _carry(a + _const_col(fe.SUB_C) - b, passes=1)
 
 
 def fneg(a):
-    return _carry(_const_col(fe.SUB_C) - a, passes=2)
+    # the b=0 case of fsub's expression: sup 24573, one pass leaves
+    # limb0 ≤ 8191 + 608·2 = 9407 ≤ B
+    return _carry(_const_col(fe.SUB_C) - a, passes=1)
 
 
 def fmul_small2(a):
@@ -115,18 +124,18 @@ def _reduce39(c):
     """(2*NL-1, TB) schoolbook coefficients -> loose (NL, TB).
 
     Coefficients are sums of up to 20 limb products; with the kernel-wide
-    loose bound B = 10650 (see the invariant note on fmul) they reach
-    20·B² ≈ 2^31.08 — past int32 max but below 2^32, so the int32
+    loose bound B = 10624 (see the invariant note on fadd) they reach
+    20·B² ≈ 2^31.07 — past int32 max but below 2^32, so the int32
     accumulation wraps. Two's complement keeps the low bits exact:
     `c & MASK` is already the true low 13 bits, and masking the
     arithmetic shift to its low 19 bits recovers the true logical
     `hi = c >> 13` (true hi < 2^19 because the true value < 2^32).
 
     Two carry passes then restore the loose bound: input rows to the
-    carry are < 2^27.4 (hi ≤ 276903 from 20·B², row ≤ lo+hi ≤ 285094,
-    ×FOLD(608) + row ≤ 1.74e8); pass 1 leaves limbs ≤ 29389 and
-    limb0 ≤ 8191 + 608·21198 < 1.29e7; pass 2 leaves limb1 ≤ 9764,
-    limb0 ≤ 10015, others ≤ 8194 — all ≤ B, closing the invariant.
+    carry are < 2^27.4 (hi ≤ 275560 from 20·B², row ≤ lo+hi ≤ 283751,
+    ×FOLD(608) + row ≤ 1.73e8); pass 1 leaves limbs ≤ 29251 and
+    limb0 ≤ 8191 + 608·(x₁₉>>13) ≤ 649631; pass 2 leaves limb1 ≤ 8270,
+    limb0 ≤ 8799, others ≤ 8195 — all ≤ B, closing the invariant.
     (tests/test_pallas_bounds.py walks these intervals mechanically.)
     """
     lo = c & MASK

@@ -372,6 +372,35 @@ class TraceRing:
             | ((int(count) & 0xFFFFFFFF) << 32)
         v[0] = cur + 1
 
+    def append_batch(self, ts_ns: int, etype: int, sigs,
+                     arg: int = 0, link: int = TRACE_LINK_NONE,
+                     count: int = 0):
+        """Vectorized single-writer append of one record per sig: the
+        whole batch lands with ONE cursor bump (numpy scatter into the
+        ring view — no per-record Python). All records share the batch
+        timestamp/arg/link/meta; `sig` is the per-record lineage key.
+        When the batch exceeds the ring depth only the newest `depth`
+        records are materialized, but the cursor still counts every
+        one, so readers see the correct history-loss accounting."""
+        sigs = np.asarray(sigs, np.uint64)
+        n = len(sigs)
+        if not n:
+            return
+        v = self._v
+        cur = int(v[0])
+        keep = sigs[-self.depth:] if n > self.depth else sigs
+        m = len(keep)
+        slot = (cur + (n - m) + np.arange(m, dtype=np.int64)) \
+            & (self.depth - 1)
+        base = TRACE_HDR_U64 + slot * TRACE_REC_U64
+        m64 = (1 << 64) - 1
+        v[base] = ts_ns & m64
+        v[base + 1] = keep
+        v[base + 2] = int(arg) & m64
+        v[base + 3] = (etype & 0xFFFF) | ((link & 0xFFFF) << 16) \
+            | ((int(count) & 0xFFFFFFFF) << 32)
+        v[0] = cur + n
+
     def snapshot(self) -> tuple[int, np.ndarray]:
         """-> (cursor, records (n, 4) u64 oldest-first, n <= depth).
         A copy — safe to decode while the writer keeps appending; a

@@ -109,10 +109,20 @@ class VoteState:
             # not inflate the first rewarded epoch's earned delta.
             self.epoch_credits.append((epoch, 0, 0))
         elif self.epoch_credits[-1][0] != epoch:
-            _, cr, _ = self.epoch_credits[-1]
-            self.epoch_credits.append((epoch, cr, cr))
-            if len(self.epoch_credits) > 64:
-                self.epoch_credits.pop(0)
+            _, cr, prev = self.epoch_credits[-1]
+            if cr != prev:
+                self.epoch_credits.append((epoch, cr, cr))
+                if len(self.epoch_credits) > 64:
+                    self.epoch_credits.pop(0)
+            else:
+                # the open entry earned nothing: move it to the new
+                # epoch in place instead of appending, so empty epochs
+                # never consume 64-entry window slots (Agave
+                # vote_state::increment_credits "else just move the
+                # current epoch" branch — an appending impl diverges
+                # from Agave's history for vote accounts with quiet
+                # epochs, and the rewards calc reads this window)
+                self.epoch_credits[-1] = (epoch, cr, prev)
         self.credits += 1
         ep, cr, prev = self.epoch_credits[-1]
         self.epoch_credits[-1] = (ep, cr + 1, prev)

@@ -14,6 +14,28 @@ dead lanes, masked after) so XLA never recompiles in steady state; a txn
 with k signatures occupies k lanes and passes only if all k verify (the
 reference loops sigs the same way, fd_verify_tile.h:94).
 
+Device staging (r10): each rotating buffer set is ONE contiguous host
+buffer (len|sig|pub|msg lanes packed back to back — the native
+assembler writes straight into views of it), so a dispatch is a single
+async `device_put` of ~2.6 MB followed by the jit, which splits the
+lanes back out on-device (donated on real accelerators — the transfer
+buffer is consumed by the computation, never copied again). Because
+the put is async and each in-flight batch owns its own staging set,
+the host->device transfer of batch k overlaps the device compute of
+batch k-1 instead of serializing four little `jnp.asarray` copies
+through the ~60 ms tunnel per dispatch.
+
+Adaptive microbatch coalescing (r10): under steady load, dispatching
+whatever `gather` returned burns full fixed-shape compiled batches on
+mostly-padding lanes. With `coalesce_us` > 0 the tile HOLDS sub-full
+gathers in a staging window and dispatches when (a) the lane budget
+(one compiled batch) fills, (b) the window deadline expires while
+traffic trickles, or (c) ingest goes idle with no batch in device
+flight — an idle device is never kept waiting for a fuller batch, and
+the drain-on-idle rule below still retires every in-flight batch when
+ingest goes quiet mid-coalesce. Window config rides [tile.verify]
+(coalesce_us, validated by the fdlint key registry).
+
 Dedup ordering matches the reference (tag = per-boot seeded hash over
 the FULL 64-byte first signature, fd_verify_tile.h:82; queried BEFORE
 verify, inserted into the tcache only AFTER the signature verifies,
@@ -23,7 +45,9 @@ legitimate transaction), EXTENDED with a dispatch-time reservation:
 with up to `inflight` async device batches pending, a duplicate
 arriving inside the pipeline window would pass the tcache query and be
 forwarded twice (ADVICE r5). Candidate tags are therefore
-query-and-RESERVED in a host-local in-flight set at dispatch; a
+query-and-RESERVED against the pending records' tag window at dispatch
+(one vectorized membership test per batch — the window IS the pending
+queue, so reservations release themselves at finalize); a
 duplicate of an in-flight tag is DEFERRED (its payload parked, no
 device lanes spent) and decided when the reserving txn's verdict
 lands: reserver passed -> the deferred copy is a true duplicate,
@@ -85,6 +109,27 @@ def parse_batch(buf: np.ndarray, sizes: np.ndarray, seed: bytes):
     return meta, tags
 
 
+class _StageBuf:
+    """One rotating staging set: a single contiguous host buffer whose
+    lane regions (len|sig|pub|msg) are numpy views the native assembler
+    fills in place — the whole set ships to the device as ONE transfer.
+    `txn` (lane -> txn row map) is host-only bookkeeping and stays off
+    the wire."""
+
+    __slots__ = ("flat", "ln", "sig", "pub", "msg", "txn")
+
+    def __init__(self, batch: int, max_len: int):
+        self.flat = np.zeros(batch * (4 + 64 + 32 + max_len), np.uint8)
+        o = 4 * batch                      # int32 lens first: 4B-aligned
+        self.ln = self.flat[:o].view(np.int32)
+        self.sig = self.flat[o:o + 64 * batch].reshape(batch, 64)
+        o += 64 * batch
+        self.pub = self.flat[o:o + 32 * batch].reshape(batch, 32)
+        o += 32 * batch
+        self.msg = self.flat[o:].reshape(batch, max_len)
+        self.txn = np.zeros(batch, np.int32)
+
+
 class VerifyTile:
     def __init__(self, in_ring: Ring, out_ring: Ring, tcache: Tcache,
                  batch: int = 256, max_len: int = MTU,
@@ -95,7 +140,7 @@ class VerifyTile:
                  device_timeout_s: float | None = None,
                  device_fail_limit: int = 3, chaos: dict | None = None,
                  trace=None, trace_link: int = 0,
-                 trace_link_in: int = 0):
+                 trace_link_in: int = 0, coalesce_us: float = 0.0):
         self.in_ring, self.out_ring, self.tcache = in_ring, out_ring, tcache
         # horizontal sharding: N verify tiles consume the SAME ingest
         # link; tile rr_idx owns frags with seq % rr_cnt == rr_idx
@@ -131,14 +176,25 @@ class VerifyTile:
         self.device_fail_limit = max(1, int(device_fail_limit))
         self.degraded = False
         self._consec_fail = 0
-        # tags of txns dispatched but not yet finalized: duplicates
-        # inside the async pipeline window are deferred against this
-        # set and decided by the reserving txn's verdict (no device
-        # lanes spent, no censorship through a failed reserver)
-        self._inflight_tags: set[int] = set()
+        # duplicates inside the async pipeline window are deferred and
+        # decided by the reserving txn's verdict (no device lanes
+        # spent, no censorship through a failed reserver). The window
+        # itself is the pending records' `reserved` tag arrays — a
+        # record's tags leave the window the instant it pops for
+        # finalize, so there is no separate set to keep in sync, and
+        # membership tests run as one vectorized np.isin per batch.
         self._deferred: dict[int, list[bytes]] = {}
         self._deferred_n = 0
         self._deferred_cap = 256          # bounds attacker-driven parking
+        # adaptive coalescing window (0 = dispatch every gather as-is):
+        # sub-full gathers accumulate here until the lane budget fills,
+        # the deadline expires, or ingest idles with the device idle
+        self._coalesce_ns = max(0, int(float(coalesce_us) * 1e3))
+        self._hold_buf = np.zeros((batch, max_len), np.uint8) \
+            if self._coalesce_ns else None
+        self._hold_sizes = np.zeros(batch, np.uint32)
+        self._hold_n = 0
+        self._hold_deadline = 0
         self._chaos = None
         if chaos:
             from ..utils.chaos import ChaosPlan
@@ -193,10 +249,28 @@ class VerifyTile:
                 except TypeError:
                     vb = shard_map(vb, **skw, check_rep=False)
             self.devices = ndev
-            # lane buffers are rotating HOST staging arrays re-fed
-            # across dispatches; donation would invalidate an
-            # in-flight transfer's source
-            self._fn = jax.jit(vb)  # fdlint: disable=missing-donate
+            # staged dispatch: the jit consumes ONE packed uint8 buffer
+            # (the whole staging set) and splits the lanes on-device —
+            # host->device is a single transfer per dispatch. The
+            # transfer buffer is donated on real accelerators (each
+            # dispatch device_puts a fresh copy, so the computation may
+            # consume it); CPU device_put can alias host memory, where
+            # donation would hand XLA the live staging array.
+            bsz, mlen = batch, max_len
+            o_sig, o_pub = 4 * bsz, (4 + 64) * bsz
+            o_msg = o_pub + 32 * bsz
+
+            def _packed(flat):
+                import jax.numpy as jnp
+                lb = flat[:o_sig].reshape(bsz, 4).astype(jnp.int32)
+                ln = (lb[:, 0] | (lb[:, 1] << 8) | (lb[:, 2] << 16)
+                      | (lb[:, 3] << 24))
+                return vb(flat[o_sig:o_pub].reshape(bsz, 64),
+                          flat[o_pub:o_msg].reshape(bsz, 32),
+                          flat[o_msg:].reshape(bsz, mlen), ln)
+
+            donate = (0,) if jax.devices()[0].platform != "cpu" else ()
+            self._fn = jax.jit(_packed, donate_argnums=donate)
         else:
             raise ValueError(backend)
         # pipelined dispatch: keep up to `inflight` device batches in
@@ -208,13 +282,8 @@ class VerifyTile:
         # so an in-flight transfer never reads a reused host buffer.
         self.inflight = max(1, int(os.environ.get(
             "FDTPU_VERIFY_INFLIGHT", "2")))
-        self._bufsets = [
-            (np.zeros((batch, 64), np.uint8),
-             np.zeros((batch, 32), np.uint8),
-             np.zeros((batch, max_len), np.uint8),
-             np.zeros((batch,), np.int32),
-             np.zeros((batch,), np.int32))
-            for _ in range(self.inflight + 1)]
+        self._bufsets = [_StageBuf(batch, max_len)
+                         for _ in range(self.inflight + 1)]
         self._bufset_fut = [None] * (self.inflight + 1)
         self._disp = 0
         from collections import deque
@@ -231,15 +300,14 @@ class VerifyTile:
         # compile legitimately takes minutes.
         self.warmup_timeout_s = float(os.environ.get(
             "FDTPU_VERIFY_WARMUP_TIMEOUT_S", "600"))
-        s0, p0, m0, l0, _ = self._bufsets[0]
         for attempt in range(self.device_retries + 1):
-            if self._warmup_once(s0, p0, m0, l0):
+            if self._warmup_once(self._bufsets[0]):
                 break
             self.metrics["device_errors"] += 1
         else:
             self._degrade("device warmup failed")
 
-    def _warmup_once(self, s0, p0, m0, l0) -> bool:
+    def _warmup_once(self, bs: _StageBuf) -> bool:
         """One warmup attempt on a daemon thread with a deadline (a
         hung warmup must not hold the tile in BOOT forever)."""
         import queue
@@ -249,8 +317,7 @@ class VerifyTile:
         def _worker():
             try:
                 import jax
-                jax.block_until_ready(
-                    self._device_verify(s0, p0, m0, l0))
+                jax.block_until_ready(self._device_verify(bs))
                 q.put(True)
             except Exception:          # noqa: BLE001
                 q.put(False)
@@ -273,12 +340,15 @@ class VerifyTile:
             from ..utils import log
             log.warning(f"verify: degrading to CPU reference path ({why})")
 
-    def _device_verify(self, sig, pub, msg, ln):
-        """Async dispatch: returns the device verdict array WITHOUT
-        forcing readback — callers pipeline and block later."""
-        import jax.numpy as jnp
-        return self._fn(jnp.asarray(sig), jnp.asarray(pub),
-                        jnp.asarray(msg), jnp.asarray(ln))
+    def _device_verify(self, bs: _StageBuf):
+        """Async staged dispatch: ONE host->device transfer of the
+        packed staging buffer (device_put starts the copy and returns;
+        the jit splits lanes on-device), then the verdict future —
+        never forced, callers pipeline and block later. The staging
+        set stays untouched until its future resolves (_bufset_fut
+        guard), so the async transfer always reads stable memory."""
+        import jax
+        return self._fn(jax.device_put(bs.flat))
 
     def _hb_tick(self, i: int):
         """Heartbeat every few host verifies: a pure-Python ed25519
@@ -288,24 +358,24 @@ class VerifyTile:
         if i % 8 == 0 and self._cnc is not None:
             self._cnc.heartbeat()
 
-    def _cpu_verify_lanes(self, sig, pub, msg, ln, lanes: int):
+    def _cpu_verify_lanes(self, bs: _StageBuf, lanes: int):
         """Reference-verifier verdicts for assembled lanes (fallback
         path — lane buffers are only valid at dispatch time)."""
         from ..utils.ed25519_ref import verify as _ref_verify
-        out = np.zeros(sig.shape[0], bool)
+        out = np.zeros(bs.sig.shape[0], bool)
         for i in range(int(lanes)):
             self._hb_tick(i)
-            mlen = int(ln[i])
-            out[i] = _ref_verify(bytes(sig[i]), bytes(pub[i]),
-                                 bytes(msg[i, :mlen]))
+            mlen = int(bs.ln[i])
+            out[i] = _ref_verify(bytes(bs.sig[i]), bytes(bs.pub[i]),
+                                 bytes(bs.msg[i, :mlen]))
         return out
 
-    def _dispatch(self, sig, pub, msg, ln, lanes: int):
+    def _dispatch(self, bs: _StageBuf, lanes: int):
         """Guarded device dispatch: bounded retry, chaos injection, and
         CPU fallback. Returns either an async device array or a numpy
         verdict array (already final)."""
         if self.degraded:
-            return self._cpu_verify_lanes(sig, pub, msg, ln, lanes)
+            return self._cpu_verify_lanes(bs, lanes)
         from ..utils.chaos import ChaosDeviceError
         for attempt in range(self.device_retries + 1):
             try:
@@ -316,7 +386,7 @@ class VerifyTile:
                         chaos_event(self._trace, "fail_dispatch")
                     raise ChaosDeviceError("injected dispatch failure")
                 t0 = monotonic_ns()
-                fut = self._device_verify(sig, pub, msg, ln)
+                fut = self._device_verify(bs)
                 self.tpu_hist.add(monotonic_ns() - t0)
                 if self._trace is not None:
                     from ..trace.events import EV_TPU_DISPATCH
@@ -328,7 +398,7 @@ class VerifyTile:
         if self._consec_fail >= self.device_fail_limit:
             self._degrade(f"{self._consec_fail} consecutive dispatch "
                           f"failures")
-        return self._cpu_verify_lanes(sig, pub, msg, ln, lanes)
+        return self._cpu_verify_lanes(bs, lanes)
 
     def _read_verdicts(self, fut):
         """Readback with timeout: numpy (CPU-fallback) verdicts pass
@@ -370,16 +440,19 @@ class VerifyTile:
         return np.asarray(fut)
 
     def poll_once(self) -> int:
-        """Gather -> parse -> ha-dedup -> async device verify -> (queue)
-        -> publish.
+        """Gather -> (coalesce) -> parse -> ha-dedup -> async device
+        verify -> (queue) -> publish.
 
         The whole host side is batched: one native call parses + tags the
         gathered frame set (fdtpu_txn_parse_batch), one native call per
         device chunk assembles lanes (fdtpu_verify_assemble), tcache
-        query/insert run as native batch loops, and the egress copies +
-        credit checks are one native call (fdtpu_ring_publish_batch) —
-        no per-txn Python on the hot path (the reference's host path is
-        C for the same reason, src/disco/verify/fd_verify_tile.h:60-111).
+        query/insert run as native batch loops, the in-flight dedup
+        reservation is one vectorized membership test, trace lineage
+        lands via frag_batch, and the egress copies + credit checks are
+        one native call (fdtpu_ring_publish_batch) — no per-txn Python
+        on the hot path (the reference's host path is C for the same
+        reason, src/disco/verify/fd_verify_tile.h:60-111; enforced by
+        fdlint's per-frag-loop rule).
 
         Device dispatch is ASYNC with up to `inflight` batches queued:
         verdict readback of batch k overlaps gather/parse/dispatch of
@@ -387,11 +460,19 @@ class VerifyTile:
         Returns number of frags CONSUMED (0 only when the ring was idle)."""
         self._drain(block=False)
         n, self.seq, buf, sizes, sigs, ovr, seqs = self.in_ring.gather(
-            self.seq, self.batch, self.max_len, want_seqs=True)
+            self.seq, self.batch - self._hold_n, self.max_len,
+            want_seqs=True)
         self.metrics["overruns"] += ovr
         if not n:
-            # idle ingest: retire everything in flight — queued
-            # verdicts must never wait on more traffic arriving
+            # idle ingest: a held sub-batch dispatches now rather than
+            # waiting for traffic that may never come — unless batches
+            # are still in device flight, in which case holding is free
+            # (the device isn't idle) until the window deadline. And
+            # in-flight batches ALWAYS retire: queued verdicts must
+            # never wait on more traffic arriving (drain-on-idle).
+            if self._hold_n and (not self._pending or
+                                 monotonic_ns() >= self._hold_deadline):
+                self._flush_hold()
             if self._pending:
                 self._drain(block=True)
             return 0
@@ -409,14 +490,50 @@ class VerifyTile:
             buf, sizes, sigs = buf[:n], sizes[:n], sigs[:n]
         self.metrics["rx"] += n
         if self._trace is not None:
-            # ingest lineage anchors (sampled): the upstream producer's
-            # sig, so synth/quic -> verify hand-offs correlate too
+            # ingest lineage anchors (sampled, one vectorized append):
+            # the upstream producer's sig, so synth/quic -> verify
+            # hand-offs correlate too
             from ..trace.events import EV_CONSUME
-            for s in sigs:
-                self._trace.frag(EV_CONSUME, sig=int(s),
-                                 link=self._trace_link_in)
+            self._trace.frag_batch(EV_CONSUME, sigs,
+                                   link=self._trace_link_in)
+        if not self._coalesce_ns:
+            self._process_batch(buf, sizes, n)
+            return consumed
+        # adaptive coalescing: accumulate sub-full gathers into the
+        # hold window; dispatch when one compiled batch's lane budget
+        # fills or the window deadline expires under a trickle. A FULL
+        # gather with nothing held bypasses the window entirely — under
+        # saturation the fresh gather buffer dispatches directly, never
+        # paying the stage-into-hold + recycle-copy that exists only to
+        # keep sub-full remainders alive across polls
+        if not self._hold_n and n >= self.batch:
+            self._process_batch(buf, sizes, n)
+            return consumed
+        if not self._hold_n:
+            self._hold_deadline = monotonic_ns() + self._coalesce_ns
+        self._hold_buf[self._hold_n:self._hold_n + n] = buf
+        self._hold_sizes[self._hold_n:self._hold_n + n] = sizes
+        self._hold_n += n
+        if self._hold_n >= self.batch or \
+                monotonic_ns() >= self._hold_deadline:
+            self._flush_hold()
+        return consumed
 
-        sizes = np.asarray(sizes, np.uint32)
+    def _flush_hold(self):
+        """Dispatch the coalesced window. The hold buffer is recycled
+        for the next window, so the record keeps its own copy (one bulk
+        memcpy per dispatched batch — the price of a fresh gather
+        buffer, paid once per BATCH instead of once per gather)."""
+        n, self._hold_n = self._hold_n, 0
+        self._process_batch(self._hold_buf[:n].copy(),
+                            self._hold_sizes[:n].copy(), n)
+
+    def _process_batch(self, buf, sizes, n: int):
+        """Parse -> tag -> ha-dedup + batched in-flight reservation ->
+        fixed-shape device chunks, dispatched async (the verify
+        pipeline behind the gather/coalesce stage)."""
+        buf = np.ascontiguousarray(buf[:n])
+        sizes = np.ascontiguousarray(sizes[:n], np.uint32)
         meta, tags = parse_batch(buf, sizes, self.dedup_seed)
         ok = meta[:, 0] != 0
         self.metrics["parse_fail"] += int(n - ok.sum())
@@ -427,35 +544,46 @@ class VerifyTile:
         # a duplicate of a txn still in device flight spends no lanes
         # here — it parks in the deferral pool and is decided by the
         # reserving txn's verdict at finalize (ADVICE r5; see module
-        # docstring for why it must not be dropped outright)
+        # docstring for why it must not be dropped outright). The
+        # reservation is BATCHED: one np.isin against the pending
+        # records' reserved-tag window + a first-occurrence mask for
+        # intra-batch twins; only the rare raced duplicates fall to the
+        # python parking loop.
         hit = self.tcache.query_batch(tags, mask=ok.astype(np.uint8))
         dup_pre = ok & (hit != 0)
         self.metrics["dedup_drop"] += int(dup_pre.sum())
-        reserved = []
-        for i in np.nonzero(ok & ~dup_pre)[0]:
-            t = int(tags[i])
-            if t in self._inflight_tags:
-                dup_pre[i] = True        # defer: twin still in flight
-                if self._deferred_n < self._deferred_cap:
-                    self._deferred.setdefault(t, []).append(
-                        bytes(buf[i, :sizes[i]]))
-                    self._deferred_n += 1
-                else:
-                    self.metrics["dedup_drop"] += 1    # pool overflow
-            else:
-                self._inflight_tags.add(t)
-                reserved.append(t)
+        cand_idx = np.nonzero(ok & ~dup_pre)[0]
+        reserved = np.zeros(0, np.uint64)
+        if cand_idx.size:
+            ctags = tags[cand_idx]
+            window = [r["reserved"] for r in self._pending
+                      if len(r["reserved"])]
+            infl = np.isin(ctags, np.concatenate(window)) if window \
+                else np.zeros(len(ctags), bool)
+            first = np.zeros(len(ctags), bool)
+            first[np.unique(ctags, return_index=True)[1]] = True
+            res_m = first & ~infl
+            reserved = ctags[res_m]
+            defer = cand_idx[~res_m]
+            if defer.size:
+                dup_pre[defer] = True    # twins still in flight: defer
+                for i in defer:
+                    if self._deferred_n < self._deferred_cap:
+                        self._deferred.setdefault(int(tags[i]), []) \
+                            .append(bytes(buf[i, :sizes[i]]))
+                        self._deferred_n += 1
+                    else:
+                        self.metrics["dedup_drop"] += 1  # pool overflow
         skip = np.ascontiguousarray(~ok | dup_pre).astype(np.uint8)
         cand = ok & ~dup_pre
         if not cand.any():
-            return consumed
+            return
 
         # device verify in fixed-shape chunks (native lane assembly),
         # dispatched async. FAIL-CLOSED: a candidate txn counts as
         # verified only if every one of its signature lanes ran on the
         # device AND passed; any txn the assembler skips (over-MTU msg)
         # or cannot place is dropped, never forwarded unverified.
-        buf = np.ascontiguousarray(buf)
         chunks = []
         cursor = ct.c_int64(0)
         while cursor.value < n:
@@ -469,34 +597,31 @@ class VerifyTile:
                 except Exception:
                     pass              # degraded inside _read_verdicts
                 self._bufset_fut[k] = None
-            lane_sig, lane_pub, lane_msg, lane_len, lane_txn = \
-                self._bufsets[k]
+            bs = self._bufsets[k]
             lanes = _lib.fdtpu_verify_assemble(
                 buf.ctypes.data_as(_u8p),
                 sizes.ctypes.data_as(_u32p),
                 meta.ctypes.data_as(_i32p), skip.ctypes.data_as(_u8p),
                 n, buf.shape[1], ct.byref(cursor), self.batch,
                 self.max_len,
-                lane_sig.ctypes.data_as(_u8p),
-                lane_pub.ctypes.data_as(_u8p),
-                lane_msg.ctypes.data_as(_u8p),
-                lane_len.ctypes.data_as(_i32p),
-                lane_txn.ctypes.data_as(_i32p))
+                bs.sig.ctypes.data_as(_u8p),
+                bs.pub.ctypes.data_as(_u8p),
+                bs.msg.ctypes.data_as(_u8p),
+                bs.ln.ctypes.data_as(_i32p),
+                bs.txn.ctypes.data_as(_i32p))
             if not lanes:
                 break
-            fut = self._dispatch(lane_sig, lane_pub, lane_msg,
-                                 lane_len, lanes)
+            fut = self._dispatch(bs, lanes)
             if not isinstance(fut, np.ndarray):
                 self._bufset_fut[k] = fut
             self._disp += 1
             self.metrics["batches"] += 1
-            chunks.append((fut, lane_txn[:lanes].copy()))
+            chunks.append((fut, bs.txn[:lanes].copy()))
         self._pending.append(
             {"chunks": chunks, "buf": buf, "sizes": sizes,
              "tags": tags, "cand": cand, "n": n, "reserved": reserved})
         while len(self._pending) > self.inflight:
             self._drain(block=True, max_sets=1)
-        return consumed
 
     @staticmethod
     def _chunk_ready(fut) -> bool:
@@ -594,11 +719,12 @@ class VerifyTile:
             txn_ok = self._cpu_verify_record(rec)
         self.metrics["verify_fail"] += int((cand & ~txn_ok).sum())
 
-        # release the dispatch-time reservations; tcache insert happens
-        # only for txns whose signatures VERIFIED (ref order, poisoning
-        # resistance). A racing duplicate between query and insert is
-        # dropped here (insert returns "already present").
-        self._inflight_tags.difference_update(rec["reserved"])
+        # the dispatch-time reservations released themselves when this
+        # record popped off _pending (the window IS the pending queue);
+        # tcache insert happens only for txns whose signatures VERIFIED
+        # (ref order, poisoning resistance). A racing duplicate between
+        # query and insert is dropped here (insert returns "already
+        # present").
         dup_post = self.tcache.insert_batch(rec["tags"],
                                             mask=txn_ok.astype(np.uint8))
         late = txn_ok & (dup_post != 0)
@@ -620,14 +746,13 @@ class VerifyTile:
                 break               # halted while backpressured
         self.metrics["tx"] += fwd
         if self._trace is not None and fwd:
-            # frag-lineage anchors: one (sampled) publish record per
-            # forwarded txn, keyed by its dedup tag — the sig the
-            # downstream consume hooks carry, so one microbatch is
-            # followable verify -> dedup -> pack across rings
+            # frag-lineage anchors: (sampled) publish records keyed by
+            # dedup tag — the sig the downstream consume hooks carry,
+            # so one microbatch is followable verify -> dedup -> pack
+            # across rings; one vectorized append for the whole batch
             from ..trace.events import EV_PUBLISH
-            for i in np.nonzero(mask)[0]:
-                self._trace.frag(EV_PUBLISH, sig=int(rec["tags"][i]),
-                                 link=self._trace_link)
+            self._trace.frag_batch(EV_PUBLISH, rec["tags"][mask != 0],
+                                   link=self._trace_link)
 
     def _resolve_deferred(self, released_tags):
         """Decide duplicates parked while their tag was in flight: the
@@ -637,7 +762,11 @@ class VerifyTile:
         censorship-resistance half of the reservation contract). The
         slow path only runs for dups that raced the pipeline window."""
         hb = 0
-        for t in released_tags:
+        # deferred-duplicate recovery: bounded by _deferred_cap, runs
+        # only for dups that raced the in-flight window, never on the
+        # batched ingest/egress path
+        # fdlint: disable=per-frag-loop — bounded raced-dup slow path
+        for t in np.asarray(released_tags, np.uint64).tolist():
             for p in self._deferred.pop(t, ()):
                 self._hb_tick(hb)
                 hb += 1
@@ -687,8 +816,11 @@ class VerifyTile:
         return True
 
     def flush(self):
-        """Retire every in-flight batch (halt path — verdicts already
-        dispatched must still publish)."""
+        """Dispatch a held coalesce window, then retire every in-flight
+        batch (halt path — verdicts already dispatched must still
+        publish, and held ingest must not be dropped)."""
+        if self._hold_n:
+            self._flush_hold()
         self._drain(block=True)
 
     def on_halt(self):

@@ -140,6 +140,30 @@ class TraceWriter:
             self.ring.append(monotonic_ns(), etype, sig=sig, arg=arg,
                              link=link, count=count)
 
+    def frag_batch(self, etype: int, sigs,
+                   link: int = TRACE_LINK_NONE):
+        """Batched frag(): same sampling stream as n sequential frag()
+        calls (every `sample`-th of the running frag count records),
+        but the selected records land via ONE vectorized ring append —
+        no per-frag Python on tile hot paths (the zero-Python-hot-loop
+        contract the new fdlint per-frag-loop rule enforces). Records
+        in one batch share a single timestamp: the batch IS the event."""
+        import numpy as np
+        n = len(sigs)
+        if not n:
+            return
+        s = self.sample
+        if s == 1:
+            keep = np.asarray(sigs, np.uint64)
+        else:
+            # indices i with (nfrag + i + 1) % s == 0
+            i0 = (s - 1 - self._nfrag) % s
+            keep = np.asarray(sigs[i0::s], np.uint64)
+        self._nfrag += n
+        if len(keep):
+            self.ring.append_batch(monotonic_ns(), etype, keep,
+                                   link=link)
+
     def span(self, etype: int, t0_ns: int, sig: int = 0,
              link: int = TRACE_LINK_NONE, count: int = 0):
         now = monotonic_ns()

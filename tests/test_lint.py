@@ -374,6 +374,133 @@ def test_stale_outside_supervision():
         """), "stale-outside-supervision")
 
 
+def test_per_frag_loop_trace_frag():
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                for i in range(n):
+                    self.trace.frag(3, sig=int(sigs[i]))
+        """), "per-frag-loop")
+
+
+def test_per_frag_loop_publish_via_helper_closure():
+    """The rule follows poll_once's same-module call closure: a
+    per-frag publish loop in a helper the hot path calls is just as
+    hot as one written inline."""
+    f = _tiles_findings("""
+        class T:
+            def poll_once(self):
+                self._wait_credits()
+                return self._egress(rows)
+            def _egress(self, rows):
+                self._wait_credits()
+                for r in rows:
+                    self.out_ring.publish(r, sig=1)
+        """)
+    fires_once(f, "per-frag-loop")
+
+
+def test_per_frag_loop_indirect_through_tainted_helper():
+    """A loop calling a helper whose closure reaches a single-item API
+    is the same defect one frame deeper — the loop line is flagged."""
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                for i in range(n):
+                    self._emit(buf[i])
+            def _emit(self, frame):
+                self.out_ring.publish(frame, sig=1)
+        """), "per-frag-loop")
+
+
+def test_per_frag_loop_callback_closure_is_hot():
+    """A nested closure handed into a gather helper as a callback joins
+    the hot closure via the argument edge — its own per-frag loop is
+    flagged even though nothing calls it by name."""
+    fires_once(_tiles_findings("""
+        def _gather_all(ctx, handle):
+            return 0
+        class T:
+            def poll_once(self):
+                def cb(frame):
+                    for s in frame.sigs:
+                        self.trace.frag(3, sig=s)
+                return _gather_all(self.ctx, cb)
+        """), "per-frag-loop")
+
+
+def test_per_frag_loop_untainted_helper_in_loop_is_clean():
+    """Per-frame calls to helpers that do NOT reach single-item APIs
+    (parse / state-machine work) stay legal — that is the
+    frame-granular grain the rule's docstring carves out."""
+    assert rule_count(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                for i in range(n):
+                    self._handle(buf[i])
+            def _handle(self, frame):
+                return parse(frame)
+        """), "per-frag-loop") == 0
+
+
+def test_per_frag_loop_tcache_insert():
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                for s in sigs:
+                    if self.tcache.insert(int(s)):
+                        pass
+        """), "per-frag-loop")
+
+
+def test_per_frag_loop_outside_hot_path_is_clean():
+    """A per-frag loop in a function poll_once never reaches (boot
+    code, test helpers) is not a hot-path defect."""
+    assert rule_count(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                return 0
+            def boot_fill(self, rows):
+                for r in rows:
+                    self.trace.frag(3, sig=1)
+        """), "per-frag-loop") == 0
+
+
+def test_per_frag_loop_batched_calls_are_clean():
+    assert rule_count(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                self.trace.frag_batch(3, sigs)
+                for ln in self.in_links:
+                    n = self.rings[ln].gather(0, 64, 1280)
+                stop, pub = self.out_ring.publish_batch(
+                    buf, sizes, sigs, mask, fseqs=self.fseqs)
+        """), "per-frag-loop") == 0
+
+
+def test_per_frag_loop_suppression_on_loop_line():
+    assert rule_count(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                # fdlint: disable=per-frag-loop — bounded recovery
+                for s in sigs:
+                    self.tcache.query(int(s))
+        """), "per-frag-loop") == 0
+
+
+def test_per_frag_loop_nested_loops_report_once():
+    """A call inside nested fors is ONE defect, anchored at the
+    outermost loop (the suppression point)."""
+    f = _tiles_findings("""
+        class T:
+            def poll_once(self):
+                for t in tags:
+                    for p in pool[t]:
+                        self.out_ring.publish(p, sig=t)
+        """)
+    fires_once(f, "per-frag-loop")
+
+
 def test_silent_consumer():
     fires_once(_tiles_findings("""
         @register("demo")

@@ -1,14 +1,17 @@
 """Interval-arithmetic closure proof for the Pallas kernel's loose bound.
 
 `ops/pallas_ed.py` keeps every in-kernel field element "loose": per-limb
-non-negative with upper bound B = 10650. After the r4 carry tightening,
+non-negative with upper bound B = 10624. After the r4 carry tightening,
 `_reduce39` runs only TWO relaxed carry passes after a schoolbook
 multiply, and the int32 coefficient accumulation is allowed to pass
 int32 max (wrap-tolerant masking recovers the low 13 bits and the
-19-bit logical hi, valid while the true value stays < 2^32). Random
-differential tests cannot exercise these bounds — worst-case limb
-patterns are unreachable from random inputs — so the safety argument is
-numeric, and this test walks it mechanically:
+19-bit logical hi, valid while the true value stays < 2^32 — i.e.
+while B ≤ ⌊√(2^32/20)⌋ = 14654). The r10 tightening drops fsub/fneg
+from two relaxed passes to ONE: their 1-pass worst case (limb0 =
+8191 + FOLD·((B + max SUB_C)>>13) = 10623) now DEFINES the loose
+bound. Random differential tests cannot exercise these bounds —
+worst-case limb patterns are unreachable from random inputs — so the
+safety argument is numeric, and this test walks it mechanically:
 
   1. every arithmetic primitive maps inputs bounded by B back to
      outputs bounded by B (closure: any kernel composition is safe);
@@ -28,7 +31,15 @@ BITS = fe.BITS
 MASK = fe.MASK
 FOLD = fe.FOLD
 
-B = 10650                       # the kernel-wide loose bound
+B = 10624                       # the kernel-wide loose bound (r10)
+
+
+def test_loose_bound_within_uint32_multiply_window():
+    """The wrap-tolerance premise in one line: 20·B² < 2^32, with the
+    maximal admissible bound pinned so a future tightening knows its
+    headroom."""
+    assert 20 * B * B < 2 ** 32
+    assert B <= 14654 == int((2 ** 32 / 20) ** 0.5)
 
 
 def carry_pass(ub):
@@ -87,11 +98,11 @@ def test_sub_const_dominates_loose_bound():
 
 def test_fmul_closure():
     """loose x loose -> loose: the core invariant behind the 2-pass
-    reduction. Also pins the interior bound quoted in the _reduce39
-    docstring (limb0 <= 10015)."""
+    reduction. Also pins the interior bounds quoted in the _reduce39
+    docstring (limb0 <= 8799, limb1 <= 8270)."""
     out = fmul_ub([B] * NL, [B] * NL)
     assert max(out) <= B, out
-    assert out[0] <= 10015 and out[1] <= 9764, out
+    assert out[0] <= 8799 and out[1] <= 8270, out
 
 
 def test_fadd_closure():
@@ -100,11 +111,14 @@ def test_fadd_closure():
 
 
 def test_fsub_closure():
+    """ONE pass (r10) closes fsub/fneg; the fsub worst case IS the
+    loose bound's defining corner (limb0 = 10623 = B − 1)."""
     sub_c = [int(v) for v in np.asarray(fe.SUB_C, np.int64)]
-    out = carry([B + c for c in sub_c], 2)
+    out = carry([B + c for c in sub_c], 1)
     assert max(out) <= B, out
+    assert max(out) == B - 1        # the bound is tight, not slack
     # fneg is the b=0 case of the same expression
-    out = carry(sub_c, 2)
+    out = carry(sub_c, 1)
     assert max(out) <= B, out
 
 
@@ -124,10 +138,10 @@ def test_fmul_const_closure():
 def test_decompress_handoff_within_bound():
     """The fused kernel hands `ax = where(flip, fneg(x), x)` straight
     into fmul with no intervening carry: both branches must already be
-    loose. fneg(x) is carry(SUB_C - x, 2) <= the fsub bound; the
+    loose. fneg(x) is carry(SUB_C - x, 1) <= the fsub bound; the
     un-flipped x is a _reduce39 output."""
     sub_c = [int(v) for v in np.asarray(fe.SUB_C, np.int64)]
-    neg_branch = carry(sub_c, 2)
+    neg_branch = carry(sub_c, 1)
     mul_branch = fmul_ub([B] * NL, [B] * NL)
     handoff = [max(a, b) for a, b in zip(neg_branch, mul_branch)]
     assert max(handoff) <= B, handoff

@@ -64,6 +64,47 @@ def test_writer_samples_frag_events_records_all_lifecycle(wksp):
     assert sigs == [3, 7, 11, 15]
 
 
+def test_append_batch_one_cursor_bump_and_wrap_accounting(wksp):
+    """Vectorized append: the whole batch lands under one cursor bump;
+    oversized batches keep only the newest `depth` records but the
+    cursor still counts every one (history-loss accounting)."""
+    r = TraceRing.create(wksp, 8)
+    r.append_batch(500, tev.EV_PUBLISH, list(range(5)), link=1)
+    assert r.cursor == 5
+    cur, recs = r.snapshot()
+    assert [tev.decode(x)["sig"] for x in recs] == [0, 1, 2, 3, 4]
+    # batch larger than depth: newest 8 survive, cursor counts all 20
+    r.append_batch(501, tev.EV_PUBLISH, list(range(100, 120)))
+    assert r.cursor == 25
+    _, recs = r.snapshot()
+    assert [tev.decode(x)["sig"] for x in recs] == list(range(112, 120))
+    r.append_batch(502, tev.EV_PUBLISH, [])          # empty: no-op
+    assert r.cursor == 25
+
+
+def test_frag_batch_matches_sequential_sampling_stream(wksp):
+    """frag_batch is n sequential frag() calls: same records selected
+    from the running frag count regardless of batch boundaries."""
+    ra = TraceRing.create(wksp, 64)
+    rb = TraceRing.create(wksp, 64)
+    ta = TraceWriter(ra, sample=3, links={"x": 0})
+    tb = TraceWriter(rb, sample=3, links={"x": 0})
+    sigs = list(range(21))
+    for s in sigs:
+        ta.frag(tev.EV_CONSUME, sig=s, link=0)
+    for lo, hi in ((0, 7), (7, 12), (12, 12), (12, 21)):
+        tb.frag_batch(tev.EV_CONSUME, sigs[lo:hi], link=0)
+    assert ra.cursor == rb.cursor == 7            # every 3rd of 21
+    got_a = [tev.decode(x)["sig"] for x in ra.snapshot()[1]]
+    got_b = [tev.decode(x)["sig"] for x in rb.snapshot()[1]]
+    assert got_a == got_b == [2, 5, 8, 11, 14, 17, 20]
+    # sample=1 fast path records everything
+    r1 = TraceRing.create(wksp, 64)
+    t1 = TraceWriter(r1, sample=1, links={"x": 0})
+    t1.frag_batch(tev.EV_CONSUME, sigs[:5], link=0)
+    assert r1.cursor == 5
+
+
 def test_span_records_end_ts_and_duration(wksp):
     from firedancer_tpu.utils.tempo import monotonic_ns
     r = TraceRing.create(wksp, 8)

@@ -185,3 +185,30 @@ def test_epoch_credits_seed_matches_agave():
     assert st.epoch_credits[-1] == (7, 2, 0)
     st._increment_credits(epoch=8)
     assert st.epoch_credits[-1] == (8, 3, 2)
+
+
+def test_epoch_credits_empty_epoch_moves_in_place():
+    """Agave increment_credits: when the open entry earned nothing
+    (credits == prev_credits — e.g. a deserialized account whose last
+    epochs were quiet), an epoch change MOVES the entry instead of
+    appending, so empty epochs never consume 64-entry window slots
+    (ADVICE r5 last open item)."""
+    from firedancer_tpu.svm.vote import VoteState
+    st = VoteState(node_pubkey=b"\x01" * 32, authorized_voter=b"\x02" * 32,
+                   authorized_withdrawer=b"\x02" * 32)
+    # deserialized shape: history ends in an entry that earned nothing
+    st.epoch_credits = [(3, 10, 4), (5, 10, 10)]
+    st.credits = 10
+    st._increment_credits(epoch=9)
+    # the empty epoch-5 entry was moved to epoch 9, NOT appended after
+    assert st.epoch_credits == [(3, 10, 4), (9, 11, 10)]
+    # and an entry that DID earn still appends on epoch change
+    st._increment_credits(epoch=10)
+    assert st.epoch_credits == [(3, 10, 4), (9, 11, 10), (10, 12, 11)]
+    # window cap still enforced on the append path
+    st.epoch_credits = [(e, e + 1, e) for e in range(64)]
+    st.credits = 64
+    st._increment_credits(epoch=99)
+    assert len(st.epoch_credits) == 64
+    assert st.epoch_credits[-1] == (99, 65, 64)
+    assert st.epoch_credits[0] == (1, 2, 1)
