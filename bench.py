@@ -176,18 +176,28 @@ def _child_bench():
 
 
 def _e2e_run(count: int, unique: int, batch: int,
-             rate_tps: float = 0.0, coalesce_us: float = 0.0):
+             rate_tps: float = 0.0, coalesce_us: float = 0.0,
+             profile: bool = True):
     """One synth -> verify -> dedup -> sink topology run; returns the
-    measured record (tps, stage budget, link budget). rate_tps > 0
-    paces the synth (the offered axis of the sweep); 0 lets it rip
+    measured record (tps, stage budget, link budget, and — with
+    profile=True — the fdprof per-stage attribution digest). rate_tps
+    > 0 paces the synth (the offered axis of the sweep); 0 lets it rip
     (capacity measurement)."""
     from firedancer_tpu.disco import Topology, TopologyRunner
     from firedancer_tpu.disco.metrics import (link_lag, merge_hists,
                                               quantile_ns, read_hists,
                                               read_link_metrics)
 
+    # bench observatory (fdprof): low prime sampling rate so the
+    # profile rides every bench round at negligible overhead (the
+    # tier-1 overhead test bounds the sampler; 29 Hz against ~20 us
+    # polls is noise) — override/disable with FDTPU_BENCH_PROF_HZ
+    prof_hz = float(os.environ.get("FDTPU_BENCH_PROF_HZ", "29"))
+    prof_cfg = {"enable": True, "hz": prof_hz} \
+        if profile and prof_hz > 0 else None
     topo = (
-        Topology(f"bench{os.getpid()}", wksp_size=1 << 26)
+        Topology(f"bench{os.getpid()}", wksp_size=1 << 26,
+                 prof=prof_cfg)
         .link("ingest", depth=8192, mtu=1280)
         .link("verify_dedup", depth=8192, mtu=1280)
         .link("dedup_sink", depth=8192, mtu=1280)
@@ -253,7 +263,7 @@ def _e2e_run(count: int, unique: int, batch: int,
                 "consume_p99_us": round(quantile_ns(h, 0.99) / 1e3, 1)
                 if h else 0,
             }
-        return {
+        out = {
             "e2e_tps": round(count / wall, 1),
             "e2e_count": count,
             "e2e_wall_s": round(wall, 2),
@@ -261,6 +271,26 @@ def _e2e_run(count: int, unique: int, batch: int,
             "e2e_stage_budget": budget,
             "e2e_link_budget": link_budget,
         }
+        if prof_cfg:
+            # per-stage profile digest (fdprof): top-k frames with
+            # stem-state attribution, device occupancy (tpu time /
+            # wall), compile counts — the WHY next to every number,
+            # diffable across rounds by tools/fdbench
+            from firedancer_tpu.prof import profile_summary
+            prof = profile_summary(runner.plan, runner.wksp)
+            vh = read_hists(runner.wksp, runner.plan, "verify")
+            tpu = vh.get("tpu", {"sum_ns": 0})
+            vm = runner.metrics("verify")
+            prof["verify_device"] = {
+                "occupancy": round(tpu["sum_ns"] / 1e9 / wall, 3)
+                if wall else 0.0,
+                "compiles": vm.get("tpu_jit_compiles", 0),
+                "cache_miss": vm.get("tpu_jit_cache_miss", 0),
+                "compile_s": round(
+                    vm.get("tpu_compile_ns", 0) / 1e9, 2),
+            }
+            out["e2e_profile"] = prof
+        return out
     finally:
         runner.halt()
         runner.close()
@@ -332,8 +362,10 @@ def _e2e_bench():
             # actually engages; compile is warm from the first run
             n_pt = int(max(8192, min(count, offered * 2)))
             try:
+                # sweep points keep only achieved/hop attribution —
+                # skip the per-point profiling the capacity run did
                 rec = _e2e_run(n_pt, unique, batch, rate_tps=offered,
-                               coalesce_us=coalesce_us)
+                               coalesce_us=coalesce_us, profile=False)
             except Exception as e:  # noqa: BLE001 — annotate the point
                 sweep.append({"offered_tps": round(offered, 1),
                               "error": f"{e!r}"[:200]})
@@ -445,9 +477,33 @@ def main():
                     result[k] = v
         except Exception as e3:  # noqa: BLE001
             result["e2e_error"] = f"{e3!r}"[:300]
+    # bench-trend gate (fdbench): compare this round against the
+    # previous BENCH json — kernel vps / e2e tps / knee regressions
+    # beyond the threshold fail the run, and the printed diff says
+    # which hop/frames moved (tools/fdbench for the standalone CLI)
+    trend_rc = 0
+    prev = os.environ.get("FDTPU_BENCH_PREV")
+    if prev:
+        try:
+            from firedancer_tpu.prof.bench_diff import (
+                diff_bench, gate_regressions, load_bench, render_text)
+            old = load_bench(prev)
+            thr = float(os.environ.get("FDTPU_BENCH_GATE_PCT", "0.05"))
+            d = diff_bench(old, result)
+            regs = gate_regressions(d, threshold=thr)
+            print(render_text(d, regs, thr), file=sys.stderr)
+            result["bench_gate"] = {
+                "prev": prev, "threshold": thr,
+                "regressions": regs,
+            }
+            trend_rc = 1 if regs else 0
+        except Exception as e:  # noqa: BLE001 — annotate, don't break
+            result["bench_gate"] = {"prev": prev,
+                                    "error": f"{e!r}"[:200]}
     print(json.dumps(result))
     sys.stdout.flush()
-    sys.exit(_gate_rc(result, os.environ.get("FDTPU_BENCH_GATE_E2E")))
+    sys.exit(_gate_rc(result, os.environ.get("FDTPU_BENCH_GATE_E2E"))
+             or trend_rc)
 
 
 def _gate_rc(result: dict, floor: str | None) -> int:

@@ -52,6 +52,18 @@ Schema:
     [tile.trace]             # per-tile override (opt out/in, depth,
     sample = 16              #  sample) — highest precedence
 
+    [prof]                   # fdprof continuous profiler (prof/recorder.py)
+    enable = true            # default false: unprofiled tiles pay one
+    hz = 97                  #  attribute check, no sampler thread
+    slots = 256              # folded-stack table entries (power of two)
+    ring = 2048              # timestamped sample ring (power of two)
+    tiles = ["verify"]       # optional allowlist (default: all tiles)
+    capture_ms = 200.0       # device-trace window length
+    breach_capture = ["verify"]  # SLO breach -> device capture here
+
+    [tile.prof]              # per-tile override (opt out/in, hz,
+    hz = 29                  #  slots, ring, stack_depth)
+
     [slo]                    # service-level objectives (disco/slo.py),
     fast_window_s = 5.0      #  evaluated by the metric tile; breaches
     slow_window_s = 60.0     #  flip its slo_breach gauge, leave an
@@ -92,7 +104,8 @@ except ModuleNotFoundError:          # py<3.11
                 "no TOML parser available on this Python (<3.11): "
                 "install 'tomli'") from e
 
-_TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo"}
+_TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo",
+                 "prof"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -141,7 +154,7 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
             if key in layer:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
-        for key in ("topology", "trace", "slo"):
+        for key in ("topology", "trace", "slo", "prof"):
             if key in layer:
                 merged = _deep_merge(cfg.get(key, {}), layer[key])
                 if key == "slo" and "target" in layer[key]:
@@ -195,9 +208,15 @@ def build_topology(cfg: dict, name: str | None = None):
     slo_cfg = cfg.get("slo")
     if slo_cfg is not None:
         normalize_slo(slo_cfg)
+    # [prof] continuous profiler — same gate (tiles/breach_capture
+    # references resolve at topo.build)
+    from ..prof import normalize_prof
+    prof_cfg = cfg.get("prof")
+    if prof_cfg is not None:
+        normalize_prof(prof_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
                     wksp_size=int(top.get("wksp_size", 1 << 26)),
-                    trace=trace_cfg, slo=slo_cfg)
+                    trace=trace_cfg, slo=slo_cfg, prof=prof_cfg)
     for ln in cfg.get("link", []):
         topo.link(ln["name"], depth=int(ln.get("depth", 128)),
                   mtu=int(ln.get("mtu", 1280)))

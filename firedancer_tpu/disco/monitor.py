@@ -109,7 +109,60 @@ def full_snapshot(plan: dict, wksp: Workspace) -> dict:
         "topology": plan.get("topology", "?"),
         "tiles": snapshot(plan, wksp),
         "links": links_table(read_link_metrics(wksp, plan)),
+        "slo_events": slo_breach_events(plan, wksp),
     }
+
+
+def slo_breach_events(plan: dict, wksp: Workspace,
+                      limit: int = 8) -> list[dict]:
+    """Recent SLO breaches recovered from shm alone: EV_SLO records in
+    the metric tile's flight-recorder ring, plus the engine's durable
+    per-target breach dumps (/dev/shm/..slo.<target>.json) for
+    breaches the WRAPPING ring has already overwritten — so the
+    monitor shows a flapping objective without talking to the metric
+    tile's HTTP surface, and post-mortem."""
+    targets = [t["name"] for t in (plan.get("slo") or {})
+               .get("target", [])]
+    out: list[dict] = []
+    from ..trace import events as trace_ev
+    from ..trace.export import read_rings
+    metric_tiles = [tn for tn, spec in plan["tiles"].items()
+                    if spec["kind"] == "metric"
+                    and spec.get("trace_off") is not None]
+    for tn, evs in read_rings(plan, wksp, tiles=metric_tiles).items():
+        for e in evs:
+            if e["etype"] != trace_ev.EV_SLO:
+                continue
+            idx = e["count"]
+            out.append({"ts": e["ts"],
+                        "target": targets[idx]
+                        if idx < len(targets) else f"?{idx}",
+                        "value": e["arg"]})
+    seen = {r["target"] for r in out}
+    from .slo import slo_dump_path
+    for name in targets:
+        if name in seen:
+            continue
+        try:
+            with open(slo_dump_path(plan.get("topology", "?"),
+                                    name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        out.append({"ts": doc.get("dumped_at_ns", 0), "target": name,
+                    "value": doc.get("value"),
+                    "breaches": doc.get("breaches")})
+    out.sort(key=lambda r: r["ts"])
+    return out[-limit:]
+
+
+def format_slo_events(events: list[dict]) -> str:
+    if not events:
+        return ""
+    lines = ["recent SLO breaches (newest last):"]
+    for e in events:
+        lines.append(f"  ts={e['ts']} {e['target']} value={e['value']}")
+    return "\n".join(lines)
 
 
 def _delta_str(v: int, prev: int | None) -> str:
@@ -190,6 +243,9 @@ def main(argv=None):
                 lt = format_links(links)
                 if lt:
                     frame += "\n\n" + lt
+                st = format_slo_events(slo_breach_events(plan, wksp))
+                if st:
+                    frame += "\n\n" + st
                 if watch is not None:
                     # diff-print: clear + redraw with counter deltas
                     # (the reference monitor's terminal discipline)

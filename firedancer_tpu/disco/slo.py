@@ -318,6 +318,11 @@ class SloEngine:
         cfg = plan.get("slo") or dict(SLO_DEFAULTS, target=[])
         self.targets = [_TargetState(t) for t in cfg["target"]]
         self.evals = 0
+        # breach history ring: the last N breach/clear transitions,
+        # exposed via /summary.json so a FLAPPING objective is visible
+        # without grepping dump files (each EV_SLO in the trace ring
+        # has a matching row here with the measured value and fracs)
+        self.history: deque = deque(maxlen=64)
 
     # -- source readers -----------------------------------------------------
 
@@ -442,6 +447,10 @@ class SloEngine:
         ev = {"target": st.spec["name"], "expr": st.spec["expr"],
               "kind": kind, "value": st.value,
               "fast_frac": st.fast_frac, "slow_frac": st.slow_frac}
+        self.history.append({"t": self.clock(), "kind": kind,
+                             "target": st.spec["name"],
+                             "value": st.value,
+                             "breaches": st.breaches})
         if kind == "breach":
             if self.trace is not None:
                 from ..trace.events import EV_SLO
@@ -459,10 +468,12 @@ class SloEngine:
         """Breach snapshot next to the supervisor black boxes — the
         post-mortem artifact: which objective, what value, how the
         windows looked. Must never block evaluation."""
+        from ..utils.tempo import monotonic_ns
         path = slo_dump_path(self.plan.get("topology", "?"),
                              st.spec["name"])
         doc = {
             "topology": self.plan.get("topology", "?"),
+            "dumped_at_ns": monotonic_ns(),
             "target": st.spec["name"],
             "expr": st.spec["expr"],
             "value": st.value,

@@ -100,6 +100,18 @@ class Stem:
         # disabled path is this one cached attribute staying None
         # (trace/__init__.py contract; no per-frag cost when off)
         self._trace = getattr(ctx, "trace", None)
+        # fdprof continuous profiler: same None-is-disabled contract.
+        # The sampler thread starts in run() (it samples THE stem
+        # thread); _prof_state is the attribution channel the loop
+        # stores wait/work/housekeep + the active in-link into — one
+        # attribute store per poll when profiling, one None check when
+        # not. Attribution lags the sample by one poll (the state a
+        # sample sees was set after the PREVIOUS poll) — statistically
+        # exact in steady regimes, which is all a sampling profiler
+        # claims.
+        self._prof_region = getattr(ctx, "prof", None)
+        self._prof_state = None
+        self._sampler = None
         self._wait_t0: int | None = None      # idle-streak start (ns)
         # WORK attribution accumulators: with sample>1 one EV_WORK
         # record aggregates the last `sample` productive polls
@@ -131,6 +143,16 @@ class Stem:
                 if self._stalled_links is None:
                     self._stalled_links = set()
                 self._stalled_links.add(ev["link"])   # None = all links
+
+    def _stop_sampler(self):
+        """Stop the fdprof sampler on ANY loop exit (halt, fail,
+        external FAIL): the shm region keeps the aggregate — a stopped
+        sampler loses nothing, but a sampler outliving run() would
+        keep attributing samples to a loop that no longer exists."""
+        if self._sampler is not None:
+            self._sampler.stop()
+            self._sampler = None
+            self._prof_state = None
 
     def _trace_flush(self, tr):
         """Close out pending trace state on any loop exit (halt, fail,
@@ -206,6 +228,21 @@ class Stem:
         cnc = self.ctx.cnc
         cnc.heartbeat()
         cnc.state = CNC_RUN
+        if self._prof_region is not None and self._sampler is None:
+            # host sampling profiler over THIS thread (fdprof): the
+            # daemon sampler walks our stack at prof_hz and aggregates
+            # folded stacks into the shm region
+            import threading
+            from ..prof import ProfState, Sampler
+            spec = self.ctx.spec
+            self._prof_state = ProfState()
+            self._sampler = Sampler(
+                self._prof_region,
+                float(spec.get("prof_hz", 97.0)),
+                threading.get_ident(), self._prof_state,
+                stack_depth=int(spec.get("prof_stack_depth", 16)),
+            ).start()
+        ps = self._prof_state
         if tr is not None:
             tr.event(trace_ev.EV_BOOT)
         # jittered lazy interval: same reasoning as the reference's
@@ -230,9 +267,12 @@ class Stem:
                             self._trace_flush(tr)
                             tr.event(trace_ev.EV_FAIL)
                         self._flush_metrics()
+                        self._stop_sampler()
                         return
                     hk_t0 = time.perf_counter_ns() if tr is not None \
                         else 0
+                    if ps is not None:
+                        ps.state = 2          # fdprof: housekeep
                     self._update_in_fseqs()
                     hk = getattr(self.tile, "housekeeping", None)
                     if hk is not None:
@@ -256,6 +296,8 @@ class Stem:
                 # spent waiting on upstream, a productive one is work
                 # (the reference's per-link regime split)
                 self._hists["work" if n else "wait"].add(t1 - t0)
+                if ps is not None:
+                    ps.state = 1 if n else 0  # fdprof: work / wait
                 if n and self._link_hists:
                     # per-link consume latency: attribute this poll's
                     # duration to every in link whose Ring consume
@@ -265,6 +307,8 @@ class Stem:
                         if c != self._link_seen[ln]:
                             self._link_seen[ln] = c
                             h.add(t1 - t0)
+                            if ps is not None:
+                                ps.link = ln  # fdprof: active in-link
                 if tr is not None:
                     # trace shape: one WAIT span per idle STREAK
                     # (credit-wait begin at the first empty poll, end
@@ -308,6 +352,7 @@ class Stem:
                 self._trace_flush(tr)
                 tr.event(trace_ev.EV_FAIL)
             self._flush_metrics()
+            self._stop_sampler()
             from ..utils import log
             log.err(f"tile failed: {e!r}")
             raise
@@ -320,4 +365,5 @@ class Stem:
         if tr is not None:
             self._trace_flush(tr)
             tr.event(trace_ev.EV_HALT)
+        self._stop_sampler()
         cnc.state = CNC_HALT

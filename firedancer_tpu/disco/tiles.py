@@ -178,13 +178,17 @@ class VerifyAdapter:
                # device telemetry (fdmetrics v2): promoted by the
                # prometheus renderer to fdtpu_tile_tpu_* series
                "tpu_jit_compiles", "tpu_jit_cache_miss",
-               "tpu_inflight", "tpu_mem_bytes"]
+               "tpu_inflight", "tpu_mem_bytes",
+               # fdprof: warmup compile wall time + device-capture
+               # windows served (the observability of the profiler)
+               "tpu_compile_ns", "prof_captures"]
     GAUGES = ["cpu_fallback", "tpu_jit_compiles", "tpu_jit_cache_miss",
-              "tpu_inflight", "tpu_mem_bytes"]
+              "tpu_inflight", "tpu_mem_bytes", "tpu_compile_ns"]
     # declared (not name-sniffed) device-telemetry slots: the renderer
     # promotes these to first-class fdtpu_tile_<name> families
     DEVICE_SERIES = ["tpu_jit_compiles", "tpu_jit_cache_miss",
-                     "tpu_inflight", "tpu_mem_bytes"]
+                     "tpu_inflight", "tpu_mem_bytes",
+                     "tpu_compile_ns"]
 
     def __init__(self, ctx, args):
         _setup_jax()
@@ -225,11 +229,30 @@ class VerifyAdapter:
         # device-time attribution: the stem flushes this accumulator
         # into the tile's third (tpu) histogram slot
         self.tpu_hist = self.tile.tpu_hist
+        # fdprof device side: compile-event watch (EV_COMPILE + a
+        # manifest when profiled) and the capture doorbell handler —
+        # both polled at housekeeping cadence, never in the hot loop
+        from ..prof.device import CompileWatch, DeviceCapture
+        prof = getattr(ctx, "prof", None)
+        self._compile_watch = CompileWatch(
+            ctx.plan, ctx.tile_name, self._jit_compiles,
+            trace=ctx.trace, mem_fn=self._device_mem,
+            manifest=prof is not None)
+        self._capture = DeviceCapture(
+            ctx.plan, ctx.tile_name, prof,
+            trace=ctx.trace) if prof is not None else None
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
 
+    def housekeeping(self):
+        self._compile_watch.poll()
+        if self._capture is not None:
+            self._capture.poll()
+
     def on_halt(self):
+        if self._capture is not None:
+            self._capture.flush()   # never leave the doorbell hanging
         self.tile.flush()      # publish verdicts already in flight
 
     def in_seqs(self):
@@ -261,6 +284,9 @@ class VerifyAdapter:
         m["tpu_jit_cache_miss"] = max(0, compiles - 1)
         m["tpu_inflight"] = len(self.tile._pending)
         m["tpu_mem_bytes"] = self._device_mem()
+        m["tpu_compile_ns"] = self.tile.compile_ns
+        m["prof_captures"] = self._capture.captures \
+            if self._capture is not None else 0
         return m
 
 
@@ -1773,11 +1799,14 @@ class MetricAdapter:
 
         def summary_route():
             # the ONE summary-document shape (monitor --json emits the
-            # same), plus the SLO state only this tile can evaluate
+            # same), plus the SLO state only this tile can evaluate —
+            # including the breach-history ring, so a flapping
+            # objective reads straight off /summary.json
             from .monitor import full_snapshot
             body = json.dumps({
                 **full_snapshot(ctx.plan, ctx.wksp),
                 "slo": self.engine.status(),
+                "slo_history": list(self.engine.history),
             }).encode()
             return 200, "application/json", body
 
@@ -1824,6 +1853,16 @@ class MetricAdapter:
             from ..utils import log
             log.warning(f"slo {ev['kind']}: {ev['target']} "
                         f"({ev['expr']}) value={ev['value']}")
+            if ev["kind"] != "breach":
+                continue
+            # SLO-breach-triggered device capture (fdprof): ring the
+            # doorbell on each [prof] breach_capture tile — its own
+            # housekeeping runs the bounded jax.profiler window and
+            # acks, so the breach ships WITH its device attribution
+            for tn in (self.ctx.plan.get("prof") or {}).get(
+                    "breach_capture") or ():
+                from ..prof.device import request_capture
+                request_capture(self.ctx.plan, self.ctx.wksp, tn)
 
     def poll_once(self) -> int:
         return 0

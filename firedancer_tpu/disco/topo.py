@@ -83,7 +83,8 @@ class Topology:
     """Builder. Declare links/tiles/objects, then build() into a wksp."""
 
     def __init__(self, name: str, wksp_size: int = 1 << 26,
-                 trace: dict | None = None, slo: dict | None = None):
+                 trace: dict | None = None, slo: dict | None = None,
+                 prof: dict | None = None):
         self.name = name
         self.wksp_size = wksp_size
         self.links: dict[str, LinkSpec] = {}
@@ -96,6 +97,8 @@ class Topology:
         # against the declared tiles/links/metrics at build, so a typo
         # or a dangling reference fails before launch too
         self.slo = slo
+        # [prof] continuous-profiler config (prof/recorder.py schema)
+        self.prof = prof
 
     def link(self, name: str, depth: int = 128, mtu: int = 1280,
              external: bool = False):
@@ -189,6 +192,19 @@ class Topology:
                     f"trace.tiles names unknown tile(s) "
                     f"{sorted(unknown)}")
             plan["trace"] = trace_cfg
+            # [prof] continuous profiler: same carve-at-build shape as
+            # the flight recorder — unprofiled tiles get NO region and
+            # NO plan keys, so TileCtx.prof stays None
+            from ..prof import ProfRegion, effective_prof, \
+                normalize_prof
+            prof_cfg = normalize_prof(self.prof)
+            for key in ("tiles", "breach_capture"):
+                unknown = set(prof_cfg[key] or ()) - set(self.tiles)
+                if unknown:
+                    raise ValueError(
+                        f"prof.{key} names unknown tile(s) "
+                        f"{sorted(unknown)}")
+            plan["prof"] = prof_cfg
             for tn, t in self.tiles.items():
                 for i in t.ins:
                     if i["reliable"]:
@@ -260,6 +276,21 @@ class Topology:
                     plan["tiles"][tn]["trace_off"] = tr.off
                     plan["tiles"][tn]["trace_depth"] = eff["depth"]
                     plan["tiles"][tn]["trace_sample"] = eff["sample"]
+                # profile region (fdprof): folded-stack table +
+                # timestamped sample ring + capture doorbell, carved
+                # only for profiled tiles (prof/recorder.py)
+                peff = effective_prof(
+                    prof_cfg, tn,
+                    normalize_prof(t.args.get("prof"), per_tile=True))
+                if peff is not None:
+                    pr = ProfRegion.create(w, peff["slots"],
+                                           peff["ring"])
+                    plan["tiles"][tn]["prof_off"] = pr.off
+                    plan["tiles"][tn]["prof_slots"] = peff["slots"]
+                    plan["tiles"][tn]["prof_ring"] = peff["ring"]
+                    plan["tiles"][tn]["prof_hz"] = peff["hz"]
+                    plan["tiles"][tn]["prof_stack_depth"] = \
+                        peff["stack_depth"]
                 if t.kind == "sign":
                     # live identity hot-swap region (fd_keyswitch)
                     from ..keyguard.keyswitch import FOOTPRINT as KS_FP
@@ -347,6 +378,11 @@ class TileCtx:
         # (every hook is a single attribute check, trace/__init__.py)
         from ..trace import writer_for
         self.trace = writer_for(plan, self.wksp, tile_name)
+
+        # continuous profiler (fdprof): same None-is-disabled contract
+        # — the stem starts a sampler thread only when a region exists
+        from ..prof import region_for as _prof_region_for
+        self.prof = _prof_region_for(plan, self.wksp, tile_name)
 
         # per-link telemetry views (fdmetrics v2): consumer blocks for
         # this tile's in links, producer blocks for its out links —

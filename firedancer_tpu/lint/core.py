@@ -88,6 +88,12 @@ RULES: dict[str, tuple[str, str, str]] = {
         "[slo] section rejected by the disco/slo.py schema (unknown "
         "key, bad expression grammar, out-of-range window/burn) or a "
         "target references an undeclared tile/metric/link"),
+    "bad-prof": (
+        "graph", "error",
+        "[prof] section or [tile.prof] table rejected by the fdprof "
+        "schema (unknown key, non-power-of-two slots/ring, hz out of "
+        "range) or prof.tiles / prof.breach_capture names an "
+        "undeclared tile"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

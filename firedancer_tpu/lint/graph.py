@@ -75,7 +75,8 @@ def model_from_config(cfg: dict) -> dict:
                             "outs": list(t.get("outs", ())),
                             "args": args}
     return {"links": links, "tcaches": tcaches, "tiles": tiles,
-            "trace": cfg.get("trace"), "slo": cfg.get("slo")}
+            "trace": cfg.get("trace"), "slo": cfg.get("slo"),
+            "prof": cfg.get("prof")}
 
 
 def model_from_topology(topo) -> dict:
@@ -89,7 +90,8 @@ def model_from_topology(topo) -> dict:
              for tn, t in topo.tiles.items()}
     return {"links": links, "tcaches": set(topo.tcaches),
             "tiles": tiles, "trace": getattr(topo, "trace", None),
-            "slo": getattr(topo, "slo", None)}
+            "slo": getattr(topo, "slo", None),
+            "prof": getattr(topo, "prof", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -232,6 +234,7 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_tiles(model, kinds, lines))
     out.extend(_check_trace(model, path, lines))
     out.extend(_check_slo(model, kinds, path, lines))
+    out.extend(_check_prof(model, path, lines))
     return out
 
 
@@ -260,6 +263,35 @@ def _check_trace(model, path, lines) -> list[Finding]:
                 normalize_trace(t["args"]["trace"], per_tile=True)
             except Exception as e:
                 _emit(out, lines, "bad-trace", tn, f"tile {tn!r}: {e}")
+    return out
+
+
+def _check_prof(model, path, lines) -> list[Finding]:
+    """[prof] section + [tile.prof] overrides: the fdprof schema gate
+    (prof/recorder.py is the one validator) plus tile-name resolution
+    for the `tiles` allowlist and the breach_capture list."""
+    from ..prof import normalize_prof
+    out: list[Finding] = []
+    spec = model.get("prof")
+    if spec is not None:
+        try:
+            norm = normalize_prof(spec)
+        except Exception as e:
+            out.append(finding("bad-prof", path, 0, f"[prof]: {e}"))
+        else:
+            for key in ("tiles", "breach_capture"):
+                for tn in norm[key] or ():
+                    if tn not in model["tiles"]:
+                        _emit(out, lines, "bad-prof", tn,
+                              f"[prof] {key} entry {tn!r} is not a "
+                              f"declared tile"
+                              + reg.suggest(str(tn), model["tiles"]))
+    for tn, t in model["tiles"].items():
+        if "prof" in t["args"]:
+            try:
+                normalize_prof(t["args"]["prof"], per_tile=True)
+            except Exception as e:
+                _emit(out, lines, "bad-prof", tn, f"tile {tn!r}: {e}")
     return out
 
 

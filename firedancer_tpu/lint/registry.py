@@ -28,6 +28,7 @@ COMMON_KEYS: dict[str, str | None] = {
     "supervise": None,      # disco/supervise.py policy table
     "chaos": None,          # utils/chaos.py fault plan
     "trace": None,          # trace/recorder.py per-tile override table
+    "prof": None,           # prof/recorder.py per-tile override table
     "cpu_idx": None,        # launch: sched_setaffinity pin
     "sandbox": None,        # launch: utils/sandbox hardening
     "sandbox_files": None,
@@ -41,6 +42,14 @@ COMMON_KEYS: dict[str, str | None] = {
 # the graph analyzer's bad-trace check.
 TRACE_SECTION_KEYS = ("enable", "depth", "sample", "tiles")
 TILE_TRACE_KEYS = ("enable", "depth", "sample")
+
+# [prof] topology-section keys (mirror of prof/recorder.py
+# PROF_DEFAULTS / TILE_PROF_KEYS — tests/test_prof.py keeps the mirror
+# honest). `tiles`/`breach_capture` entries are tile-name references,
+# resolved by the graph analyzer's bad-prof check.
+PROF_SECTION_KEYS = ("enable", "hz", "slots", "ring", "stack_depth",
+                     "tiles", "capture_ms", "breach_capture")
+TILE_PROF_KEYS = ("enable", "hz", "slots", "ring", "stack_depth")
 
 # [slo] topology-section keys (mirror of disco/slo.py SLO_DEFAULTS /
 # TARGET_KEYS — tests/test_metrics.py keeps the mirror honest).

@@ -1,0 +1,30 @@
+"""fdprof: the whole-topology continuous profiler.
+
+fdmetrics says WHICH hop saturates, fdtrace says WHEN — fdprof says
+WHY: which Python frames, which XLA compiles, which device windows eat
+the budget. Three surfaces over one shm + clock discipline:
+
+    recorder.py   [prof] config schema, the per-tile ProfRegion
+                  (folded-stack table + timestamped sample ring +
+                  capture doorbell), the host Sampler thread
+    device.py     jax.profiler capture windows + compile-event watch
+                  (verify tile housekeeping), driven by the doorbell
+    export.py     merged Perfetto bundle (fdtrace spans + host slices
+                  on the shared utils/tempo clock), folded text,
+                  top-k summaries (the BENCH json's e2e_profile)
+    bench_diff.py tools/fdbench — diff two BENCH_r*.json files with a
+                  regression-threshold exit code
+    cli.py        `python -m firedancer_tpu.prof` / tools/fdprof
+
+Disabled-path contract (same as fdtrace): an unprofiled tile's
+TileCtx.prof is None, the stem starts no sampler thread and writes no
+attribution state — unprofiled topologies pay one attribute check.
+"""
+from .export import (  # noqa: F401
+    folded_text, merged_chrome, profile_summary, read_folded,
+    read_samples, summary_text,
+)
+from .recorder import (  # noqa: F401
+    PROF_DEFAULTS, STATE_NAMES, TILE_PROF_KEYS, ProfRegion, ProfState,
+    Sampler, effective_prof, normalize_prof, region_for,
+)

@@ -1,0 +1,190 @@
+"""fdbench: the bench-trend observatory — diff two BENCH_r*.json
+files with a regression-threshold exit code.
+
+Every bench round prints one JSON document (bench.py): kernel
+verifies/s, e2e tps + knee, per-hop link budget, and (since fdprof)
+the per-stage profile summary. This tool turns two of those documents
+into the answer a perf PR must ship with: WHAT moved, by HOW much, and
+WHERE the time went — instead of a bare before/after number.
+
+    tools/fdbench OLD.json NEW.json             # human diff
+    tools/fdbench OLD.json NEW.json --gate      # exit 1 on regression
+        [--threshold 0.05]                      # allowed fractional drop
+
+Gated metrics (higher is better): the kernel vps (`value`), `e2e_tps`,
+and `e2e_knee_tps`. A metric absent from either side is reported but
+never gated (a CPU-fallback round must not fail the gate for skipping
+e2e — the witnessed_tpu record stands in when present, the same
+fallback bench.py's own FDTPU_BENCH_GATE_E2E uses). The profile top-k
+and link-budget deltas are attribution, not gates.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# (json key, label); all higher-is-better, gate-eligible
+GATE_METRICS = (
+    ("value", "kernel vps"),
+    ("e2e_tps", "e2e tps"),
+    ("e2e_knee_tps", "e2e knee tps"),
+)
+
+
+def load_bench(path: str) -> dict:
+    """A BENCH json in either shape: the bare record bench.py prints
+    (BENCH_r*_witnessed.json) or the driver wrapper whose `tail`
+    string holds that record as its last JSON-object line
+    (BENCH_r*.json round artifacts)."""
+    with open(path) as f:
+        doc = json.load(f)
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+    return doc
+
+
+def _metric(doc: dict, key: str):
+    """A gated metric, honoring the witnessed-record fallback bench.py
+    uses when the e2e stage was skipped (tunnel down)."""
+    v = doc.get(key)
+    if v is None and key.startswith("e2e"):
+        v = doc.get("witnessed_tpu", {}).get(key)
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _top_stacks(doc: dict) -> dict[str, dict[str, int]]:
+    """{tile: {stack: count}} from a BENCH json's e2e_profile."""
+    out: dict[str, dict[str, int]] = {}
+    for tn, p in (doc.get("e2e_profile") or {}).items():
+        out[tn] = {t["stack"]: int(t["count"])
+                   for t in p.get("top", [])}
+    return out
+
+
+def diff_bench(old: dict, new: dict) -> dict:
+    """Structured delta document (JSON-able): gated metric moves,
+    per-hop link-budget deltas, and profile top-k churn."""
+    metrics = {}
+    for key, label in GATE_METRICS:
+        ov, nv = _metric(old, key), _metric(new, key)
+        rec = {"label": label, "old": ov, "new": nv}
+        if ov is not None and nv is not None and ov > 0:
+            rec["frac"] = (nv - ov) / ov
+        metrics[key] = rec
+    links = {}
+    ol = old.get("e2e_link_budget") or {}
+    nl = new.get("e2e_link_budget") or {}
+    for ln in sorted(set(ol) | set(nl)):
+        o, n = ol.get(ln, {}), nl.get(ln, {})
+        links[ln] = {k: {"old": o.get(k), "new": n.get(k)}
+                     for k in ("pub", "lost", "backpressure",
+                               "consume_p99_us")
+                     if k in o or k in n}
+    ot, nt = _top_stacks(old), _top_stacks(new)
+    profile = {}
+    for tn in sorted(set(ot) | set(nt)):
+        o, n = ot.get(tn, {}), nt.get(tn, {})
+        rows = {}
+        for stack in sorted(set(o) | set(n)):
+            if o.get(stack) != n.get(stack):
+                rows[stack] = {"old": o.get(stack, 0),
+                               "new": n.get(stack, 0)}
+        if rows:
+            profile[tn] = rows
+    return {"metrics": metrics, "links": links, "profile": profile}
+
+
+def gate_regressions(diff: dict, threshold: float = 0.05) -> list[dict]:
+    """Gated metrics whose fractional drop exceeds the threshold —
+    non-empty means the gate fails (exit 1)."""
+    out = []
+    for key, rec in diff["metrics"].items():
+        frac = rec.get("frac")
+        if frac is not None and frac < -threshold:
+            out.append({"metric": key, "label": rec["label"],
+                        "old": rec["old"], "new": rec["new"],
+                        "frac": frac})
+    return out
+
+
+def render_text(diff: dict, regressions: list[dict],
+                threshold: float) -> str:
+    lines = ["fdbench diff", "============"]
+    for key, rec in diff["metrics"].items():
+        ov, nv = rec["old"], rec["new"]
+        if ov is None and nv is None:
+            continue
+        arrow = ""
+        if rec.get("frac") is not None:
+            arrow = f"  ({rec['frac']:+.1%})"
+        lines.append(f"{rec['label']:<16} "
+                     f"{ov if ov is not None else '-':>12} -> "
+                     f"{nv if nv is not None else '-':>12}{arrow}")
+    if diff["links"]:
+        lines.append("")
+        lines.append(f"{'link':<18}{'pub':>16}{'lost':>12}"
+                     f"{'bp':>12}{'p99us':>14}")
+        for ln, rec in diff["links"].items():
+            def cell(k):
+                c = rec.get(k)
+                if not c:
+                    return "-"
+                return f"{c['old'] if c['old'] is not None else '-'}" \
+                       f"->{c['new'] if c['new'] is not None else '-'}"
+            lines.append(f"{ln:<18}{cell('pub'):>16}{cell('lost'):>12}"
+                         f"{cell('backpressure'):>12}"
+                         f"{cell('consume_p99_us'):>14}")
+    for tn, rows in diff["profile"].items():
+        lines.append("")
+        lines.append(f"profile {tn} (top-k sample-count deltas):")
+        for stack, c in rows.items():
+            lines.append(f"  {c['old']:>6} -> {c['new']:>6}  {stack}")
+    lines.append("")
+    if regressions:
+        for r in regressions:
+            lines.append(f"REGRESSION: {r['label']} {r['old']} -> "
+                         f"{r['new']} ({r['frac']:+.1%}, threshold "
+                         f"-{threshold:.0%})")
+    else:
+        lines.append(f"gate: clean (threshold -{threshold:.0%})")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdbench",
+        description="diff two BENCH json files; --gate exits nonzero "
+                    "on a regression beyond --threshold")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--gate", action="store_true")
+    ap.add_argument("--threshold", type=float, default=0.05)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the structured diff document instead")
+    args = ap.parse_args(argv)
+    old = load_bench(args.old)
+    new = load_bench(args.new)
+    d = diff_bench(old, new)
+    regs = gate_regressions(d, threshold=args.threshold)
+    if args.json:
+        json.dump({"diff": d, "regressions": regs}, sys.stdout,
+                  indent=2)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_text(d, regs, args.threshold))
+    return 1 if (args.gate and regs) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

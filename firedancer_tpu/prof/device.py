@@ -1,0 +1,184 @@
+"""fdprof device side: bounded `jax.profiler` trace windows + compile
+artifacts, driven by the shm capture doorbell.
+
+The host sampler (recorder.py) explains Python time; the questions it
+cannot answer — which XLA ops, which dispatch stalls, which compiles —
+belong to the device profiler. A capture is a bounded window: the
+owning tile's housekeeping sees `capture_req > capture_ack` on its
+ProfRegion (bumped by the metric tile on an SLO breach, or by
+`tools/fdprof --capture`), starts `jax.profiler.start_trace` into a
+per-tile directory, lets the normal poll loop run the window out, then
+stops the trace, writes a JSON manifest next to the supervisor black
+boxes, stamps an EV_PROF_CAPTURE span into the flight recorder, and
+acks. A backend without a working profiler still produces the manifest
+(ok=false + the error) — a breach-triggered drill must leave an
+artifact either way.
+
+Compile events ride the same housekeeping pass: a jit cache-size
+increase since the last pass is a compile the steady-state padding
+discipline should have prevented; it leaves an EV_COMPILE trace event
+and refreshes the compile manifest (count, device memory, timestamps).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..utils.tempo import monotonic_ns
+
+
+def capture_manifest_path(topology: str, tile: str) -> str:
+    return f"/dev/shm/fdtpu_{topology}.prof.{tile}.capture.json"
+
+
+def compile_manifest_path(topology: str, tile: str) -> str:
+    return f"/dev/shm/fdtpu_{topology}.prof.{tile}.compile.json"
+
+
+def trace_dir(topology: str, tile: str) -> str:
+    # the heavyweight profiler output (TensorBoard/XPlane) goes to
+    # /tmp, not /dev/shm — only the small manifest lives with the
+    # black boxes
+    return f"/tmp/fdtpu_prof_{topology}_{tile}"
+
+
+def request_capture(plan: dict, wksp, tile: str) -> bool:
+    """Bump the capture doorbell on a profiled tile (requester side:
+    metric tile on breach, or the fdprof CLI). False if unprofiled."""
+    from .recorder import region_for
+    region = region_for(plan, wksp, tile)
+    if region is None:
+        return False
+    region.request_capture()
+    return True
+
+
+class DeviceCapture:
+    """The owning tile's capture state machine (one per device tile,
+    polled from its housekeeping — never from the hot loop):
+
+        poll() -> started | stopped-manifest-path | None
+
+    Window length comes from the plan's [prof] capture_ms; the window
+    runs out across housekeeping passes so the poll loop keeps driving
+    the device while the profiler records it."""
+
+    def __init__(self, plan: dict, tile: str, region, trace=None):
+        self.plan, self.tile, self.region = plan, tile, region
+        self.trace = trace
+        self.topology = plan.get("topology", "?")
+        self.window_ms = float(
+            (plan.get("prof") or {}).get("capture_ms", 200.0))
+        self._active: dict | None = None
+        self.captures = 0
+
+    def _start(self, req: int):
+        t0 = monotonic_ns()
+        d = trace_dir(self.topology, self.tile)
+        err = None
+        try:
+            os.makedirs(d, exist_ok=True)
+            import jax
+            jax.profiler.start_trace(d)
+        except Exception as e:     # noqa: BLE001 — manifest either way
+            err = f"{e!r}"[:200]
+        self._active = {"req": req, "t0": t0, "dir": d, "err": err,
+                        "deadline": t0 + int(self.window_ms * 1e6)}
+
+    def _stop(self) -> str | None:
+        act, self._active = self._active, None
+        t1 = monotonic_ns()
+        if act["err"] is None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                act["err"] = f"{e!r}"[:200]
+        doc = {
+            "topology": self.topology,
+            "tile": self.tile,
+            "req": act["req"],
+            "t0_ns": act["t0"],
+            "t1_ns": t1,
+            "window_ms": self.window_ms,
+            "ok": act["err"] is None,
+            "trace_dir": act["dir"] if act["err"] is None else None,
+            "error": act["err"],
+        }
+        path = capture_manifest_path(self.topology, self.tile)
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        except OSError:
+            path = None
+        if self.trace is not None:
+            from ..trace.events import EV_PROF_CAPTURE
+            self.trace.span(EV_PROF_CAPTURE, act["t0"],
+                            count=act["req"])
+        self.region.ack_capture(act["req"])
+        self.captures += 1
+        return path
+
+    def poll(self) -> str | None:
+        """One housekeeping-cadence pass; returns the manifest path
+        when a window just closed."""
+        if self._active is not None:
+            if monotonic_ns() >= self._active["deadline"]:
+                return self._stop()
+            return None
+        req = self.region.capture_req
+        if req > self.region.capture_ack:
+            self._start(req)
+        return None
+
+    def flush(self):
+        """Halt path: close an open window so the ack never dangles."""
+        if self._active is not None:
+            self._stop()
+
+
+class CompileWatch:
+    """Compile-event capture: detects jit cache growth between
+    housekeeping passes, stamps EV_COMPILE into the flight recorder,
+    and keeps the compile manifest fresh. `compiles_fn` returns the
+    current compiled-variant count (adapter-provided: jax version
+    differences stay in one place)."""
+
+    def __init__(self, plan: dict, tile: str, compiles_fn, trace=None,
+                 mem_fn=None, manifest: bool = True):
+        self.topology = plan.get("topology", "?")
+        self.tile = tile
+        self._compiles = compiles_fn
+        self._mem = mem_fn or (lambda: 0)
+        self.trace = trace
+        self.manifest = manifest   # manifest files only when profiled
+        self.last = 0             # warmup's compile registers on the
+        self.events = 0           # first pass: boot compile is event 1
+
+    def poll(self) -> int | None:
+        """Returns the new compile count when one was detected."""
+        cur = self._compiles()
+        if cur <= self.last:
+            return None
+        self.last = cur
+        self.events += 1
+        if self.trace is not None:
+            from ..trace.events import EV_COMPILE
+            self.trace.event(EV_COMPILE, arg=self._mem(), count=cur)
+        if not self.manifest:
+            return cur
+        doc = {
+            "topology": self.topology,
+            "tile": self.tile,
+            "compiles": cur,
+            "cache_miss": max(0, cur - 1),
+            "device_mem_bytes": self._mem(),
+            "ts_ns": monotonic_ns(),
+        }
+        try:
+            with open(compile_manifest_path(self.topology, self.tile),
+                      "w") as f:
+                json.dump(doc, f)
+        except OSError:
+            pass
+        return cur

@@ -39,6 +39,12 @@ EV_DOWN = 16         # instant: supervisor observed abnormal death
 EV_SLO = 17          # instant: SLO breach (metric-tile-written;
                      #   arg = measured value, count = target index
                      #   into the plan's [slo] target list)
+EV_COMPILE = 18      # instant: jit cache grew — a compile the padding
+                     #   discipline should have prevented (fdprof
+                     #   CompileWatch; arg = device mem bytes,
+                     #   count = total compiled variants)
+EV_PROF_CAPTURE = 19  # span: bounded device-trace window (fdprof
+                     #   DeviceCapture; count = doorbell req id)
 
 NAMES = {
     EV_BOOT: "boot", EV_HALT: "halt", EV_FAIL: "fail",
@@ -48,12 +54,13 @@ NAMES = {
     EV_TPU_DISPATCH: "tpu_dispatch", EV_TPU_READBACK: "tpu_readback",
     EV_CPU_FALLBACK: "cpu_fallback", EV_CHAOS: "chaos",
     EV_WATCHDOG: "watchdog", EV_RESTART: "restart", EV_DOWN: "down",
-    EV_SLO: "slo",
+    EV_SLO: "slo", EV_COMPILE: "compile",
+    EV_PROF_CAPTURE: "prof_capture",
 }
 
 # span events: record.ts is the END, record.arg the duration in ns
 SPANS = {EV_WAIT, EV_WORK, EV_HOUSEKEEP, EV_BACKPRESSURE,
-         EV_TPU_DISPATCH, EV_TPU_READBACK}
+         EV_TPU_DISPATCH, EV_TPU_READBACK, EV_PROF_CAPTURE}
 
 # frag-scoped events (sig is a lineage key, not 0-means-nothing)
 FRAG_EVENTS = {EV_CONSUME, EV_PUBLISH}

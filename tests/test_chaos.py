@@ -17,6 +17,13 @@ import pytest
 
 from firedancer_tpu.utils.chaos import ChaosPlan
 
+
+def _wedge_s() -> float:
+    """The ONE 2-core deflake policy: test_supervise.py owns the
+    watchdog-window scaling; import it so a retune cannot drift."""
+    from test_supervise import WEDGE_S
+    return WEDGE_S
+
 pytestmark = pytest.mark.chaos
 
 
@@ -227,7 +234,10 @@ def test_stalled_consumer_fseq_recovers_via_watchdog():
         .tile("b", "sink", ins=["a_b"],
               supervise={"policy": "restart", "backoff_s": 0.05,
                          "max_restarts": 4, "window_s": 30.0,
-                         "wedge_timeout_s": 0.4},
+                         # THE shared 2-core deflake window (a small
+                         # box's scheduler stalls healthy tiles past a
+                         # 0.4 s deadline — the r10 tier-1 flake)
+                         "wedge_timeout_s": _wedge_s()},
               chaos={"events": [{"action": "stall_fseq", "at_rx": 8}]})
     )
     runner = TopologyRunner(topo.build()).start()

@@ -242,6 +242,38 @@ def test_trace_section_is_clean_when_valid():
     assert lint_config(cfg, "<fixture>") == []
 
 
+def test_bad_prof_unknown_key_and_shape():
+    cfg = _cfg(prof={"enable": True, "slotz": 64})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-prof")
+    assert "did you mean 'slots'" in findings[0].message
+    fires_once(lint_config(_cfg(prof={"enable": True, "ring": 100}),
+                           "<fixture>"), "bad-prof")
+    # per-tile override table goes through the same schema gate
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"],
+         "prof": {"hz": 0}}])
+    fires_once(lint_config(cfg, "<fixture>"), "bad-prof")
+
+
+def test_bad_prof_unknown_tile_refs():
+    cfg = _cfg(prof={"enable": True, "tiles": ["ghost"]})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-prof")
+    assert "not a declared tile" in findings[0].message
+    fires_once(lint_config(
+        _cfg(prof={"enable": True, "breach_capture": ["ghost"]}),
+        "<fixture>"), "bad-prof")
+
+
+def test_prof_section_is_clean_when_valid():
+    cfg = _cfg(prof={"enable": True, "hz": 29, "slots": 128,
+                     "ring": 512, "tiles": ["dst"],
+                     "breach_capture": ["dst"]})
+    assert lint_config(cfg, "<fixture>") == []
+
+
 def test_bad_slo_unknown_key_and_grammar():
     cfg = _cfg(slo={"fast_windw_s": 1.0})
     findings = lint_config(cfg, "<fixture>")

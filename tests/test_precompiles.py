@@ -126,6 +126,7 @@ def _p256_ix(sig, pub33, msg):
 def test_secp256r1_precompile():
     """P-256 precompile (SIMD-0075): verify via an OpenSSL-made
     signature, reject corrupt/high-s/truncated."""
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.hazmat.primitives.asymmetric.utils import (
         decode_dss_signature)

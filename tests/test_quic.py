@@ -8,6 +8,10 @@ import time
 
 import pytest
 
+# waltz/quic needs the AEAD primitives at import time; a bare
+# environment must collect this module clean (skip, not error)
+pytest.importorskip("cryptography")
+
 from firedancer_tpu.waltz import quic
 
 

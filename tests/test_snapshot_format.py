@@ -81,6 +81,7 @@ def _funk_with_accounts(n=300):
 
 
 def test_archive_roundtrip_with_lattice_verify(tmp_path):
+    pytest.importorskip("zstandard")
     path = str(tmp_path / "snap.tar.zst")
     funk = _funk_with_accounts()
     write_snapshot_archive(path, 42, funk, accounts_per_vec=64)
@@ -95,6 +96,7 @@ def test_archive_roundtrip_with_lattice_verify(tmp_path):
 
 
 def test_tampered_archive_fails_lattice_verify(tmp_path):
+    pytest.importorskip("zstandard")
     import zstandard
     path = str(tmp_path / "snap.tar.zst")
     funk = _funk_with_accounts(50)
@@ -116,6 +118,7 @@ def test_tampered_archive_fails_lattice_verify(tmp_path):
 
 
 def test_streaming_restorer_chunked(tmp_path):
+    pytest.importorskip("zstandard")
     path = str(tmp_path / "snap.tar.zst")
     funk = _funk_with_accounts(120)
     write_snapshot_archive(path, 9, funk, accounts_per_vec=32)
@@ -129,6 +132,7 @@ def test_streaming_restorer_chunked(tmp_path):
 
 
 def test_missing_vec_fails(tmp_path):
+    pytest.importorskip("zstandard")
     import zstandard
     path = str(tmp_path / "snap.tar.zst")
     funk = _funk_with_accounts(80)
@@ -156,6 +160,7 @@ def test_missing_vec_fails(tmp_path):
 
 @pytest.mark.slow
 def test_snapld_snapdc_snapin_pipeline(tmp_path):
+    pytest.importorskip("zstandard")
     """The full restore tile chain over rings: file -> snapld ->
     snapdc (zstd) -> snapin (tar+AppendVec), lattice verified."""
     import os

@@ -54,6 +54,8 @@ def test_x25519_rfc7748_dh():
 
 
 def test_x25519_differential_vs_openssl():
+    import pytest
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric.x25519 import (
         X25519PrivateKey,
@@ -77,6 +79,8 @@ def test_x25519_rejects_small_order():
 # ---------------------------------------------------------------------------
 
 def test_cert_parses_and_verifies_under_openssl():
+    import pytest
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
