@@ -26,6 +26,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -500,10 +501,43 @@ def main():
         except Exception as e:  # noqa: BLE001 — annotate, don't break
             result["bench_gate"] = {"prev": prev,
                                     "error": f"{e!r}"[:200]}
+    _emit_report(result)
     print(json.dumps(result))
     sys.stdout.flush()
     sys.exit(_gate_rc(result, os.environ.get("FDTPU_BENCH_GATE_E2E"))
              or trend_rc)
+
+
+def _emit_report(result: dict):
+    """Per-round report artifact (fdgui): FDTPU_BENCH_REPORT=<out.html>
+    (any other truthy value means ./report.html) renders the
+    bench-trend dashboard over every BENCH_r*.json round plus THIS
+    round's record — so every CI/bench run leaves an openable report
+    next to its json. Annotates `result` (report / report_error),
+    never breaks the JSON line."""
+    rep = os.environ.get("FDTPU_BENCH_REPORT")
+    if not rep:
+        return
+    try:
+        import glob as _glob
+        out_path = rep if rep.endswith(".html") \
+            else os.path.join(HERE, "report.html")
+        cur = os.path.join(tempfile.gettempdir(),
+                           f"BENCH_current.{os.getpid()}.json")
+        with open(cur, "w") as f:
+            json.dump(result, f)
+        try:
+            from firedancer_tpu.gui.report import report_from_bench
+            rounds = sorted(_glob.glob(
+                os.path.join(HERE, "BENCH_r*.json")))
+            # bench_series preserves caller order, so THIS round is
+            # the trajectory's last point wherever tempdir sorts
+            report_from_bench(rounds + [cur], out_path)
+        finally:
+            os.unlink(cur)
+        result["report"] = out_path
+    except Exception as e:  # noqa: BLE001 — annotate, don't break
+        result["report_error"] = f"{e!r}"[:200]
 
 
 def _gate_rc(result: dict, floor: str | None) -> int:

@@ -6,7 +6,11 @@ from shared memory on a daemon thread while the tile loop stays idle,
 so the endpoint survives any other tile's death. This module is the
 ONE implementation of that shape — route table, ephemeral-port bind,
 clean shutdown — so adapters stop duplicating ThreadingHTTPServer
-boilerplate.
+boilerplate. Since fdgui v2 it also owns the STREAMING half: ws_routes
+upgrade to RFC 6455 (disco/ws.py — the same framing layer rpc/ws.py
+uses, the reference's one-waltz/http-under-everything shape) with
+per-client bounded send queues that shed slow clients instead of
+blocking the serving tile.
 
 Request counting is thread-safe by construction (`Counter` below):
 ThreadingHTTPServer runs each request on its own thread, so a bare
@@ -47,16 +51,49 @@ class TileHttpServer:
     (status, content_type, body_bytes). Handler exceptions become 500s
     (a rendering bug must not kill the serving thread). `requests`
     counts every handled request, thread-safely.
+
+    ws_routes: {path: on_connect}; a GET with an Upgrade header on one
+    of these paths becomes a WebSocket (disco/ws.py). on_connect(conn)
+    runs right after the 101 (send the snapshot there); afterwards the
+    handler thread serves the inbound half (ping/close) while the
+    conn's sender thread drains its bounded queue. `broadcast(path,
+    obj)` fans a JSON frame to every live client of a path — O(1)
+    enqueue per client, slow clients degrade per the WsConn policy
+    (drop-oldest, then shed) instead of stalling the caller.
+    ws_max_clients bounds concurrent upgrades (excess get 503),
+    ws_queue is the per-client frame high-water mark, ws_sndbuf caps
+    the kernel send buffer so a stalled peer's backlog lands in OUR
+    queue where the policy lives.
     """
 
     def __init__(self, routes: dict, port: int = 0,
-                 bind_addr: str = "127.0.0.1"):
+                 bind_addr: str = "127.0.0.1", ws_routes: dict | None = None,
+                 ws_max_clients: int = 8, ws_queue: int = 64,
+                 ws_sndbuf: int = 0):
         self.routes = dict(routes)
+        self.ws_routes = dict(ws_routes or {})
+        self.ws_max_clients = int(ws_max_clients)
+        self.ws_queue = int(ws_queue)
+        self.ws_sndbuf = int(ws_sndbuf)
         self.requests = Counter()
+        self.ws_accepted = Counter()
+        self.ws_rejected = Counter()
+        self._ws_lock = threading.Lock()
+        self._ws_clients: dict[str, list] = {}
+        self._ws_live = 0       # admitted upgrades (slot reservation)
+        self._ws_shed = 0       # dead clients' shed flags, accumulated
+        self._ws_dropped = 0    # dead clients' dropped frames, likewise
+        self._ws_sent = 0
         plumbing = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                on_connect = plumbing.ws_routes.get(self.path)
+                if on_connect is not None and "upgrade" in \
+                        self.headers.get("Connection", "").lower():
+                    plumbing.requests.bump()
+                    plumbing._ws_upgrade(self, on_connect)
+                    return
                 handler = plumbing.routes.get(self.path)
                 if handler is None:
                     plumbing.requests.bump()
@@ -90,6 +127,130 @@ class TileHttpServer:
             target=self.server.serve_forever, daemon=True)
         self.thread.start()
 
+    # -- websocket plumbing -------------------------------------------------
+
+    @staticmethod
+    def _origin_ok(origin: str, host_header: str) -> bool:
+        """Browsers exempt WebSocket from same-origin policy, so a
+        malicious page could stream the whole operator dashboard from
+        an unwitting operator's loopback. When the client VOLUNTEERS
+        an Origin (browsers always do), it must be loopback or match
+        the Host it connected to; non-browser clients send no Origin
+        and pass."""
+        from urllib.parse import urlsplit
+        try:
+            oh = (urlsplit(origin).hostname or "").lower()
+        except ValueError:
+            return False
+        if oh in ("localhost", "127.0.0.1", "::1"):
+            return True
+        hh = host_header.rsplit(":", 1)[0].strip("[]").lower()
+        return bool(oh) and oh == hh
+
+    def _ws_upgrade(self, handler, on_connect):
+        from .ws import WsConn, handshake_response
+        key = handler.headers.get("Sec-WebSocket-Key")
+        if not key or "websocket" not in \
+                handler.headers.get("Upgrade", "").lower():
+            self.ws_rejected.bump()
+            handler.send_error(400, "bad websocket upgrade")
+            return
+        origin = handler.headers.get("Origin")
+        if origin and not self._origin_ok(
+                origin, handler.headers.get("Host", "")):
+            self.ws_rejected.bump()
+            handler.send_error(403, "cross-origin websocket refused")
+            return
+        # check-and-reserve in ONE critical section: two simultaneous
+        # upgrades must not both read live < max and both get admitted
+        with self._ws_lock:
+            admitted = self._ws_live < self.ws_max_clients
+            if admitted:
+                self._ws_live += 1
+        if not admitted:
+            # graceful degradation: a full house answers 503, it does
+            # not queue — the operator sees the refusal immediately
+            self.ws_rejected.bump()
+            handler.send_error(503, "websocket client limit")
+            return
+        conn = None
+        try:
+            handler.wfile.write(handshake_response(key))
+            handler.wfile.flush()
+            handler.close_connection = True
+            conn = WsConn(handler.connection, rfile=handler.rfile,
+                          hwm=self.ws_queue, sndbuf=self.ws_sndbuf)
+            # the snapshot goes into the FIFO before broadcast can see
+            # this client: registration AFTER on_connect guarantees
+            # the documented snapshot-then-deltas order
+            on_connect(conn)
+            with self._ws_lock:
+                self._ws_clients.setdefault(handler.path, []) \
+                    .append(conn)
+            self.ws_accepted.bump()
+            conn.run_reader()
+        finally:
+            self._unregister(handler.path, conn)
+
+    def _unregister(self, path: str, conn):
+        with self._ws_lock:
+            self._ws_live -= 1
+            if conn is None:
+                return
+            clients = self._ws_clients.get(path, [])
+            if conn in clients:
+                clients.remove(conn)
+            self._ws_shed += int(conn.shed)
+            self._ws_dropped += conn.dropped
+            self._ws_sent += conn.sent
+        conn.close()
+
+    def ws_clients(self, path: str) -> list:
+        with self._ws_lock:
+            return list(self._ws_clients.get(path, []))
+
+    def has_ws_clients(self, path: str) -> bool:
+        with self._ws_lock:
+            return bool(self._ws_clients.get(path))
+
+    def broadcast(self, path: str, obj) -> int:
+        """Fan one JSON frame to every live client of a ws route;
+        returns how many accepted it. Serializes ONCE (this runs on
+        the serving tile's housekeeping thread — N clients must not
+        cost N json.dumps of a multi-KB delta). Never blocks (WsConn
+        contract); clients shed by the enqueue are swept by their
+        reader threads."""
+        clients = self.ws_clients(path)
+        if not clients:
+            return 0
+        import json
+
+        from .ws import encode_frame
+        frame = encode_frame(json.dumps(obj).encode())
+        n = 0
+        for conn in clients:
+            if conn.enqueue(frame):
+                n += 1
+        return n
+
+    def ws_stats(self) -> dict:
+        """Aggregate queue telemetry over live AND dead clients (the
+        gui tile's ws_* metric slots)."""
+        with self._ws_lock:
+            live = [c for v in self._ws_clients.values() for c in v]
+            return {
+                "clients": self._ws_live,
+                "sent": self._ws_sent + sum(c.sent for c in live),
+                "dropped": self._ws_dropped
+                + sum(c.dropped for c in live),
+                "shed": self._ws_shed
+                + sum(int(c.shed) for c in live),
+            }
+
     def close(self):
+        with self._ws_lock:
+            conns = [c for v in self._ws_clients.values() for c in v]
+        for c in conns:
+            c.close()
         self.server.shutdown()
         self.server.server_close()

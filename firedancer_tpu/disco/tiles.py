@@ -2258,118 +2258,148 @@ class VinylAdapter:
         return dict(self.m)
 
 
-_GUI_HTML = """<!doctype html><html><head><meta charset="utf-8">
-<title>firedancer-tpu</title><style>
-body{font-family:ui-monospace,monospace;background:#0b0e14;color:#d6d9e0;
-margin:24px}h1{font-size:16px;color:#7aa2f7}table{border-collapse:collapse;
-margin-top:12px}td,th{padding:3px 10px;border-bottom:1px solid #1f2430;
-text-align:left;font-size:12px}th{color:#7aa2f7}.RUN{color:#9ece6a}
-.BOOT{color:#e0af68}.HALT,.FAIL{color:#f7768e}#tps{font-size:28px;
-color:#9ece6a}small{color:#565f89}</style></head><body>
-<h1>firedancer-tpu <small id="topo"></small></h1>
-<div>TPS <span id="tps">-</span></div>
-<table id="t"><thead><tr><th>tile</th><th>kind</th><th>state</th>
-<th>hb age</th><th>work p99 &micro;s</th><th>metrics</th></tr></thead>
-<tbody></tbody></table>
-<script>
-async function tick(){
- try{
-  const r=await fetch('summary.json');const s=await r.json();
-  document.getElementById('topo').textContent=s.topology;
-  document.getElementById('tps').textContent=s.tps.toFixed(0);
-  const tb=document.querySelector('#t tbody');tb.innerHTML='';
-  for(const [tn,row] of Object.entries(s.tiles)){
-   const ms=Object.entries(row.metrics).filter(([k,v])=>v)
-     .map(([k,v])=>k+'='+v).join(' ');
-   const w=row.latency.work||{};
-   tb.insertAdjacentHTML('beforeend',
-    `<tr><td>${tn}</td><td>${row.kind}</td>`+
-    `<td class="${row.state}">${row.state}</td>`+
-    `<td>${row.hb_age_ticks}</td>`+
-    `<td>${w.count?w.p99_us.toFixed(0):'-'}</td><td>${ms}</td></tr>`);
-  }
- }catch(e){}
- setTimeout(tick,1000);
-}
-tick();
-</script></body></html>"""
-
-
 @register("gui")
 class GuiAdapter:
-    """Live dashboard (ref: src/disco/gui/fd_gui.c + fd_gui_tile.c —
-    the reference serves a bundled frontend over HTTP+WebSocket; here
-    a self-contained page polls a JSON summary rendered straight from
-    the shm metrics + cnc regions, the same sources the monitor CLI
-    reads). TPS derives from the delta of a configured counter
-    (args: tps_tile/tps_metric, default sink.rx) sampled at the
-    housekeeping cadence.
+    """fdgui v2: the live operator dashboard (ref: src/disco/gui/
+    fd_gui.c + fd_gui_tile.c — the reference serves a bundled frontend
+    over HTTP+WebSocket with a snapshot+delta protocol,
+    book/api/websocket.md, on the shared waltz/http server). Here the
+    same shape over the shared plumbing: TileHttpServer (disco/httpd)
+    serves the self-contained page (gui/page.py) plus a `/ws` route —
+    on connect the client gets one full topology snapshot, then a
+    delta per housekeeping pass (gui/schema.py: TPS, per-tile
+    state/metrics/latency/occupancy incl. supervisor counters,
+    per-link pub/consumed/loss/backpressure + consume quantiles, SLO
+    status + breach history). Everything is READ-side over existing
+    shm surfaces: zero writer-side cost.
 
-    args: port (0 = ephemeral, published as the "port" metric),
-    bind_addr, tps_tile, tps_metric."""
+    Slow clients degrade gracefully (WsConn): enqueue never blocks the
+    housekeeping loop; a backed-up queue drops oldest frames, and a
+    stalled client is shed (ws_shed metric) — the cadence is never
+    hostage to a dead TCP peer.
 
-    METRICS = ["port", "requests"]
-    GAUGES = ["port"]
+    args (gui/schema.py normalize_gui — validated at config load,
+    topo.build, and by fdlint's bad-gui rule): port (0 = ephemeral,
+    published as the "port" metric), bind_addr, tps_tile/tps_metric
+    (TPS counter source, default sink.rx), ws_max_clients, ws_queue,
+    ws_sndbuf, bench_glob (/bench.json trend source), report_on_halt
+    (write the static report artifact on clean halt)."""
+
+    METRICS = ["port", "requests", "ws_clients", "ws_sent",
+               "ws_dropped", "ws_shed"]
+    GAUGES = ["port", "ws_clients"]
 
     def __init__(self, ctx, args):
-        import time as _t
-
+        from ..gui import (DeltaSource, normalize_gui, page_html,
+                           snapshot_doc)
         from .httpd import TileHttpServer
-        from .monitor import snapshot
+        a = normalize_gui(args)
         self.ctx = ctx
-        self.tps_tile = args.get("tps_tile", "sink")
-        self.tps_metric = args.get("tps_metric", "rx")
-        self._tps = 0.0
-        self._last = (None, 0.0)       # (count, t)
+        # TPS rides the delta source on utils/tempo.monotonic_ns —
+        # THE topology clock (heartbeats, watchdog, trace, prof); a
+        # perf_counter-derived rate would disagree with the trace/prof
+        # timelines on the shared clock domain
+        self._delta_src = DeltaSource(ctx.plan, ctx.wksp,
+                                      tps_tile=a["tps_tile"],
+                                      tps_metric=a["tps_metric"])
+        self._report_on_halt = a["report_on_halt"]
+        self._bench_glob = a["bench_glob"]
 
         def page_route():
-            return 200, "text/html", _GUI_HTML.encode()
+            return 200, "text/html", page_html().encode()
 
         def summary_route():
-            body = json.dumps({
-                "topology": ctx.plan["topology"],
-                "tps": self._tps,
-                "tiles": snapshot(ctx.plan, ctx.wksp),
-            }).encode()
+            # handler-thread shm reads can race a halting topology
+            # (tiles tearing down mid-snapshot): answer 503 like the
+            # monitor tolerates a stale plan, never a traceback-500
+            try:
+                body = json.dumps({
+                    "topology": ctx.plan["topology"],
+                    "tps": self._delta_src.tps,
+                    **{k: v for k, v in self._summary().items()
+                       if k != "topology"},
+                }).encode()
+            except Exception as e:   # noqa: BLE001 — teardown race
+                return 503, "application/json", json.dumps(
+                    {"error": f"topology unreadable: {e!r}"}).encode()
             return 200, "application/json", body
 
-        # the shared reader-side HTTP plumbing (disco/httpd.py) also
-        # owns the request counter — handler threads used to race a
-        # bare `requests += 1` here and drop counts
+        def flame_route():
+            from ..prof.export import read_folded
+            try:
+                body = json.dumps(
+                    read_folded(ctx.plan, ctx.wksp)).encode()
+            except Exception as e:   # noqa: BLE001 — teardown race
+                return 503, "application/json", json.dumps(
+                    {"error": f"prof unreadable: {e!r}"}).encode()
+            return 200, "application/json", body
+
+        def bench_route():
+            import glob as _glob
+
+            from ..gui.report import bench_series
+            body = json.dumps(bench_series(
+                sorted(_glob.glob(self._bench_glob)))).encode()
+            return 200, "application/json", body
+
+        def on_ws_connect(conn):
+            conn.send_json(snapshot_doc(ctx.plan))
+
         self.server = TileHttpServer(
             {"/": page_route, "/index.html": page_route,
-             "/summary.json": summary_route},
-            port=int(args.get("port", 0)),
-            bind_addr=args.get("bind_addr", "127.0.0.1"))
+             "/summary.json": summary_route,
+             "/flame.json": flame_route, "/bench.json": bench_route},
+            port=a["port"], bind_addr=a["bind_addr"],
+            ws_routes={"/ws": on_ws_connect},
+            ws_max_clients=a["ws_max_clients"],
+            ws_queue=a["ws_queue"], ws_sndbuf=a["ws_sndbuf"])
         self.port = self.server.port
-        self._time = _t
+
+    def _summary(self) -> dict:
+        from .monitor import full_snapshot
+        return full_snapshot(self.ctx.plan, self.ctx.wksp)
 
     def housekeeping(self):
-        from .topo import read_metrics
-        tn = self.tps_tile
-        spec = self.ctx.plan["tiles"].get(tn)
-        if spec is None:
+        # TPS samples every pass (cheap: one metric-slot read); the
+        # full delta document is built only when someone is listening
+        self._delta_src.sample_tps()
+        if not self.server.has_ws_clients("/ws"):
             return
-        names = spec.get("metrics_names", [])
-        if self.tps_metric not in names:
-            return
-        vals = read_metrics(self.ctx.wksp, self.ctx.plan, tn)
-        cnt = int(vals[names.index(self.tps_metric)])
-        now = self._time.perf_counter()
-        last_cnt, last_t = self._last
-        if last_cnt is not None and now > last_t:
-            self._tps = max(0.0, (cnt - last_cnt) / (now - last_t))
-        self._last = (cnt, now)
+        try:
+            delta = self._delta_src.delta()
+        except Exception:   # noqa: BLE001 — mid-teardown read race:
+            return          # skip the tick, the stream resumes
+        self.server.broadcast("/ws", delta)
 
     def poll_once(self) -> int:
         return 0
 
     def on_halt(self):
+        if self._report_on_halt:
+            import glob as _glob
+
+            from ..gui.report import bench_series, collect, \
+                render_html
+            try:
+                data = collect(self.ctx.plan, self.ctx.wksp,
+                               deltas=1)
+                data["bench"] = bench_series(
+                    sorted(_glob.glob(self._bench_glob)))
+                with open(self._report_on_halt, "w") as f:
+                    f.write(render_html(data))
+            except Exception as e:   # noqa: BLE001 — the artifact is
+                from ..utils import log      # best-effort on halt
+                log.warning(f"gui: report_on_halt failed: {e!r}")
         self.server.close()
 
     def metrics_items(self):
+        ws = self.server.ws_stats()
         return {"port": self.port,
-                "requests": self.server.requests.value}
+                "requests": self.server.requests.value,
+                "ws_clients": ws["clients"],
+                "ws_sent": ws["sent"],
+                "ws_dropped": ws["dropped"],
+                "ws_shed": ws["shed"]}
 
 
 @register("cswtch")

@@ -206,6 +206,12 @@ class Topology:
                         f"{sorted(unknown)}")
             plan["prof"] = prof_cfg
             for tn, t in self.tiles.items():
+                if t.kind == "gui":
+                    # [tile.gui] schema gate (gui/schema.py is the one
+                    # validator — same three-layer contract as
+                    # [trace]/[prof]: config load, build, fdlint)
+                    from ..gui import normalize_gui
+                    normalize_gui(t.args)
                 for i in t.ins:
                     if i["reliable"]:
                         fs = Fseq(w)

@@ -1,0 +1,240 @@
+"""Shared RFC 6455 WebSocket plumbing for reader-side tiles.
+
+The reference serves its operator GUI and its RPC subscriptions over
+ONE http server implementation — `waltz/http`'s upgrade path backs
+both `fd_gui_tile.c` and the rpc websocket (ref:
+src/waltz/http/fd_http_server.h, book/api/websocket.md). This module
+is that seam: the framing/handshake helpers factored out of
+`rpc/ws.py` (which now imports them), plus `WsConn` — the per-client
+bounded send queue every streaming tile endpoint shares.
+
+`WsConn` is the graceful-degradation half (the shape the reference
+bakes into fd_http_server's outgoing buffer accounting): the serving
+tile's housekeeping ENQUEUES frames and never blocks; a dedicated
+sender thread drains the queue into the socket. A slow client backs
+the queue up; past the high-water mark the oldest frames are dropped
+(the client misses deltas, the tile does not stall), and a client
+that stalls through a full queue-turnover beyond capacity is force
+closed (`shed`) — the tile's cadence is never hostage to one dead
+TCP peer.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+
+WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# opcodes (RFC 6455 §5.2)
+OP_TEXT, OP_CLOSE, OP_PING, OP_PONG = 0x1, 0x8, 0x9, 0xA
+
+
+def accept_key(key: str) -> str:
+    """Sec-WebSocket-Accept for a client's Sec-WebSocket-Key (§4.2.2)."""
+    import base64
+    import hashlib
+    return base64.b64encode(
+        hashlib.sha1(key.encode() + WS_GUID).digest()).decode()
+
+
+def handshake_response(key: str) -> bytes:
+    """The raw 101 Switching Protocols response for an upgrade."""
+    return (b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + accept_key(key).encode()
+            + b"\r\n\r\n")
+
+
+def encode_frame(payload: bytes, opcode: int = OP_TEXT) -> bytes:
+    """One unmasked (server->client) FIN frame."""
+    hdr = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        hdr += bytes([n])
+    elif n < 1 << 16:
+        hdr += bytes([126]) + struct.pack(">H", n)
+    else:
+        hdr += bytes([127]) + struct.pack(">Q", n)
+    return hdr + payload
+
+
+def read_exact(src, n: int) -> bytes:
+    """Blocking exact read from a socket OR a buffered file object
+    (an http handler's rfile — upgrade reads must drain ITS buffer,
+    not the raw fd, or bytes pipelined behind the request vanish).
+    The socket path waits on select and retries EAGAIN: the send
+    side's timeout may flip the SHARED file description non-blocking
+    (the write fd is a dup)."""
+    if not hasattr(src, "recv"):
+        out = b""
+        while len(out) < n:
+            chunk = src.read(n - len(out))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            out += chunk
+        return out
+    import select
+    out = b""
+    while len(out) < n:
+        select.select([src], [], [])
+        try:
+            chunk = src.recv(n - len(out))
+        except (BlockingIOError, InterruptedError):
+            continue
+        except socket.timeout:
+            continue
+        if not chunk:
+            raise ConnectionError("peer closed")
+        out += chunk
+    return out
+
+
+def read_frame(src):
+    """-> (opcode, payload); unmasks client frames (required §5.1)."""
+    b0, b1 = read_exact(src, 2)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        n, = struct.unpack(">H", read_exact(src, 2))
+    elif n == 127:
+        n, = struct.unpack(">Q", read_exact(src, 8))
+    if n > 1 << 20:
+        raise ConnectionError("frame too large")
+    mask = read_exact(src, 4) if masked else b"\x00" * 4
+    payload = bytearray(read_exact(src, n))
+    if masked:
+        for i in range(len(payload)):
+            payload[i] ^= mask[i & 3]
+    return opcode, bytes(payload)
+
+
+class WsConn:
+    """One upgraded client: bounded send queue + sender thread.
+
+    enqueue()/send_json() are O(1) and NEVER block — the serving
+    tile's housekeeping stays on cadence no matter what the peer
+    does. Overflow policy (hwm frames): drop-oldest, and force-close
+    once `hwm` further frames have been dropped without a single
+    successful send (the peer has stalled through an entire queue
+    turnover beyond capacity — it is dead weight, shed it).
+
+    `sndbuf` caps the kernel send buffer at upgrade time so a stalled
+    peer backs pressure into OUR queue (where the policy lives)
+    instead of into megabytes of kernel memory."""
+
+    __slots__ = ("sock", "wsock", "_rsrc", "hwm", "q", "cv", "closed",
+                 "shed", "sent", "dropped", "_pending_drop", "_thread")
+
+    def __init__(self, sock, rfile=None, hwm: int = 64,
+                 sndbuf: int = 0):
+        import os as _os
+        self.sock = sock
+        if sndbuf:
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                int(sndbuf))
+            except OSError:
+                pass
+        # sender side: an independent socket OBJECT over a dup'd fd so
+        # closing/timeouts never affect the blocking reader (python
+        # socket timeouts are per-object, not per-fd)
+        self.wsock = socket.socket(fileno=_os.dup(sock.fileno()))
+        self._rsrc = rfile if rfile is not None else sock
+        self.hwm = max(2, int(hwm))
+        self.q: deque[bytes] = deque()
+        self.cv = threading.Condition()
+        self.closed = False
+        self.shed = False
+        self.sent = 0
+        self.dropped = 0
+        self._pending_drop = 0
+        self._thread = threading.Thread(target=self._sender,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- enqueue side (the tile) -------------------------------------------
+
+    def send_json(self, obj) -> bool:
+        import json
+        return self.enqueue(encode_frame(json.dumps(obj).encode()))
+
+    def enqueue(self, frame: bytes) -> bool:
+        """Queue a frame; returns False if the client is closed (or
+        was just shed by this call). Never blocks."""
+        force = False
+        with self.cv:
+            if self.closed:
+                return False
+            self.q.append(frame)
+            while len(self.q) > self.hwm:
+                self.q.popleft()
+                self.dropped += 1
+                self._pending_drop += 1
+            if self._pending_drop > self.hwm:
+                force = True
+            self.cv.notify()
+        if force:
+            self.shed = True
+            self.close()
+            return False
+        return True
+
+    # -- drain side (the sender thread) ------------------------------------
+
+    def _sender(self):
+        while True:
+            with self.cv:
+                while not self.q and not self.closed:
+                    self.cv.wait()
+                if self.closed:
+                    return
+                frame = self.q.popleft()
+            try:
+                self.wsock.sendall(frame)
+            except OSError:
+                self.close()
+                return
+            with self.cv:
+                self.sent += 1
+                self._pending_drop = 0
+
+    # -- reader loop (the upgrade handler's thread) -------------------------
+
+    def run_reader(self, on_text=None):
+        """Serve the client's inbound half until it disconnects or is
+        shed: ping -> pong (through the queue — single socket writer),
+        close -> done, text -> optional callback."""
+        try:
+            while not self.closed:
+                opcode, payload = read_frame(self._rsrc)
+                if opcode == OP_CLOSE:
+                    return
+                if opcode == OP_PING:
+                    self.enqueue(encode_frame(payload, OP_PONG))
+                elif opcode == OP_TEXT and on_text is not None:
+                    on_text(payload)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            self.close()
+
+    def close(self):
+        with self.cv:
+            if self.closed:
+                return
+            self.closed = True
+            self.cv.notify_all()
+        for s in (self.wsock, self.sock):
+            try:
+                # shutdown wakes a reader blocked in recv on another
+                # thread (a bare close leaves the syscall pending)
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -1,0 +1,19 @@
+"""fdgui v2: the operator dashboard over the shm observability plane.
+
+One subsystem, two delivery modes (ref: src/disco/gui/fd_gui.c +
+fd_gui_tile.c — the reference's bundled-frontend gui tile speaking a
+snapshot+delta WebSocket protocol over the shared waltz/http server):
+
+  * the `gui` tile (disco/tiles.py GuiAdapter) serves the live
+    dashboard: HTTP page + `ws://.../ws` snapshot+delta stream over
+    the shared TileHttpServer/WsConn plumbing (disco/httpd.py +
+    disco/ws.py), read-side only over shm;
+  * `tools/fdgui` / `python -m firedancer_tpu.gui` renders the same
+    dashboard headlessly as one self-contained HTML artifact — from
+    live OR post-mortem shm, and from BENCH_r*.json rounds alone.
+"""
+from .page import PAGE, REPORT_MARKER, page_html   # noqa: F401
+from .report import (bench_series, collect, render_html,  # noqa: F401
+                     report_from_bench, report_from_shm)
+from .schema import (GUI_DEFAULTS, DeltaSource,    # noqa: F401
+                     cfg_digest, normalize_gui, snapshot_doc)

@@ -1,0 +1,95 @@
+"""fdgui CLI: attach to a topology's dashboard, or render the report.
+
+    tools/fdgui <topology>                       # print the live URL
+    tools/fdgui <topology> --report out.html     # static artifact
+        [--bench 'BENCH_r*.json']                #  + trend charts
+    tools/fdgui --bench 'BENCH_r*.json' --report out.html
+                                                 # bench-only report
+
+Attaches via the plan JSON the runner drops in /dev/shm (the monitor
+CLI's discipline), so the report works POST-MORTEM: the workspace
+outlives the tiles, and a crashed run's final counters, SLO breach
+history and folded stacks all land in the artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdgui",
+        description="fdgui: live dashboard URL or static HTML report "
+                    "over a topology's shm (live or post-mortem)")
+    ap.add_argument("topology", nargs="?",
+                    help="topology name (omit for a bench-only report)")
+    ap.add_argument("--report", metavar="OUT.html",
+                    help="write the self-contained HTML artifact")
+    ap.add_argument("--bench", metavar="GLOB",
+                    help="BENCH_r*.json glob for the trend charts")
+    args = ap.parse_args(argv)
+
+    if args.topology is None:
+        if not (args.report and args.bench):
+            ap.error("without a topology, --report and --bench are "
+                     "both required (bench-only report)")
+        from .report import report_from_bench
+        paths = sorted(glob.glob(args.bench))
+        if not paths:
+            print(f"fdgui: no files match {args.bench!r}",
+                  file=sys.stderr)
+            return 1
+        out = report_from_bench(paths, args.report)
+        print(f"fdgui: wrote {out} ({len(paths)} bench rounds)")
+        return 0
+
+    if args.report:
+        from .report import report_from_shm
+        try:
+            out = report_from_shm(args.topology, args.report,
+                                  bench_glob=args.bench)
+        except FileNotFoundError:
+            print(f"fdgui: no plan for topology {args.topology!r} "
+                  f"(is it running, or was its shm unlinked?)",
+                  file=sys.stderr)
+            return 1
+        print(f"fdgui: wrote {out}")
+        return 0
+
+    # no --report: find the live gui tile and print its URL
+    from ..disco.monitor import attach
+    from ..disco.topo import read_metrics
+    try:
+        plan, wksp = attach(args.topology)
+    except FileNotFoundError:
+        print(f"fdgui: no plan for topology {args.topology!r}",
+              file=sys.stderr)
+        return 1
+    try:
+        for tn, spec in plan["tiles"].items():
+            if spec["kind"] != "gui":
+                continue
+            names = spec.get("metrics_names", [])
+            if "port" not in names:
+                continue
+            vals = read_metrics(wksp, plan, tn)
+            port = int(vals[names.index("port")])
+            if port:
+                addr = spec.get("args", {}).get("bind_addr",
+                                                "127.0.0.1")
+                if addr in ("0.0.0.0", "::"):   # wildcard: loopback
+                    addr = "127.0.0.1"          # is always reachable
+                print(f"http://{addr}:{port}/   (tile {tn!r})")
+                return 0
+        print(f"fdgui: topology {args.topology!r} has no gui tile "
+              f"with a bound port (add [[tile]] kind='gui', or use "
+              f"--report for a headless artifact)", file=sys.stderr)
+        return 1
+    finally:
+        wksp.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

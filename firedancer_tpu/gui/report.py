@@ -1,0 +1,142 @@
+"""fdgui headless mode: render the dashboard as ONE static HTML file.
+
+The live dashboard answers "what is saturating right now"; CI and
+post-mortems need the same answer as a durable artifact. This module
+collects the exact documents the WebSocket would have streamed
+(snapshot + deltas from gui/schema.py, flamegraph data from fdprof,
+bench trends from BENCH_r*.json) and injects them into the frontend
+page at its REPORT_MARKER — the result is self-contained (inline JS,
+inline data, no server, no assets) and renders from `file://`.
+
+Works from LIVE shm or POST-MORTEM shm alike: the workspace and the
+plan JSON outlive the tiles (the fdtrace stance), so
+`tools/fdgui <topo> --report out.html` after a crash still shows the
+final counters, occupancies, SLO breach history and folded stacks.
+Bench-only reports (no shm at all) render the trend page from the
+BENCH jsons alone — the artifact bench.py drops next to each round
+when FDTPU_BENCH_REPORT is set.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+from .page import PAGE, REPORT_MARKER
+
+
+def bench_series(paths) -> list[dict]:
+    """BENCH_r*.json paths -> the trend rows the frontend charts
+    (kernel vps / e2e tps / knee per round), in CALLER order — the
+    trajectory's last point must be whatever the caller put last
+    (bench.py appends the in-flight round from a tempdir whose path
+    would sort anywhere). Unreadable files are skipped — a report
+    must render from whatever rounds exist."""
+    from ..prof.bench_diff import load_bench
+    rows = []
+    for p in paths:
+        try:
+            rec = load_bench(p)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+
+        def _num(key, rec=rec):
+            v = rec.get(key)
+            if v is None and key.startswith("e2e"):
+                v = rec.get("witnessed_tpu", {}).get(key)
+            try:
+                return float(v) if v is not None else None
+            except (TypeError, ValueError):
+                return None
+        rows.append({
+            "file": os.path.basename(p),
+            "value": _num("value"),
+            "e2e_tps": _num("e2e_tps"),
+            "e2e_knee_tps": _num("e2e_knee_tps"),
+            "platform": rec.get("platform"),
+        })
+    return rows
+
+
+def _gui_tile_args(plan: dict) -> dict:
+    """The (normalized) args of the plan's gui tile, defaults when the
+    topology has none — the report's TPS source must match what the
+    live dashboard was configured to show."""
+    from .schema import GUI_DEFAULTS, normalize_gui
+    for spec in plan["tiles"].values():
+        if spec["kind"] == "gui":
+            try:
+                return normalize_gui(spec.get("args", {}))
+            except ValueError:
+                break     # older/foreign plan: fall back to defaults
+    return dict(GUI_DEFAULTS)
+
+
+def collect(plan: dict, wksp, deltas: int = 2,
+            interval_s: float = 0.25) -> dict:
+    """Snapshot + `deltas` protocol deltas + flamegraph data from one
+    attached workspace. Two deltas spaced `interval_s` apart give the
+    occupancy/rate fields a real interval even on a live topology; on
+    a halted one the second delta simply repeats the final counters."""
+    from ..prof.export import read_folded
+    from .schema import DeltaSource, snapshot_doc
+    ga = _gui_tile_args(plan)
+    src = DeltaSource(plan, wksp, tps_tile=ga["tps_tile"],
+                      tps_metric=ga["tps_metric"])
+    docs = []
+    for i in range(max(1, int(deltas))):
+        if i:
+            time.sleep(interval_s)
+        docs.append(src.delta())
+    try:
+        flame = read_folded(plan, wksp)
+    except Exception:   # noqa: BLE001 — a torn prof region loses the
+        flame = {}      # flame tab, never the whole artifact
+    return {"snapshot": snapshot_doc(plan), "deltas": docs,
+            "flame": flame}
+
+
+def render_html(data: dict) -> str:
+    """Inject the collected data into the frontend page. `</script>`
+    inside JSON strings is escaped so embedded stacks/exprs can never
+    terminate the injected script block."""
+    blob = json.dumps(data).replace("</", "<\\/")
+    return PAGE.replace(
+        REPORT_MARKER,
+        f"<script>window.FDGUI_DATA={blob}</script>")
+
+
+def report_from_shm(topology: str, out_path: str,
+                    bench_glob: str | None = None) -> str:
+    """Attach by topology name (live or post-mortem shm) and write the
+    artifact; returns the output path."""
+    from ..disco.monitor import attach
+    plan, wksp = attach(topology)
+    try:
+        data = collect(plan, wksp)
+    finally:
+        wksp.close()
+    data["bench"] = bench_series(sorted(glob.glob(bench_glob))) \
+        if bench_glob else []
+    with open(out_path, "w") as f:
+        f.write(render_html(data))
+    return out_path
+
+
+def report_from_bench(paths, out_path: str) -> str:
+    """Bench-only artifact: no shm, just the trend page (the shape
+    bench.py emits per round under FDTPU_BENCH_REPORT)."""
+    data = {
+        "snapshot": {"type": "snapshot", "v": 2,
+                     "topology": "bench trends", "cfg_digest": "-",
+                     "tiles": {}, "links": {},
+                     "slo": {"targets": []}},
+        "deltas": [], "flame": {},
+        "bench": bench_series(paths),
+    }
+    with open(out_path, "w") as f:
+        f.write(render_html(data))
+    return out_path

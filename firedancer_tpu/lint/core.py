@@ -94,6 +94,12 @@ RULES: dict[str, tuple[str, str, str]] = {
         "schema (unknown key, non-power-of-two slots/ring, hz out of "
         "range) or prof.tiles / prof.breach_capture names an "
         "undeclared tile"),
+    "bad-gui": (
+        "graph", "error",
+        "[tile.gui] args rejected by the gui schema (unknown key, "
+        "out-of-range ws_max_clients/ws_queue/ws_sndbuf, empty "
+        "tps/bench/report strings) — the fdgui v2 knob set, "
+        "gui/schema.py normalize_gui"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

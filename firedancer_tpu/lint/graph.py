@@ -235,6 +235,25 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_trace(model, path, lines))
     out.extend(_check_slo(model, kinds, path, lines))
     out.extend(_check_prof(model, path, lines))
+    out.extend(_check_gui(model, lines))
+    return out
+
+
+def _check_gui(model, lines) -> list[Finding]:
+    """[tile.gui] args: the fdgui schema gate (gui/schema.py is the
+    one validator, same as topo.build) — ws queue/client bounds, knob
+    types, unknown keys with a did-you-mean. The tps_tile/tps_metric
+    REFERENCES stay under dangling-ref (_check_arg_refs), like every
+    other registry-typed arg."""
+    out: list[Finding] = []
+    for tn, t in model["tiles"].items():
+        if t["kind"] != "gui":
+            continue
+        from ..gui import normalize_gui
+        try:
+            normalize_gui(t["args"])
+        except Exception as e:
+            _emit(out, lines, "bad-gui", tn, f"tile {tn!r}: {e}")
     return out
 
 

@@ -108,7 +108,12 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
     "netlnk": {},
     "vinyl": {"path": None, "gc": None},
     "gui": {"port": None, "bind_addr": None, "tps_tile": TILE,
-            "tps_metric": None},                # validated against TILE's kind
+            "tps_metric": None,                 # validated against TILE's kind
+            # fdgui v2 knobs (gui/schema.py GUI_DEFAULTS is the
+            # authoritative mirror — tests/test_gui.py keeps it honest)
+            "ws_max_clients": None, "ws_queue": None,
+            "ws_sndbuf": None, "bench_glob": None,
+            "report_on_halt": None},
     "cswtch": {},
     "ipecho": {"shred_version": None, "port": None, "bind_addr": None},
     "pcap": {"path": None, "realtime": None, "loop": None},

@@ -118,9 +118,31 @@ def gate_regressions(diff: dict, threshold: float = 0.05) -> list[dict]:
     return out
 
 
+def report_path_for(bench_path: str,
+                    dir_fallback: bool = True) -> str | None:
+    """The fdgui report artifact that belongs to a BENCH json, if one
+    exists: `<base>.report.html` next to it, else (when dir_fallback)
+    the directory's `report.html` — what bench.py writes under
+    FDTPU_BENCH_REPORT. Callers comparing two rounds in the SAME
+    directory must disable the fallback: one shared report.html holds
+    only the latest run and would be misattributed to both rounds."""
+    import os
+    cands = [os.path.splitext(bench_path)[0] + ".report.html"]
+    if dir_fallback:
+        cands.append(os.path.join(
+            os.path.dirname(bench_path) or ".", "report.html"))
+    for cand in cands:
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
 def render_text(diff: dict, regressions: list[dict],
-                threshold: float) -> str:
+                threshold: float, reports=None) -> str:
     lines = ["fdbench diff", "============"]
+    for label, path in (reports or ()):
+        if path:
+            lines.append(f"report ({label}): {path}")
     for key, rec in diff["metrics"].items():
         ov, nv = rec["old"], rec["new"]
         if ov is None and nv is None:
@@ -177,12 +199,24 @@ def main(argv=None) -> int:
     new = load_bench(args.new)
     d = diff_bench(old, new)
     regs = gate_regressions(d, threshold=args.threshold)
+    # per-directory report.html is only attributable when the two
+    # rounds live in different directories (per-round CI archives);
+    # same-dir rounds share one file that holds only the latest run
+    import os as _os
+    fb = _os.path.dirname(_os.path.abspath(args.old)) \
+        != _os.path.dirname(_os.path.abspath(args.new))
+    reports = (("old", report_path_for(args.old, dir_fallback=fb)),
+               ("new", report_path_for(args.new, dir_fallback=fb)))
     if args.json:
-        json.dump({"diff": d, "regressions": regs}, sys.stdout,
-                  indent=2)
+        json.dump({"diff": d, "regressions": regs,
+                   "reports": dict(reports)},
+                  sys.stdout, indent=2)
         sys.stdout.write("\n")
     else:
-        sys.stdout.write(render_text(d, regs, args.threshold))
+        # link each round's fdgui report artifact when one exists —
+        # the diff names what moved, the reports show where
+        sys.stdout.write(render_text(d, regs, args.threshold,
+                                     reports=reports))
     return 1 if (args.gate and regs) else 0
 
 

@@ -3,7 +3,10 @@
 The reference serves Solana's websocket subscription API next to the
 HTTP one (ref: src/discof/rpc/ — slot/account notifications out of
 replay state; the ws framing rides src/waltz/http/fd_http_server.h's
-upgrade path). This is a dependency-free RFC 6455 subset server:
+upgrade path). This is a dependency-free RFC 6455 subset server over
+the SHARED framing layer in disco/ws.py (the same plumbing that backs
+the gui tile's streaming routes — one waltz/http-style implementation
+underneath gui, metric, and rpc):
 
   * GET + Upgrade handshake (Sec-WebSocket-Accept per §4.2.2)
   * text frames in/out, masked client frames, ping/pong, close
@@ -19,71 +22,14 @@ result}} shape.
 """
 from __future__ import annotations
 
-import base64
-import hashlib
 import json
 import socket
-import struct
 import threading
 
-WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
-
-
-def _accept_key(key: str) -> str:
-    return base64.b64encode(
-        hashlib.sha1(key.encode() + WS_GUID).digest()).decode()
-
-
-def _encode_frame(payload: bytes, opcode: int = 0x1) -> bytes:
-    hdr = bytes([0x80 | opcode])
-    n = len(payload)
-    if n < 126:
-        hdr += bytes([n])
-    elif n < 1 << 16:
-        hdr += bytes([126]) + struct.pack(">H", n)
-    else:
-        hdr += bytes([127]) + struct.pack(">Q", n)
-    return hdr + payload
-
-
-def _read_exact(sock, n: int) -> bytes:
-    """select-based blocking read: the send side's timeout flips the
-    SHARED file description non-blocking (the wsock fd is a dup), so
-    the reader waits on select and retries EAGAIN."""
-    import select
-    out = b""
-    while len(out) < n:
-        select.select([sock], [], [])
-        try:
-            chunk = sock.recv(n - len(out))
-        except (BlockingIOError, InterruptedError):
-            continue
-        except socket.timeout:
-            continue
-        if not chunk:
-            raise ConnectionError("peer closed")
-        out += chunk
-    return out
-
-
-def _read_frame(sock):
-    """-> (opcode, payload); unmasks client frames (required §5.1)."""
-    b0, b1 = _read_exact(sock, 2)
-    opcode = b0 & 0x0F
-    masked = bool(b1 & 0x80)
-    n = b1 & 0x7F
-    if n == 126:
-        n, = struct.unpack(">H", _read_exact(sock, 2))
-    elif n == 127:
-        n, = struct.unpack(">Q", _read_exact(sock, 8))
-    if n > 1 << 20:
-        raise ConnectionError("frame too large")
-    mask = _read_exact(sock, 4) if masked else b"\x00" * 4
-    payload = bytearray(_read_exact(sock, n))
-    if masked:
-        for i in range(len(payload)):
-            payload[i] ^= mask[i & 3]
-    return opcode, bytes(payload)
+from ..disco.ws import (WS_GUID, accept_key as _accept_key,  # noqa: F401
+                        encode_frame as _encode_frame,
+                        read_exact as _read_exact,
+                        read_frame as _read_frame)
 
 
 class _Client:

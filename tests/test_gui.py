@@ -1,0 +1,498 @@
+"""fdgui v2 tests: shared ws plumbing, snapshot+delta protocol, slow-
+client shedding, the live chaos acceptance drill, and the headless
+report artifact (ref: src/disco/gui/fd_gui.c + fd_gui_tile.c protocol
+shape, book/api/websocket.md; served by the shared waltz/http-style
+plumbing in disco/httpd.py + disco/ws.py)."""
+import base64
+import glob
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from firedancer_tpu.disco import Topology, TopologyRunner
+from firedancer_tpu.disco.httpd import TileHttpServer
+from firedancer_tpu.disco.ws import (OP_PING, OP_PONG, WsConn,
+                                     encode_frame, read_frame)
+
+gui = pytest.mark.gui
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# raw RFC 6455 test client (masked frames, blocking reads)
+# ---------------------------------------------------------------------------
+
+class WsTestClient:
+    def __init__(self, port, path="/ws", rcvbuf=0, timeout=30,
+                 origin=None):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=timeout)
+        if rcvbuf:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                 rcvbuf)
+        key = base64.b64encode(os.urandom(16)).decode()
+        extra = f"Origin: {origin}\r\n" if origin else ""
+        self.sock.sendall((
+            f"GET {path} HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            f"{extra}Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = self.sock.recv(4096)
+            assert chunk, f"server closed during handshake: {resp!r}"
+            resp += chunk
+        self.status = resp.split(b"\r\n")[0]
+        if b"101" in self.status:
+            want = base64.b64encode(hashlib.sha1(
+                key.encode()
+                + b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11").digest())
+            assert want in resp       # accept key verified (§4.2.2)
+
+    def send_frame(self, payload: bytes, opcode=0x1):
+        mask = os.urandom(4)
+        masked = bytes(b ^ mask[i & 3] for i, b in enumerate(payload))
+        hdr = bytes([0x80 | opcode])
+        n = len(payload)
+        assert n < 126
+        self.sock.sendall(hdr + bytes([0x80 | n]) + mask + masked)
+
+    def _exact(self, n):
+        out = b""
+        while len(out) < n:
+            c = self.sock.recv(n - len(out))
+            assert c, "peer closed"
+            out += c
+        return out
+
+    def recv_frame(self):
+        b0 = self._exact(2)
+        op = b0[0] & 0x0F
+        n = b0[1] & 0x7F
+        if n == 126:
+            n, = struct.unpack(">H", self._exact(2))
+        elif n == 127:
+            n, = struct.unpack(">Q", self._exact(8))
+        return op, self._exact(n)
+
+    def recv_json(self):
+        op, payload = self.recv_frame()
+        assert op == 0x1
+        return json.loads(payload)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# framing + handshake + queue policy units
+# ---------------------------------------------------------------------------
+
+@gui
+def test_frame_codec_roundtrip_all_length_classes():
+    """encode_frame/read_frame round-trip through the 7-bit, 16-bit
+    and 64-bit length encodings, and masked client frames unmask."""
+    a, b = socket.socketpair()
+    try:
+        for n in (0, 1, 125, 126, 1000, 1 << 16):
+            payload = bytes(i & 0xFF for i in range(n))
+            a.sendall(encode_frame(payload))
+            op, got = read_frame(b)
+            assert op == 0x1 and got == payload
+        # masked client frame (the §5.1 requirement)
+        mask = b"\x01\x02\x03\x04"
+        payload = b"masked-hello"
+        masked = bytes(c ^ mask[i & 3] for i, c in enumerate(payload))
+        a.sendall(bytes([0x81, 0x80 | len(payload)]) + mask + masked)
+        op, got = read_frame(b)
+        assert got == payload
+    finally:
+        a.close()
+        b.close()
+
+
+@gui
+def test_ws_upgrade_handshake_ping_and_client_limit():
+    """TileHttpServer streaming routes: 101 upgrade with the computed
+    accept key, on_connect document delivery, ping->pong, plain-GET
+    routes still served, and the ws_max_clients 503 refusal."""
+    srv = TileHttpServer(
+        {"/x": lambda: (200, "text/plain", b"ok")},
+        ws_routes={"/ws": lambda conn: conn.send_json({"hello": 1})},
+        ws_max_clients=1, ws_queue=8)
+    try:
+        c1 = WsTestClient(srv.port)
+        assert b"101" in c1.status
+        assert c1.recv_json() == {"hello": 1}
+        c1.send_frame(b"ka", opcode=OP_PING)
+        op, payload = c1.recv_frame()
+        assert op == OP_PONG and payload == b"ka"
+        # plain HTTP still served next to the ws route
+        import urllib.request
+        assert urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/x", timeout=10).read() \
+            == b"ok"
+        # second concurrent client: refused with 503, not queued
+        c2 = WsTestClient(srv.port)
+        assert b"503" in c2.status
+        c2.close()
+        assert srv.ws_stats()["clients"] == 1
+        c1.close()
+        deadline = time.time() + 10
+        while time.time() < deadline and srv.ws_stats()["clients"]:
+            time.sleep(0.02)
+        assert srv.ws_stats()["clients"] == 0
+        # cross-origin browser pages are refused (WebSocket is exempt
+        # from same-origin policy — without this, any website could
+        # stream the operator dashboard off an operator's loopback);
+        # loopback origins and non-browser clients (no Origin) pass
+        c3 = WsTestClient(srv.port, origin="http://evil.example")
+        assert b"403" in c3.status
+        c3.close()
+        c4 = WsTestClient(srv.port, origin="http://localhost:9999")
+        assert b"101" in c4.status
+        assert c4.recv_json() == {"hello": 1}
+        c4.close()
+    finally:
+        srv.close()
+
+
+@gui
+def test_ws_queue_drop_oldest_then_shed_never_blocks():
+    """The graceful-degradation contract: a stalled reader first costs
+    itself dropped frames (drop-oldest past the high-water mark), then
+    gets force-closed (shed) — and the enqueue side NEVER blocks, so
+    the serving tile's housekeeping cadence is structurally immune."""
+    a, b = socket.socketpair()
+    try:
+        conn = WsConn(a, hwm=4, sndbuf=4096)
+        frame = encode_frame(b"x" * 2048)
+        worst = 0.0
+        for _ in range(200):
+            t0 = time.perf_counter()
+            conn.enqueue(frame)
+            worst = max(worst, time.perf_counter() - t0)
+            if conn.shed:
+                break
+            time.sleep(0.001)
+        assert conn.shed, "stalled reader was never shed"
+        assert conn.dropped > 4
+        assert conn.closed
+        # the bound that matters: no enqueue ever waited on the peer
+        assert worst < 0.2, f"enqueue blocked for {worst:.3f}s"
+    finally:
+        a.close()
+        b.close()
+
+
+@gui
+def test_ws_healthy_client_gets_everything_in_order():
+    a, b = socket.socketpair()
+    drain = []
+    import threading
+    def reader():
+        try:
+            while len(drain) < 50:
+                op, payload = read_frame(b)
+                drain.append(json.loads(payload))
+        except (ConnectionError, OSError):
+            pass
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        conn = WsConn(a, hwm=64)
+        for i in range(50):
+            assert conn.send_json({"i": i})
+        t.join(10)
+        assert [d["i"] for d in drain] == list(range(50))
+        assert conn.dropped == 0 and not conn.shed
+        conn.close()
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# arg schema: the [trace]/[prof]-style three-layer contract
+# ---------------------------------------------------------------------------
+
+@gui
+def test_gui_args_schema_and_registry_mirror():
+    from firedancer_tpu.gui import GUI_DEFAULTS, normalize_gui
+    from firedancer_tpu.lint.registry import TILE_ARGS
+    # the lint/config registry mirrors the schema exactly
+    assert set(TILE_ARGS["gui"]) == set(GUI_DEFAULTS)
+    out = normalize_gui(None)
+    assert out == GUI_DEFAULTS
+    # common/structural keys pass through untouched
+    normalize_gui({"supervise": {"policy": "restart"}, "ws_queue": 8})
+    with pytest.raises(ValueError, match="did you mean 'ws_queue'"):
+        normalize_gui({"ws_quee": 8})
+    with pytest.raises(ValueError, match="ws_max_clients"):
+        normalize_gui({"ws_max_clients": 0})
+    with pytest.raises(ValueError, match="ws_queue"):
+        normalize_gui({"ws_queue": 1})
+    with pytest.raises(ValueError, match="tps_tile"):
+        normalize_gui({"tps_tile": ""})
+    # topo.build runs the same gate (fail before launch)
+    bad = (Topology(f"gbad{os.getpid()}", wksp_size=1 << 20)
+           .link("l", depth=16, mtu=64)
+           .tile("s", "synth", outs=["l"], count=1)
+           .tile("k", "sink", ins=["l"])
+           .tile("g", "gui", ws_queue=0))
+    with pytest.raises(ValueError, match="ws_queue"):
+        bad.build()
+
+
+# ---------------------------------------------------------------------------
+# snapshot + delta protocol schema (in-process, no tile processes)
+# ---------------------------------------------------------------------------
+
+@gui
+def test_snapshot_delta_schema_roundtrip():
+    from firedancer_tpu.gui import (DeltaSource, cfg_digest,
+                                    snapshot_doc)
+    from firedancer_tpu.runtime import Workspace
+    topo = (
+        Topology(f"gs{os.getpid()}", wksp_size=1 << 21,
+                 slo={"target": [{"name": "bp",
+                                  "expr": "link.a_b.backpressure "
+                                          "rate < 5/s"}]})
+        .link("a_b", depth=32, mtu=256)
+        .tile("a", "synth", outs=["a_b"], count=64, unique=8)
+        .tile("b", "sink", ins=["a_b"])
+        .tile("metric", "metric", port=0)
+        .tile("gui", "gui", port=0)
+    )
+    plan = topo.build()
+    wksp = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                     create=False)
+    try:
+        snap = json.loads(json.dumps(snapshot_doc(plan)))
+        assert snap["type"] == "snapshot" and snap["v"] == 2
+        assert snap["cfg_digest"] == cfg_digest(plan)
+        assert set(snap["tiles"]) == {"a", "b", "metric", "gui"}
+        assert snap["tiles"]["b"]["ins"] == ["a_b"]
+        assert snap["links"]["a_b"]["producer"] == "a"
+        assert snap["links"]["a_b"]["consumers"] == ["b"]
+        assert snap["links"]["a_b"]["depth"] == 32
+        assert [t["name"] for t in snap["slo"]["targets"]] == ["bp"]
+        src = DeltaSource(plan, wksp, tps_tile="b", tps_metric="rx")
+        d = json.loads(json.dumps(src.delta()))
+        assert d["type"] == "delta" and d["ts"] > 0
+        assert set(d["tiles"]) == set(snap["tiles"])
+        row = d["tiles"]["b"]
+        for key in ("state", "hb_age_ticks", "metrics", "latency",
+                    "occupancy"):
+            assert key in row, row
+        assert 0.0 <= row["occupancy"]["work"] <= 1.0
+        assert "sup_restarts" in row["metrics"]   # supervisor counters
+        assert set(d["links"]) == {"a_b"}
+        for key in ("pub", "backpressure", "consumers"):
+            assert key in d["links"]["a_b"]
+        assert set(d["slo"]) >= {"breach", "breaches", "events"}
+        # second delta: interval occupancy still in range
+        d2 = src.delta()
+        assert 0.0 <= d2["tiles"]["a"]["occupancy"]["work"] <= 1.0
+    finally:
+        wksp.close()
+        Workspace.unlink_name(plan["wksp"]["name"])
+        path = f"/dev/shm/fdtpu_{plan['topology']}.plan.json"
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+# live acceptance: chaos stall -> backpressure delta + SLO breach seen
+# by a ws client; slow second client shed; cadence unperturbed;
+# post-mortem report from the halted topology's shm
+# ---------------------------------------------------------------------------
+
+@gui
+@pytest.mark.chaos
+def test_live_chaos_ws_stream_shed_and_postmortem_report(tmp_path):
+    topo = (
+        Topology(f"gl{os.getpid()}", wksp_size=1 << 22,
+                 trace={"enable": True, "depth": 512, "sample": 1,
+                        "tiles": ["metric"]},
+                 slo={"fast_window_s": 0.5, "slow_window_s": 10.0,
+                      "target": [{
+                          "name": "sink-bp",
+                          "expr": "link.a_b.backpressure rate < 5/s"}]})
+        .link("a_b", depth=32, mtu=256)
+        .tile("a", "synth", outs=["a_b"], count=5_000_000, unique=16,
+              burst=8)
+        .tile("b", "sink", ins=["a_b"],
+              chaos={"events": [{"action": "stall_fseq", "at_rx": 8}]})
+        .tile("metric", "metric", port=0)
+        .tile("gui", "gui", port=0, tps_tile="b", tps_metric="rx",
+              ws_queue=8, ws_sndbuf=4096)
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=540)
+        deadline = time.time() + 30
+        port = 0
+        while time.time() < deadline and not port:
+            runner.check_failures()
+            port = int(runner.metrics("gui").get("port", 0))
+            time.sleep(0.05)
+        assert port
+        # client A: healthy reader — snapshot first, then deltas
+        ca = WsTestClient(port)
+        snap = ca.recv_json()
+        assert snap["type"] == "snapshot"
+        assert snap["links"]["a_b"]["producer"] == "a"
+        # client B: completes the handshake, then never reads again
+        cb = WsTestClient(port, rcvbuf=4096)
+        assert b"101" in cb.status
+        saw_bp = saw_breach = False
+        gaps = []
+        last = time.time()
+        deadline = time.time() + 60
+        while time.time() < deadline and not (saw_bp and saw_breach):
+            runner.check_failures()
+            d = ca.recv_json()
+            now = time.time()
+            gaps.append(now - last)
+            last = now
+            assert d["type"] == "delta"
+            if d["links"].get("a_b", {}).get("backpressure", 0) > 0:
+                saw_bp = True
+            slo = d.get("slo", {})
+            if slo.get("breach", 0) >= 1 or any(
+                    e.get("target") == "sink-bp"
+                    for e in slo.get("events", [])):
+                saw_breach = True
+        assert saw_bp, "client never observed the backpressure delta"
+        assert saw_breach, "client never observed the SLO breach"
+        # the stalled client got shed; the healthy stream (above) kept
+        # flowing the whole time — bounded overhead: the gui tile's
+        # delta cadence never gapped anywhere near the slow client's
+        # stall, and its heartbeat stayed fresh
+        deadline = time.time() + 60
+        shed = 0
+        while time.time() < deadline and not shed:
+            runner.check_failures()
+            shed = runner.metrics("gui").get("ws_shed", 0)
+            try:
+                ca.recv_json()       # keep draining A
+            except AssertionError:
+                pass
+            time.sleep(0.01)
+        assert shed >= 1, "stalled client was never shed"
+        assert max(gaps) < 5.0, f"delta stream stalled: {max(gaps):.1f}s"
+        assert runner.heartbeats()["gui"] < int(5e9)
+        # breach dump hygiene (written by the slo engine during the run)
+        from firedancer_tpu.disco.slo import slo_dump_path
+        dump = slo_dump_path(runner.plan["topology"], "sink-bp")
+        ca.close()
+        cb.close()
+        # halt the topology, keep the shm: the report must render
+        # POST-MORTEM from the workspace + plan alone
+        runner.halt(join_timeout_s=10)
+        from firedancer_tpu.gui.cli import main as gui_main
+        out = tmp_path / "postmortem.html"
+        rc = gui_main([runner.plan["topology"], "--report", str(out)])
+        assert rc == 0
+        html = out.read_text()
+        assert "window.FDGUI_DATA" in html
+        data = json.loads(
+            html.split("window.FDGUI_DATA=", 1)[1]
+            .split("</script>", 1)[0].replace("<\\/", "</"))
+        assert data["snapshot"]["topology"] == runner.plan["topology"]
+        final = data["deltas"][-1]
+        assert final["links"]["a_b"]["backpressure"] > 0
+        assert final["tiles"]["b"]["state"] in ("halt", "FAIL")
+        if os.path.exists(dump):
+            os.unlink(dump)          # test hygiene (/dev/shm)
+    finally:
+        runner.halt(join_timeout_s=10)
+        runner.close()
+
+
+# ---------------------------------------------------------------------------
+# bench-trend report (the FDTPU_BENCH_REPORT artifact)
+# ---------------------------------------------------------------------------
+
+@gui
+def test_report_from_bench_jsons(tmp_path):
+    from firedancer_tpu.gui.report import bench_series, \
+        report_from_bench
+    paths = sorted(glob.glob(os.path.join(HERE, "BENCH_r0*.json")))
+    assert len(paths) >= 2, "repo bench rounds missing"
+    rows = bench_series(paths)
+    assert len(rows) == len(paths)
+    # early rounds may predate the record format — the chart renders
+    # whatever rounds carry numbers, it never refuses the report
+    assert sum(r["value"] is not None for r in rows) >= 2
+    assert any(r["e2e_tps"] is not None for r in rows)
+    out = tmp_path / "bench.html"
+    report_from_bench(paths, str(out))
+    html = out.read_text()
+    assert "window.FDGUI_DATA" in html and "bench trends" in html
+    data = json.loads(
+        html.split("window.FDGUI_DATA=", 1)[1]
+        .split("</script>", 1)[0].replace("<\\/", "</"))
+    assert [r["file"] for r in data["bench"]] \
+        == [os.path.basename(p) for p in paths]
+
+
+@gui
+def test_bench_py_emits_report_when_env_set(tmp_path, monkeypatch):
+    """FDTPU_BENCH_REPORT wiring: bench.py's report hook writes the
+    artifact next to the BENCH json with THIS round appended to the
+    trajectory, and annotates the result record with its path."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(HERE, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    out = tmp_path / "round.report.html"
+    result = {"metric": "ed25519_verifies_per_sec", "value": 123456.0,
+              "unit": "verifies/s/chip", "e2e_tps": 9999.0}
+    monkeypatch.setenv("FDTPU_BENCH_REPORT", str(out))
+    bench._emit_report(result)
+    assert result.get("report") == str(out), result
+    html = out.read_text()
+    data = json.loads(
+        html.split("window.FDGUI_DATA=", 1)[1]
+        .split("</script>", 1)[0].replace("<\\/", "</"))
+    # the current round rides at the end of the trajectory
+    assert data["bench"][-1]["value"] == 123456.0
+    assert data["bench"][-1]["e2e_tps"] == 9999.0
+    # unset -> no-op
+    monkeypatch.delenv("FDTPU_BENCH_REPORT")
+    clean: dict = {}
+    bench._emit_report(clean)
+    assert clean == {}
+
+
+@gui
+def test_bench_only_cli_and_fdbench_report_links(tmp_path, capsys):
+    from firedancer_tpu.gui.cli import main as gui_main
+    out = tmp_path / "trend.html"
+    rc = gui_main(["--bench", os.path.join(HERE, "BENCH_r0*.json"),
+                   "--report", str(out)])
+    assert rc == 0 and out.exists()
+    capsys.readouterr()
+    # fdbench names each round's report artifact when one exists
+    import shutil
+
+    from firedancer_tpu.prof.bench_diff import main as fdbench_main
+    old = tmp_path / "BENCH_old.json"
+    new = tmp_path / "BENCH_new.json"
+    shutil.copy(os.path.join(HERE, "BENCH_r04.json"), old)
+    shutil.copy(os.path.join(HERE, "BENCH_r05.json"), new)
+    (tmp_path / "BENCH_old.report.html").write_text("x")
+    rc = fdbench_main([str(old), str(new)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "report (old):" in text and "BENCH_old.report.html" in text

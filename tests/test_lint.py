@@ -212,6 +212,26 @@ def test_dangling_ref():
     fires_once(lint_config(cfg, "<fixture>"), "dangling-ref")
 
 
+def test_bad_gui_schema_and_did_you_mean():
+    # out-of-range ws bound (gui/schema.py normalize_gui, the same
+    # validator topo.build runs)
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"]},
+        {"name": "g", "kind": "gui", "ws_queue": 1}])
+    fires_once(lint_config(cfg, "<fixture>"), "bad-gui")
+    # unknown key with a did-you-mean (programmatic Topology builds
+    # skip app/config.py's registry gate — the linter still catches it)
+    cfg = _cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"]},
+        {"name": "g", "kind": "gui", "ws_quee": 8}])
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-gui")
+    assert any("did you mean 'ws_queue'" in f.message
+               for f in findings if f.rule == "bad-gui")
+
+
 def test_bad_trace_unknown_key():
     cfg = _cfg(trace={"enable": True, "dept": 64})
     findings = lint_config(cfg, "<fixture>")
