@@ -392,6 +392,266 @@ def _e2e_bench():
     sys.stdout.flush()
 
 
+def _leader_topology(count, unique, batch, verify_tiles, rate_tps,
+                     tcache_depth=None):
+    """The FULL leader loop: synth -> verify(xN, rr-sharded) -> dedup
+    -> pack -> bank(svm device waves) -> poh -> shred(leader, signed
+    merkle FEC sets) -> shredsink. Tcache depths sit BELOW the unique
+    txn pool so replayed frames re-verify and re-execute instead of
+    dedup-dropping — the loop sees `count` txns of real work from a
+    pool it can afford to pre-sign at boot. Verify shards get a core
+    each (cpu0+i) when the host has cores to spare."""
+    from firedancer_tpu.disco import Topology
+    if tcache_depth is None:
+        # the wraparound trick needs a replay's tag EVICTED by the
+        # time its copy is queried. Eviction happens at insert
+        # (finalize) but queries happen at dispatch, so the effective
+        # window is depth + the verify in-flight window (~batch x
+        # (inflight+1) lanes) — and rr sharding divides the replay
+        # distance by tile_cnt. depth ~ unique/16 leaves comfortable
+        # margin for all of that; below 16 the tcache degenerates
+        tcache_depth = max(16, 1 << (max(64, int(unique)).bit_length()
+                                     - 4))
+    cpus = os.cpu_count() or 1
+    cpu0 = 1 if cpus >= verify_tiles + 6 else None
+    vd = [f"vd{i}" for i in range(verify_tiles)]
+    topo = (
+        Topology(f"ldr{os.getpid()}", wksp_size=1 << 27)
+        .link("ingest", depth=4096, mtu=1280)
+        .link("dedup_pack", depth=4096, mtu=1280)
+        .link("pack_bank0", depth=256, mtu=16384)
+        .link("bank0_done", depth=256, mtu=64)
+        .link("bank0_poh", depth=256, mtu=16448)
+        .link("poh_entries", depth=512, mtu=16640)
+        .link("poh_slots", depth=64, mtu=64)
+        .link("shreds_mirror", depth=4096, mtu=1280)
+        .link("shred_req", depth=32, mtu=1280)
+        .link("sign_resp", depth=32, mtu=128)
+        .tcache("dedup_tc", depth=tcache_depth)
+        .tile("synth", "synth", outs=["ingest"], count=count,
+              unique=unique, burst=512, seed=17, rate_tps=rate_tps)
+        .tile("dedup", "dedup", ins=vd, outs=["dedup_pack"],
+              tcache="dedup_tc", batch=1024)
+        .tile("pack", "pack",
+              ins=["dedup_pack", "bank0_done", "poh_slots"],
+              outs=["pack_bank0"], txn_in="dedup_pack",
+              bank_links=["pack_bank0"], done_links=["bank0_done"],
+              slot_in="poh_slots", max_txn_per_microblock=31,
+              wave=4, batch=256)
+        .tile("bank0", "bank", ins=["pack_bank0"],
+              outs=["bank0_done", "bank0_poh"], exec="svm", wave=8,
+              poh_link="bank0_poh", forward_payloads=True,
+              genesis_synth=unique)
+        .tile("poh", "poh", ins=["bank0_poh"],
+              outs=["poh_entries", "poh_slots"],
+              slot_link="poh_slots", hashes_per_tick=64,
+              ticks_per_slot=8)
+        .tile("shred", "shred", mode="leader",
+              ins=["poh_entries", ("sign_resp", False)],
+              outs=["shred_req", "shreds_mirror"], req="shred_req",
+              resp="sign_resp", shreds_link="shreds_mirror",
+              identity_hex="03a107bff3ce10be1d70dd18e74bc09967e4d63"
+                           "09ba50d5f1ddc8664125531b8",
+              cluster=[{"pubkey_hex": "55" * 32, "stake": 100,
+                        "addr": "127.0.0.1:9"}])
+        .tile("sign", "sign", ins=[("shred_req", False)],
+              outs=["sign_resp"],
+              seed="000102030405060708090a0b0c0d0e0f10111213141516"
+                   "1718191a1b1c1d1e1f",
+              clients=[{"role": "leader", "req": "shred_req",
+                        "resp": "sign_resp"}])
+        .tile("shredsink", "sink", ins=["shreds_mirror"]))
+    for i in range(verify_tiles):
+        topo.link(vd[i], depth=4096, mtu=1280)
+        topo.tcache(f"vtc{i}", depth=tcache_depth)
+    topo.sharded_tile(
+        "verify", "verify", verify_tiles, ins=["ingest"], outs=vd,
+        batch=batch, coalesce_us=500, cpu0=cpu0,
+        tcache=[f"vtc{i}" for i in range(verify_tiles)])
+    return topo
+
+
+_LEADER_TILES = ("synth", "dedup", "pack", "bank0", "poh", "shred",
+                 "shredsink")
+_LEADER_LINKS = ("ingest", "dedup_pack", "pack_bank0", "bank0_poh",
+                 "poh_entries", "shreds_mirror")
+
+
+def _leader_hop_snapshot(runner, verify_tiles):
+    """Cumulative per-tile work/wait sums + per-link backpressure —
+    diffed per sweep stanza to attribute the saturating hop."""
+    from firedancer_tpu.disco.metrics import (read_hists,
+                                              read_link_metrics)
+    tiles = {}
+    names = list(_LEADER_TILES) + [f"verify{i}"
+                                   for i in range(verify_tiles)]
+    for t in names:
+        h = read_hists(runner.wksp, runner.plan, t)
+        if not h:
+            continue
+        tiles[t] = (h.get("work", {}).get("sum_ns", 0),
+                    h.get("wait", {}).get("sum_ns", 0))
+    links = {ln: rec["backpressure"]
+             for ln, rec in read_link_metrics(runner.wksp,
+                                              runner.plan).items()}
+    return {"tiles": tiles, "links": links}
+
+
+def _leader_hop(prev, cur, verify_tiles):
+    """(top occupancy tile, first backpressured link) over a stanza
+    window, from two cumulative snapshots."""
+    occ = {}
+    for t, (w1, i1) in cur["tiles"].items():
+        w0, i0 = prev["tiles"].get(t, (0, 0))
+        dw, di = w1 - w0, i1 - i0
+        occ[t] = dw / (dw + di) if dw + di else 0.0
+    top = max(occ, key=occ.get) if occ else None
+    link_order = ["ingest"] + [f"vd{i}" for i in range(verify_tiles)] \
+        + [ln for ln in _LEADER_LINKS if ln != "ingest"]
+    bp = next((ln for ln in link_order
+               if cur["links"].get(ln, 0)
+               - prev["links"].get(ln, 0) > 0), None)
+    return top, bp
+
+
+def _leader_wait_drained(runner, count, verify_tiles,
+                         timeout_s=600.0):
+    """Block until every synth txn reached a TERMINAL outcome
+    (executed by the bank, or dropped at a named hop — conservation
+    accounting, so a still-chewing pipeline is never mistaken for a
+    drained one) and pack has retired every outstanding microblock."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        runner.check_failures()
+        p = runner.metrics("pack")
+        b = runner.metrics("bank0")
+        dropped = runner.metrics("dedup")["dup"] + p["parse_fail"]
+        for i in range(verify_tiles):
+            v = runner.metrics(f"verify{i}")
+            dropped += v["parse_fail"] + v["dedup_drop"] \
+                + v["verify_fail"]
+        if b["txns"] + dropped >= count \
+                and p["completions"] == p["microblocks"]:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"leader loop never drained: pack={p} bank={b}")
+
+
+def _leader_bench():
+    """Leader-loop sweep stage (r13): measure the knee of the WHOLE
+    leader loop — the number that has to survive millions of users —
+    not just synth->verify->dedup->sink.
+
+    Two boots: (1) unpaced capacity (the loop's ceiling, bank-executed
+    txns per wall second from RUN to drained); (2) ONE ramped boot for
+    every sweep point (the synth's rate_tps ramp schedule holds each
+    offered load for a fixed stanza), recording per stanza the
+    achieved rate and the saturating hop (top-occupancy tile + first
+    link showing fresh backpressure). Knee = highest offered load
+    still served at >= 90%.
+
+    Prints one JSON line with e2e_leader_tps / e2e_leader_sweep /
+    e2e_leader_knee_tps / e2e_leader_hop. The parent process must not
+    touch jax — the verify tile processes own the device."""
+    sys.path.insert(0, HERE)
+    from firedancer_tpu.disco import TopologyRunner
+    count = int(os.environ.get("FDTPU_BENCH_LEADER_COUNT", "4096"))
+    unique = int(os.environ.get("FDTPU_BENCH_LEADER_UNIQUE", "768"))
+    batch = int(os.environ.get("FDTPU_BENCH_LEADER_BATCH", "32"))
+    tiles = int(os.environ.get("FDTPU_BENCH_LEADER_TILES", "2"))
+    out = {"e2e_leader_verify_tiles": tiles}
+
+    # --- boot 1: capacity -------------------------------------------------
+    runner = TopologyRunner(
+        _leader_topology(count, unique, batch, tiles,
+                         rate_tps=0.0).build()).start()
+    try:
+        runner.wait_running(timeout_s=840)
+        t0 = time.perf_counter()
+        runner.wait_idle("synth", "tx", count, timeout_s=600)
+        _leader_wait_drained(runner, count, tiles)
+        wall = time.perf_counter() - t0
+        txns = runner.metrics("bank0")["txns"]
+        out["e2e_leader_tps"] = round(txns / wall, 1) if wall else 0.0
+        out["e2e_leader_count"] = txns
+        out["e2e_leader_wall_s"] = round(wall, 2)
+    finally:
+        runner.halt()
+        runner.close()
+
+    # --- boot 2: one ramped boot for the whole sweep ----------------------
+    fracs_env = os.environ.get("FDTPU_BENCH_LEADER_SWEEP",
+                               "0.5,0.8,1.2")
+    fracs = [float(f) for f in fracs_env.split(",") if f.strip()]
+    cap = out["e2e_leader_tps"]
+    if fracs and cap > 0:
+        dur = float(os.environ.get("FDTPU_BENCH_LEADER_STANZA_S",
+                                   "3.0"))
+        # a warmup stanza primes the pipeline (verify latency + fill)
+        # so the first MEASURED stanza isn't half cold-start; it is
+        # excluded from the sweep output
+        warmup = max(2.0, dur)
+        ramp = [[warmup, round(cap * 0.4, 1)]] \
+            + [[dur, round(cap * f, 1)] for f in fracs]
+        n_ramp = int(sum(d * r for d, r in ramp)) + 64
+        runner = TopologyRunner(
+            _leader_topology(n_ramp, unique, batch, tiles,
+                             rate_tps=ramp).build()).start()
+        sweep = []
+        try:
+            runner.wait_running(timeout_s=840)
+            # stanza clock starts when the synth's token bucket does
+            # (its first publish) — poll fast for the first frag
+            deadline = time.monotonic() + 60
+            while runner.metrics("synth")["tx"] == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            snap = _leader_hop_snapshot(runner, tiles)
+            base_txns = runner.metrics("bank0")["txns"]
+            t_next = time.monotonic()
+            for si, (d, offered) in enumerate(ramp):
+                t_next += d
+                while time.monotonic() < t_next:
+                    runner.check_failures()
+                    time.sleep(0.02)
+                cur = _leader_hop_snapshot(runner, tiles)
+                txns = runner.metrics("bank0")["txns"]
+                achieved = (txns - base_txns) / d
+                top, bp = _leader_hop(snap, cur, tiles)
+                if si > 0:              # stanza 0 is the warmup
+                    sweep.append({
+                        "offered_tps": offered,
+                        "achieved_tps": round(achieved, 1),
+                        "served_frac": round(achieved / offered, 3)
+                        if offered else 0.0,
+                        "top_occupancy_tile": top,
+                        "first_backpressured_link": bp,
+                    })
+                snap, base_txns = cur, txns
+        finally:
+            runner.halt()
+            runner.close()
+        out["e2e_leader_sweep"] = sweep
+        served = [p for p in sweep if p.get("served_frac", 0) >= 0.9]
+        knee = max((p["achieved_tps"] for p in served), default=None)
+        out["e2e_leader_knee_tps"] = round(knee, 1) \
+            if knee is not None else None
+        # the saturating hop: attribution at the first point past the
+        # knee (where the loop stopped keeping up), else at the top
+        # offered point — the "what to fix next" pointer
+        past = next((p for p in sweep
+                     if p.get("served_frac", 1.0) < 0.9), None)
+        at = past or (sweep[-1] if sweep else None)
+        if at:
+            out["e2e_leader_hop"] = {
+                "top_occupancy_tile": at["top_occupancy_tile"],
+                "first_backpressured_link":
+                    at["first_backpressured_link"],
+            }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _run_child(env_extra: dict, timeout_s: float,
                require_key: str | None = "metric"):
     """Spawn bench.py as a child with extra env; return the last JSON
@@ -417,6 +677,9 @@ def _run_child(env_extra: dict, timeout_s: float,
 def main():
     if os.environ.get("FDTPU_BENCH_E2E_CHILD") == "1":
         _e2e_bench()
+        return
+    if os.environ.get("FDTPU_BENCH_LEADER_CHILD") == "1":
+        _leader_bench()
         return
     if os.environ.get("FDTPU_BENCH_CHILD") == "1":
         _child_bench()
@@ -478,23 +741,63 @@ def main():
                     result[k] = v
         except Exception as e3:  # noqa: BLE001
             result["e2e_error"] = f"{e3!r}"[:300]
+
+    # leader-loop sweep (r13): the full pack->bank->poh->shred knee,
+    # CPU-measured by design (the leader hops are host code) — runs on
+    # every platform unless skipped. Failures annotate, never break.
+    if os.environ.get("FDTPU_BENCH_SKIP_LEADER") != "1":
+        try:
+            env = {"FDTPU_BENCH_LEADER_CHILD": "1"}
+            if result.get("platform", "").startswith("cpu"):
+                # the kernel stage already proved the device unusable:
+                # don't let every verify shard burn its warmup timeout
+                # rediscovering that
+                env["FDTPU_JAX_PLATFORM"] = "cpu"
+                env["JAX_PLATFORMS"] = "cpu"
+            ldr = _run_child(
+                env,
+                float(os.environ.get("FDTPU_BENCH_LEADER_TIMEOUT",
+                                     "1200")),
+                require_key="e2e_leader_tps")
+            for k, v in ldr.items():
+                if k.startswith("e2e_leader"):
+                    result[k] = v
+        except Exception as e4:  # noqa: BLE001
+            result["e2e_leader_error"] = f"{e4!r}"[:300]
+
     # bench-trend gate (fdbench): compare this round against the
     # previous BENCH json — kernel vps / e2e tps / knee regressions
     # beyond the threshold fail the run, and the printed diff says
-    # which hop/frames moved (tools/fdbench for the standalone CLI)
+    # which hop/frames moved (tools/fdbench for the standalone CLI).
+    # With FDTPU_BENCH_PREV unset the gate defaults to the LATEST
+    # committed BENCH_r*.json round and gates only the knee metrics
+    # (the r13 contract: the knee never goes backwards; kernel/raw-tps
+    # noise across heterogeneous rounds stays report-only).
     trend_rc = 0
     prev = os.environ.get("FDTPU_BENCH_PREV")
+    knee_only = False
+    if not prev:
+        import glob as _glob
+        rounds = sorted(_glob.glob(os.path.join(HERE, "BENCH_r*.json")))
+        rounds = [r for r in rounds if "witnessed" not in r]
+        if rounds:
+            prev = rounds[-1]
+            knee_only = True
     if prev:
         try:
             from firedancer_tpu.prof.bench_diff import (
-                diff_bench, gate_regressions, load_bench, render_text)
+                KNEE_METRICS, diff_bench, gate_regressions, load_bench,
+                render_text)
             old = load_bench(prev)
             thr = float(os.environ.get("FDTPU_BENCH_GATE_PCT", "0.05"))
             d = diff_bench(old, result)
-            regs = gate_regressions(d, threshold=thr)
+            regs = gate_regressions(
+                d, threshold=thr,
+                keys=KNEE_METRICS if knee_only else None)
             print(render_text(d, regs, thr), file=sys.stderr)
             result["bench_gate"] = {
                 "prev": prev, "threshold": thr,
+                "knee_only": knee_only,
                 "regressions": regs,
             }
             trend_rc = 1 if regs else 0

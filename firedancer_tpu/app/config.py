@@ -30,6 +30,12 @@ Schema:
     ins = ["synth_verify"]
     outs = ["verify_dedup"]
     batch = 32               # every other key = tile arg, verbatim
+    # tile_cnt = 2           # rr-sharded scale-out: expands into tiles
+    #                        # verify0/verify1 (rr_cnt/rr_idx auto-set),
+    # outs = ["vd0", "vd1"]  # one declared out link PER shard (SPMC);
+    # cpu0 = 2               # optional: pin shard i to core cpu0+i;
+    # tcache = ["tc0","tc1"] # a list-valued tcache distributes one
+    #                        # per shard (other args are shared)
 
     [tile.supervise]         # per-tile restart policy (supervise.py)
     policy = "restart"       # "fail_fast" (default) | "restart"
@@ -234,6 +240,20 @@ def build_topology(cfg: dict, name: str | None = None):
             # wins per key (validated by topo.build via supervise.py)
             args["supervise"] = _deep_merge(default_sup,
                                             args.get("supervise", {}))
-        topo.tile(t["name"], t["kind"], ins=t.get("ins", ()),
-                  outs=t.get("outs", ()), **args)
+        tile_cnt = int(args.pop("tile_cnt", 1) or 1)
+        cpu0 = args.pop("cpu0", None)
+        if tile_cnt > 1:
+            # rr-sharded scale-out (verify_tile_cnt as config): one
+            # [[tile]] stanza expands into tile_cnt round-robin shards
+            # sharing the ins, one declared out link per shard
+            topo.sharded_tile(t["name"], t["kind"], tile_cnt,
+                              ins=t.get("ins", ()),
+                              outs=t.get("outs", ()), cpu0=cpu0,
+                              **args)
+        else:
+            if cpu0 is not None:
+                # cpu0 on an unsharded tile still pins it (shard 0)
+                args["cpu_idx"] = int(cpu0)
+            topo.tile(t["name"], t["kind"], ins=t.get("ins", ()),
+                      outs=t.get("outs", ()), **args)
     return topo

@@ -62,6 +62,44 @@ def _gather_all(ctx, seqs: dict, mtus: dict, batch: int, handle,
     return total
 
 
+def publish_wave(out, fseqs, frames, cnc=None, on_stall=None) -> int:
+    """THE batched wave egress: one credit-gated publish_batch over
+    (sig, payload) rows with stop-row resume on a mid-wave stall.
+    Stalls are visible (`on_stall` per stall tick) and heartbeat; a
+    tile that leaves RUN while backpressured ABORTS the wave instead
+    of spinning forever (the verify `_wait_credits` contract — a dead
+    or halting consumer must never wedge a producer's halt path).
+    Returns rows published. Shared by pack/bank/poh/shred so the
+    stall policy lives in one place."""
+    k = len(frames)
+    if not k:
+        return 0
+    wb = np.zeros((k, max(len(f) for _, f in frames)), np.uint8)
+    sz = np.zeros(k, np.uint32)
+    ids = np.zeros(k, np.uint64)
+    for i, (sig, f) in enumerate(frames):
+        wb[i, :len(f)] = np.frombuffer(f, np.uint8)
+        sz[i] = len(f)
+        ids[i] = sig
+    start, total = 0, 0
+    while True:
+        stop, pub = out.publish_batch(
+            wb, sz, ids, np.ones(k, np.uint8), fseqs=fseqs,
+            start=start)
+        total += pub
+        start = stop
+        if start >= k:
+            return total
+        if on_stall is not None:
+            on_stall()
+        if cnc is not None:
+            cnc.heartbeat()
+            from ..runtime import CNC_RUN
+            if cnc.state != CNC_RUN:
+                return total      # halted while backpressured: abort
+        time.sleep(20e-6)
+
+
 def _synth_genesis(n: int) -> dict:
     """Fund the deterministic synth signer pool (wraps mod its size):
     the ONE genesis map both the leader bank and non-leader replay
@@ -99,7 +137,11 @@ class SynthAdapter:
     """Load generator (the reference's benchg tile,
     ref: src/app/shared_dev/commands/bench/fd_benchg_tile.c).
     args: count (total txns), seed, burst, rate_tps (0 = unpaced;
-    token-bucket pacing for bench.py's offered-load sweep)."""
+    token-bucket pacing for bench.py's offered-load sweep). rate_tps
+    may also be a RAMP SCHEDULE — a list of (duration_s, tps) stanzas
+    — so one topology boot serves a whole offered-load sweep (one
+    stanza per sweep point; past the schedule's end the last stanza's
+    rate holds, so a long tail never silently unpaces)."""
 
     METRICS = ["tx", "backpressure"]
 
@@ -110,7 +152,15 @@ class SynthAdapter:
         self.ctx = ctx
         self.count = int(args.get("count", 1024))
         self.burst = int(args.get("burst", 32))
-        self.rate_tps = float(args.get("rate_tps", 0.0))
+        rt = args.get("rate_tps", 0.0)
+        if isinstance(rt, (list, tuple)) and rt:
+            self.ramp = [(float(d), float(r)) for d, r in rt]
+            self.rate_tps = self.ramp[0][1]
+        else:
+            # an EMPTY ramp list means unpaced, same as rate_tps=0
+            self.ramp = None
+            self.rate_tps = 0.0 if isinstance(rt, (list, tuple)) \
+                else float(rt)
         self._t0 = None               # pacing clock starts on first poll
         n_unique = min(self.count, int(args.get("unique", 64)))
         txns = make_signed_txns(n_unique, seed=int(args.get("seed", 0)))
@@ -135,14 +185,14 @@ class SynthAdapter:
         if self.sent >= self.count or not self._n_unique:
             return 0
         b = min(self.burst, self.count - self.sent)
-        if self.rate_tps > 0:
+        if self.ramp is not None or self.rate_tps > 0:
             # offered-load pacing: publish no faster than the token
             # budget elapsed wall time has earned (the sweep's offered
             # axis; an unpaced synth measures capacity, not the knee)
             if self._t0 is None:
                 self._t0 = time.perf_counter()
-            earned = int((time.perf_counter() - self._t0) * self.rate_tps)
-            b = min(b, earned - self.sent)
+            b = min(b, self._earned(time.perf_counter() - self._t0)
+                    - self.sent)
             if b <= 0:
                 return 0
         idx = np.arange(self.sent, self.sent + b) % self._n_unique
@@ -161,6 +211,20 @@ class SynthAdapter:
                 link=tr.link_id(next(iter(self.ctx.out_rings))))
         self.sent += pub
         return pub
+
+    def _earned(self, dt: float) -> int:
+        """Token budget earned after dt seconds: flat rate, or the
+        ramp schedule's integral (holding the last stanza's rate past
+        the end)."""
+        if self.ramp is None:
+            return int(dt * self.rate_tps)
+        total = 0.0
+        for d, r in self.ramp:
+            if dt <= d:
+                return int(total + dt * r)
+            total += d * r
+            dt -= d
+        return int(total + dt * self.ramp[-1][1])
 
     def metrics_items(self):
         return {"tx": self.sent, "backpressure": self.bp}
@@ -379,11 +443,19 @@ class PackAdapter:
     u64 microblock_id | u64 slot | (u16 len | payload)*.
     Completion frag: u64 microblock_id (per-bank dedicated link).
 
+    Wave discipline (r13): up to `wave` microblocks are outstanding
+    per bank (the scheduler's FIFO), the whole per-poll wave for a
+    bank ships as ONE credit-gated publish_batch on its link, and
+    completion frags drain as one gather pass per done link — no
+    per-microblock Python publish on the egress path (the reference's
+    pack hot loop is C, src/disco/pack/fd_pack_tile.c).
+
     args: txn_in (link), bank_links (ordered list), done_links (ordered
-    list, one per bank), max_txn_per_microblock, and the slot boundary
-    source: slot_in (link carrying PoH slot frags — the production
-    path, ref fd_poh.h leader slot handoff) or slot_ms (wall-clock
-    fallback for poh-less topologies)."""
+    list, one per bank), max_txn_per_microblock, wave (max outstanding
+    microblocks per bank), and the slot boundary source: slot_in (link
+    carrying PoH slot frags — the production path, ref fd_poh.h leader
+    slot handoff) or slot_ms (wall-clock fallback for poh-less
+    topologies)."""
 
     METRICS = ["rx", "parse_fail", "inserted", "scheduled", "microblocks",
                "completions", "blocks", "backpressure", "overruns",
@@ -411,9 +483,13 @@ class PackAdapter:
         self.slot_ms = float(args.get("slot_ms", 400.0))
         self._slot_t0 = time.monotonic()
         self.batch = int(args.get("batch", 64))
+        self.wave = max(1, int(args.get("wave", 4)))
         self.seqs = ctx.in_seqs0()
         self.in_mtu = ctx.plan["links"][self.txn_in]["mtu"]
-        self.busy = [None] * n_banks      # outstanding microblock id
+        from collections import deque
+        # outstanding microblock ids per bank, FIFO (wave depth deep;
+        # the scheduler holds the matching lock masks in its own queue)
+        self.busy = [deque() for _ in range(n_banks)]
         self._next_mb = 0
         self.cur_slot = 0                 # advanced by PoH slot frags
         self.m = {k: 0 for k in self.METRICS}
@@ -427,18 +503,21 @@ class PackAdapter:
 
     def poll_once(self) -> int:
         total = 0
-        # 1) retire completions (frees account locks first — matches the
-        # reference's poll order so banks never starve)
+        # 1) retire completions in batch (frees account locks first —
+        # matches the reference's poll order so banks never starve):
+        # each done link's gather drains as one pass over the sig
+        # array; completions arrive in the bank's FIFO execution order,
+        # so retiring matches the scheduler's oldest-first queue
         for bank, ln in enumerate(self.done_links):
             ring = self.ctx.in_rings[ln]
             n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
                 self.seqs[ln], self.batch, 64)
             self.m["overruns"] += ovr
-            for i in range(n):
-                mb_id = int(sigs[i])
-                if self.busy[bank] == mb_id:
+            q = self.busy[bank]
+            for mb_id in sigs[:n].tolist():
+                if q and q[0] == mb_id:
+                    q.popleft()
                     self.sched.microblock_done(bank)
-                    self.busy[bank] = None
                     self.m["completions"] += 1
             total += n
         # 2) ingest new txns
@@ -494,28 +573,48 @@ class PackAdapter:
                 (done_slot,) = struct.unpack_from("<Q", buf[i], 0)
                 self.cur_slot = done_slot + 1
             total += k
-        # 3) fill idle banks: bank-count grain (one microblock per
-        # idle bank per poll), not frag grain — each publish carries a
-        # freshly scheduled microblock, there is nothing to batch
-        # fdlint: disable=per-frag-loop — bank-count control grain
+        # 3) fill banks in WAVES: schedule up to the per-bank wave
+        # budget (bounded by the link's credit window so the batched
+        # publish below cannot stall mid-wave against a live
+        # consumer), serialize the whole wave into one buffer, and
+        # ship it as ONE credit-gated publish_batch per bank link —
+        # batch-grain egress, zero per-microblock Python publish
         for bank, ln in enumerate(self.bank_links):
-            if self.busy[bank] is not None:
-                continue
             out = self.ctx.out_rings[ln]
             fseqs = self.ctx.out_fseqs[ln]
-            if fseqs and out.credits(fseqs) <= 0:
+            room = self.wave - len(self.busy[bank])
+            if room <= 0:
+                continue
+            if fseqs:
+                cr = out.credits(fseqs)
+                if cr <= 0:
+                    self.m["backpressure"] += 1
+                    continue
+                room = min(room, cr)
+            frames = []
+            while len(frames) < room:
+                metas = self.sched.schedule_microblock(bank)
+                if not metas:
+                    break
+                mb_id = self._next_mb
+                self._next_mb += 1
+                frames.append((mb_id,
+                               self._serialize(bank, mb_id, metas)))
+                self.busy[bank].append(mb_id)
+                self.m["scheduled"] += len(metas)
+                self.m["microblocks"] += 1
+            if not frames:
+                continue
+
+            # the credit pre-check bounds the wave, so a mid-wave
+            # stall can only mean a consumer rewound its fseq: stall
+            # visibly, resume from the stop row, abort on halt
+            def bp():
                 self.m["backpressure"] += 1
-                continue
-            metas = self.sched.schedule_microblock(bank)
-            if not metas:
-                continue
-            mb_id = self._next_mb
-            self._next_mb += 1
-            out.publish(self._serialize(bank, mb_id, metas), sig=mb_id)
-            self.busy[bank] = mb_id
-            self.m["scheduled"] += len(metas)
-            self.m["microblocks"] += 1
-            total += 1
+            publish_wave(out, fseqs, frames,
+                         cnc=getattr(self.ctx, "cnc", None),
+                         on_stall=bp)
+            total += len(frames)
         return total
 
     def housekeeping(self):
@@ -557,8 +656,20 @@ class BankAdapter:
 
     exec="stub": count txns and ack (ring-plumbing tests).
 
-    args: exec, poh_link (optional out link name), done link = the
-    remaining out link."""
+    Device-wave execution (r13): the tile gathers up to `wave`
+    microblocks per poll and executes them as ONE device dispatch —
+    conflict tables for the whole wave are lane-assembled into one
+    packed staging buffer (svm/executor.py WaveExecutor, the verify
+    tile's _StageBuf discipline) whose balance-independent transfer is
+    issued BEFORE the previous wave retires, so it overlaps that
+    wave's compute; the previous wave then commits and its poh-mixin
+    frames + completion frags publish as one credit-gated
+    publish_batch per link. Serial fiction holds across waves because
+    balances are read only after the prior wave's commit, and the
+    conflict DAG orders intra-wave dependencies.
+
+    args: exec, wave (microblocks per device wave), poh_link (optional
+    out link name), done link = the remaining out link."""
 
     METRICS = ["microblocks", "txns", "transfers", "exec_skip",
                "exec_fail", "overruns", "rpc_port", "ws_port",
@@ -600,8 +711,12 @@ class BankAdapter:
                 raise ValueError(
                     f"bank {ctx.tile_name}: forward_payloads needs "
                     f"poh link mtu >= {need}, got {have}")
+        self.wave = max(1, int(args.get("wave", 8)))
+        self._pending = None           # svm: dispatched, uncommitted wave
         if self.exec_mode in ("svm", "general"):
             _setup_jax()
+            from ..svm.executor import WaveExecutor
+            self._wx = WaveExecutor()
             from ..funk.funk import Funk
             # genesis checkpoint: restore the WHOLE boot state (funded
             # users + vote/stake accounts from app/genesis.py) — the
@@ -758,25 +873,125 @@ class BankAdapter:
 
     def poll_once(self) -> int:
         n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
-            self.seq, 8, self.mtu)
+            self.seq, self.wave, self.mtu)
         self.m["overruns"] += ovr
-        # microblock grain (<= 8 frames/poll): each iteration runs the
-        # SVM executor over the frame and emits its poh + completion
-        # control frags — the per-frame work IS the execution stage
-        # fdlint: disable=per-frag-loop — microblock execution grain
+        if not n:
+            # drain-on-idle: a dispatched wave always retires — queued
+            # completions never wait on more microblocks arriving
+            if self._pending is not None:
+                self._finalize_wave()
+            return 0
+        # decode the wave (header walk: host control-plane, no ring
+        # API per frame — every publish below is batch-grain)
+        frames = []
+        slots_seen = []
         for i in range(n):
             frame = bytes(buf[i, :sizes[i]])
-            bank, txn_cnt, mb_id, slot = struct.unpack_from("<HHQQ",
-                                                            frame, 0)
+            _bank, txn_cnt, mb_id, slot = struct.unpack_from(
+                "<HHQQ", frame, 0)
             self.slot = max(self.slot, slot)
-            if self.exec_mode in ("svm", "general") \
-                    and self.ws is not None \
-                    and self.slot != self._ws_last_slot:
-                self._ws_last_slot = self.slot
-                self.ws.publish_slot(self.slot)
+            slots_seen.append(slot)
             self.m["txns"] += txn_cnt
             self.m["microblocks"] += 1
-            if self.exec_mode == "general" and txn_cnt:
+            frames.append((frame, txn_cnt, mb_id))
+        if self.exec_mode in ("svm", "general") \
+                and self.ws is not None:
+            # every NEW slot the wave crossed notifies, in order — a
+            # slotSubscribe client must not skip intermediate slots
+            for s in sorted({s for s in slots_seen
+                             if s > self._ws_last_slot}):
+                self._ws_last_slot = s
+                self.ws.publish_slot(s)
+        if self.exec_mode == "svm":
+            self._wave_svm(frames)
+        elif self.exec_mode == "general":
+            self._wave_general(frames)
+        else:
+            self._flush_wave([], [mb_id for _, _, mb_id in frames])
+        return n
+
+    def _wave_svm(self, frames):
+        """Stage -> (retire previous) -> dispatch: the wave's packed
+        conflict tables are balance-independent, so their device
+        transfer launches FIRST and overlaps the previous wave's
+        compute; that wave then commits (and its completions publish)
+        before this wave's balances are read — the rotating-stage
+        pipeline, with serial fiction intact."""
+        import hashlib
+        recs, txns = [], []
+        for frame, txn_cnt, mb_id in frames:
+            if txn_cnt:
+                t, mixin = self._parse_transfers(frame, txn_cnt)
+            else:
+                t, mixin = [], hashlib.sha256(b"").digest()
+            recs.append((frame, txn_cnt, mb_id, mixin))
+            txns.extend(t)
+        staged = self._wx.stage(txns) if txns else None
+        if self._pending is not None:
+            self._finalize_wave()
+        disp = None
+        if staged is not None:
+            new_xid = self._next_xid
+            self._next_xid += 1
+            try:
+                disp = self._wx.dispatch(self.funk, self.xid, new_xid,
+                                         staged)
+            except Exception:
+                self.funk.txn_cancel(new_xid)
+                raise
+        self._pending = (disp, recs)
+
+    def _finalize_wave(self):
+        """Force the pending wave's verdict futures, commit its funk
+        fork, then flush its poh mixin frames + completion frags as
+        one publish_batch per link."""
+        from ..svm.executor import STATUS_OK
+        disp, recs = self._pending
+        self._pending = None
+        if disp is not None:
+            try:
+                st = self._wx.finalize(self.funk, disp)
+                self.funk.txn_publish(disp.xid)
+                self.xid = None   # published into root
+            except Exception:
+                self.funk.txn_cancel(disp.xid)
+                raise
+            ok = sum(1 for s in st if s == STATUS_OK)
+            self.m["transfers"] += ok
+            self.m["exec_fail"] += len(st) - ok
+            # ws notifications OUTSIDE the funk guard (a notification
+            # error must not cancel a published txn); unique touched
+            # keys, once per wave, zero cost with no subscribers
+            if self.ws is not None and self.ws.has_clients:
+                touched = {key for t, s in zip(disp.staged.txns, st)
+                           if s == STATUS_OK
+                           for key in (t.src, t.dst)}
+                for key in touched:
+                    self.ws.publish_account(
+                        key, self.funk.rec_query(None, key),
+                        self.slot)
+        poh_frames = []
+        if self.poh_out is not None:
+            for frame, txn_cnt, mb_id, mixin in recs:
+                if not txn_cnt:
+                    continue
+                # forward_payloads: carry the microblock's txn section
+                # so poh entries feed the shred tile with real block
+                # content (the reference's bank->poh hand-off keeps
+                # the txns attached)
+                blob = frame[20:] if self.fwd_payloads else b""
+                poh_frames.append(
+                    (mb_id, struct.pack("<QH", mb_id, txn_cnt)
+                     + mixin + blob))
+        self._flush_wave(poh_frames, [r[2] for r in recs])
+
+    def _wave_general(self, frames):
+        """The FULL host SVM per microblock (inherently host-serial
+        per txn), with the wave's poh frames + completions flushed as
+        batch publishes after the execution loop."""
+        poh_frames = []
+        for frame, txn_cnt, mb_id in frames:
+            if txn_cnt:
                 payloads, parsed, mixin = self._parse_payloads(
                     frame, txn_cnt)
                 touched = set()
@@ -847,61 +1062,28 @@ class BankAdapter:
                             key, self.funk.rec_query(None, key),
                             self.slot)
                 if self.poh_out is not None:
-                    while self.poh_fseqs and \
-                            self.poh_out.credits(self.poh_fseqs) <= 0:
-                        time.sleep(20e-6)
                     blob = frame[20:] if self.fwd_payloads else b""
-                    self.poh_out.publish(
-                        struct.pack("<QH", mb_id, txn_cnt) + mixin
-                        + blob, sig=mb_id)
-            elif self.exec_mode == "svm" and txn_cnt:
-                from ..svm.executor import STATUS_OK, execute_block
-                txns, mixin = self._parse_transfers(frame, txn_cnt)
-                if txns:
-                    new_xid = self._next_xid
-                    self._next_xid += 1
-                    try:
-                        st = execute_block(self.funk, self.xid, new_xid,
-                                           txns)
-                        self.funk.txn_publish(new_xid)
-                        self.xid = None   # published into root
-                        self.m["transfers"] += sum(
-                            1 for s in st if s == STATUS_OK)
-                        self.m["exec_fail"] += sum(
-                            1 for s in st if s != STATUS_OK)
-                    except Exception:
-                        self.funk.txn_cancel(new_xid)
-                        raise
-                    # ws notifications OUTSIDE the funk guard (a
-                    # notification error must not cancel a published
-                    # txn); unique touched keys, once per microblock,
-                    # and zero cost with no subscribers
-                    if self.ws is not None and self.ws.has_clients:
-                        touched = {key for t, s in zip(txns, st)
-                                   if s == STATUS_OK
-                                   for key in (t.src, t.dst)}
-                        for key in touched:
-                            self.ws.publish_account(
-                                key, self.funk.rec_query(None, key),
-                                self.slot)
-                if self.poh_out is not None:
-                    while self.poh_fseqs and \
-                            self.poh_out.credits(self.poh_fseqs) <= 0:
-                        time.sleep(20e-6)
-                    # forward_payloads: carry the microblock's txn
-                    # section so poh entries feed the shred tile with
-                    # real block content (the reference's bank->poh
-                    # microblock hand-off keeps the txns attached)
-                    blob = frame[20:] if self.fwd_payloads else b""
-                    self.poh_out.publish(
-                        struct.pack("<QH", mb_id, txn_cnt) + mixin
-                        + blob,
-                        sig=mb_id)
-            while self.out_fseqs and \
-                    self.out.credits(self.out_fseqs) <= 0:
-                time.sleep(20e-6)
-            self.out.publish(struct.pack("<Q", mb_id), sig=mb_id)
-        return n
+                    poh_frames.append(
+                        (mb_id, struct.pack("<QH", mb_id, txn_cnt)
+                         + mixin + blob))
+        self._flush_wave(poh_frames, [mb_id for _, _, mb_id in frames])
+
+    def _flush_wave(self, poh_frames, done_ids):
+        cnc = getattr(self.ctx, "cnc", None)
+        if poh_frames and self.poh_out is not None:
+            publish_wave(self.poh_out, self.poh_fseqs, poh_frames,
+                         cnc=cnc)
+        if done_ids:
+            publish_wave(
+                self.out, self.out_fseqs,
+                [(mb, struct.pack("<Q", mb)) for mb in done_ids],
+                cnc=cnc)
+
+    def on_halt(self):
+        # a wave already dispatched must still commit and publish its
+        # completions (the verify tile's flush contract)
+        if self._pending is not None:
+            self._finalize_wave()
 
     def in_seqs(self):
         return {self.in_link: self.seq}
@@ -985,6 +1167,14 @@ class PohAdapter:
     verification is the batched device kernel (ops/poh.py) run by
     consumers/tests.
 
+    Batched mixin (r13): the gathered wave of bank microblocks mixes
+    into the chain as hash-chain RUNS (one host_poh_mixin_chain call
+    per run between tick boundaries — byte-identical to the
+    sequential fold, pinned by the conformance suite), and every
+    entry/slot frag the wave produced flushes as ONE credit-gated
+    publish_batch per link — the recurrence stays ordered, only the
+    per-record Python call/publish overhead is batched away.
+
     Entry frag wire: u64 slot | u32 tick | u32 num_hashes |
     u8 has_mixin | prev 32 | hash 32 | mixin 32 | u8 flags
     (bit0 = slot_complete, set on the slot's final tick entry) |
@@ -1001,9 +1191,11 @@ class PohAdapter:
                "backpressure"]
 
     def __init__(self, ctx, args):
-        from ..ops.poh import host_poh_append, host_poh_mixin
+        from ..ops.poh import (host_poh_append, host_poh_mixin,
+                               host_poh_mixin_chain)
         self._append = host_poh_append
         self._mixin = host_poh_mixin
+        self._mixin_chain = host_poh_mixin_chain
         self.ctx = ctx
         self.hashes_per_tick = int(args.get("hashes_per_tick", 64))
         self.ticks_per_slot = int(args.get("ticks_per_slot", 8))
@@ -1039,58 +1231,88 @@ class PohAdapter:
         self.tick_in_slot = 0
         self.hashes_in_tick = 0
         self.entry_idx = 0
+        # wave staging: entry/slot frames built while walking a
+        # gathered wave, flushed as one publish_batch per link
+        self._pend_entries: list[tuple[int, bytes]] = []
+        self._pend_slots: list[int] = []
         self.m = {k: 0 for k in self.METRICS}
 
-    def _publish_entry(self, num_hashes: int, prev: bytes,
-                       mixin: bytes | None, txn_blob: bytes = b"",
-                       txn_cnt: int = 0, slot_done: bool = False):
+    def _emit_entry(self, num_hashes: int, prev: bytes,
+                    mixin: bytes | None, txn_blob: bytes = b"",
+                    txn_cnt: int = 0, slot_done: bool = False):
         frame = struct.pack("<QII B", self.slot, self.tick_in_slot,
                             num_hashes, 1 if mixin else 0)
         frame += prev + self.state + (mixin or bytes(32))
         frame += bytes([1 if slot_done else 0]) \
             + struct.pack("<H", txn_cnt) + txn_blob
-        while self.entry_fseqs and \
-                self.entry_out.credits(self.entry_fseqs) <= 0:
-            self.m["backpressure"] += 1
-            time.sleep(20e-6)
-        self.entry_out.publish(frame, sig=self.entry_idx)
+        self._pend_entries.append((self.entry_idx, frame))
         self.entry_idx += 1
         self.m["entries"] += 1
+
+    def _flush_pending(self):
+        cnc = getattr(self.ctx, "cnc", None)
+        if self._pend_entries:
+            frames, self._pend_entries = self._pend_entries, []
+
+            def bp():
+                self.m["backpressure"] += 1
+            publish_wave(self.entry_out, self.entry_fseqs, frames,
+                         cnc=cnc, on_stall=bp)
+        if self._pend_slots and self.slot_out is not None:
+            slots, self._pend_slots = self._pend_slots, []
+            publish_wave(
+                self.slot_out, self.slot_fseqs,
+                [(s, struct.pack("<Q", s)) for s in slots], cnc=cnc)
 
     def poll_once(self) -> int:
         total = 0
         # 1) mix in executed microblocks (one hash consumed per record;
-        # fd_poh mixin semantics, src/ballet/poh/fd_poh.c). The loop is
-        # inherently sequential: each mixin extends the hash CHAIN from
-        # the previous state, and every entry publish is an individually
-        # framed protocol object cut at a chain position — there is no
-        # batchable form of a strictly ordered recurrence
-        # fdlint: disable=per-frag-loop — sequential PoH chain grain
+        # fd_poh mixin semantics, src/ballet/poh/fd_poh.c). The chain
+        # is inherently sequential, but the wave batches everything
+        # around the recurrence: maximal runs between tick boundaries
+        # hash as ONE chain call, and every frame the wave produced
+        # ships as one publish_batch per link after the walk.
         for ln, ring in self.ctx.in_rings.items():
             n, self.seqs[ln], buf, sizes, sigs, ovr = ring.gather(
                 self.seqs[ln], 16, self.mtu)
             self.m["overruns"] += ovr
-            for i in range(n):
+            if not n:
+                continue
+            mixins = [bytes(buf[i, 10:42]) for i in range(n)]
+            cnts = [struct.unpack_from("<H", buf[i], 8)[0]
+                    for i in range(n)]
+            blobs = [bytes(buf[i, 42:sizes[i]]) for i in range(n)]
+            i = 0
+            while i < n:
                 # a record must fit before the tick boundary
+                # (identical boundary walk to the sequential path:
+                # at most one tick fires per record position)
                 if self.hashes_in_tick + 1 >= self.hashes_per_tick:
                     self._tick()
-                mixin = bytes(buf[i, 10:42])
-                (cnt,) = struct.unpack_from("<H", buf[i], 8)
-                blob = bytes(buf[i, 42:sizes[i]])
-                prev = self.state
-                self.state = self._mixin(prev, mixin)
-                self.hashes_in_tick += 1
-                self._publish_entry(1, prev, mixin, txn_blob=blob,
-                                    txn_cnt=cnt if blob else 0)
-                self.m["mixins"] += 1
+                take = min(max(1, self.hashes_per_tick
+                               - self.hashes_in_tick - 1), n - i)
+                states = self._mixin_chain(self.state,
+                                           mixins[i:i + take])
+                for j in range(take):
+                    prev = self.state
+                    self.state = states[j]
+                    self.hashes_in_tick += 1
+                    blob = blobs[i + j]
+                    self._emit_entry(1, prev, mixins[i + j],
+                                     txn_blob=blob,
+                                     txn_cnt=cnts[i + j] if blob else 0)
+                    self.m["mixins"] += 1
+                i += take
             total += n
+        if self._pend_entries or self._pend_slots:
+            self._flush_pending()
         return total
 
     def _tick(self):
         remaining = self.hashes_per_tick - self.hashes_in_tick
         prev = self.state
         self.state = self._append(prev, remaining)
-        self._publish_entry(
+        self._emit_entry(
             remaining, prev, None,
             slot_done=self.tick_in_slot + 1 >= self.ticks_per_slot)
         self.hashes_in_tick = 0
@@ -1098,11 +1320,7 @@ class PohAdapter:
         self.m["ticks"] += 1
         if self.tick_in_slot >= self.ticks_per_slot:
             if self.slot_out is not None:
-                while self.slot_fseqs and \
-                        self.slot_out.credits(self.slot_fseqs) <= 0:
-                    time.sleep(20e-6)
-                self.slot_out.publish(struct.pack("<Q", self.slot),
-                                      sig=self.slot)
+                self._pend_slots.append(self.slot)
             self.slot += 1
             self.tick_in_slot = 0
             self.m["slots"] += 1
@@ -1112,6 +1330,10 @@ class PohAdapter:
         # stem timer stands in for the tick clock; production would pace
         # against tempo ticks-per-ns calibration)
         self._tick()
+        self._flush_pending()
+
+    def on_halt(self):
+        self._flush_pending()    # staged frames must not die with us
 
     def in_seqs(self):
         return dict(self.seqs)
@@ -1188,7 +1410,8 @@ class ShredAdapter:
                 shred_version=int(args.get("shred_version", 0)),
                 fanout=int(args.get("fanout", 200)),
                 flush_bytes=int(args.get("flush_bytes", 31840)),
-                drop_slot_every=int(args.get("drop_slot_every", 0)))
+                drop_slot_every=int(args.get("drop_slot_every", 0)),
+                cnc=getattr(ctx, "cnc", None))
             self._handle = self.core.on_entry
             self.in_links = [self.in_link]
         else:
@@ -1234,6 +1457,9 @@ class ShredAdapter:
         if self._handle is not None:
             n = _gather_all(self.ctx, self.seqs, self.mtus, 16,
                             self._handle, m)
+            # the wave's mirror wires ship as one batched publish
+            # (leader core buffers per entry, publishes per poll)
+            self.core.flush_egress()
         else:
             n = 0
             for ln in self.in_links:
@@ -1252,6 +1478,10 @@ class ShredAdapter:
                 if ln not in seqs:
                     seqs[ln] = self._kg.resp_seq
         return seqs
+
+    def on_halt(self):
+        if self.mode == "leader":
+            self.core.flush_egress()   # buffered wires must not die
 
     def metrics_items(self):
         return {k: self.core.metrics.get(k, 0) for k in self.METRICS

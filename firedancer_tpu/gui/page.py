@@ -306,7 +306,8 @@ function renderBench(rows){
  if(!rows.length){root.innerHTML="<small>no BENCH_r*.json rounds "+
   "found</small>";return;}
  for(const[key,label]of[["value","kernel verifies/s"],
-   ["e2e_tps","e2e pipeline tps"],["e2e_knee_tps","e2e knee tps"]]){
+   ["e2e_tps","e2e pipeline tps"],["e2e_knee_tps","e2e knee tps"],
+   ["e2e_leader_knee_tps","leader-loop knee tps"]]){
   const pts=rows.map((r,i)=>[i,r[key]]).filter(p=>p[1]!=null);
   const div=document.createElement("div");div.className="chart";
   const max=Math.max(...pts.map(p=>p[1]),1);

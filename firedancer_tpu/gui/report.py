@@ -56,6 +56,10 @@ def bench_series(paths) -> list[dict]:
             "value": _num("value"),
             "e2e_tps": _num("e2e_tps"),
             "e2e_knee_tps": _num("e2e_knee_tps"),
+            # leader-loop sweep (r13): the full pack->bank->poh->shred
+            # knee + its saturating hop ride every round's trend row
+            "e2e_leader_knee_tps": _num("e2e_leader_knee_tps"),
+            "e2e_leader_hop": rec.get("e2e_leader_hop"),
             "platform": rec.get("platform"),
         })
     return rows

@@ -499,8 +499,12 @@ def _check_arg_refs(tn, t, tcaches, tiles, kinds, lines) -> list[Finding]:
     for key, ref in spec.items():
         if ref is None or key not in args:
             continue
-        vals = args[key] if ref in (reg.IN_LIST, reg.OUT_LIST) and \
-            isinstance(args[key], (list, tuple)) else [args[key]]
+        # list-valued refs: IN_LIST/OUT_LIST by schema, and TCACHE for
+        # the sharded-tile expansion (a per-shard tcache list — each
+        # entry must still resolve)
+        vals = args[key] if isinstance(args[key], (list, tuple)) and \
+            ref in (reg.IN_LIST, reg.OUT_LIST, reg.TCACHE) \
+            else [args[key]]
         for v in vals:
             if ref in (reg.IN, reg.IN_LIST) and v not in ins:
                 bad(key, v, f"one of the tile's ins {sorted(ins)}")

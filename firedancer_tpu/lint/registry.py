@@ -66,15 +66,21 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
     "verify": {"batch": None, "max_len": None, "tcache": TCACHE,
                "device_retries": None, "device_timeout_s": None,
                "device_fail_limit": None, "rr_cnt": None, "rr_idx": None,
-               "devices": None, "coalesce_us": None},
+               "devices": None, "coalesce_us": None,
+               # rr-sharded scale-out (config-side expansion in
+               # app/config.py: tile_cnt shards, one out link each,
+               # optional cpu0+i core pinning; a list-valued tcache
+               # distributes per shard)
+               "tile_cnt": None, "cpu0": None},
     "dedup": {"tcache": TCACHE, "batch": None},
     "pack": {"txn_in": IN, "bank_links": OUT_LIST, "done_links": IN_LIST,
              "slot_in": IN, "bundle_in": IN, "slot_ms": None,
-             "batch": None, "max_txn_per_microblock": None},
+             "batch": None, "max_txn_per_microblock": None,
+             "wave": None},
     "bank": {"exec": None, "poh_link": OUT, "forward_payloads": None,
              "slots_per_epoch": None, "genesis_ckpt": None,
              "genesis": None, "genesis_synth": None, "rpc_port": None,
-             "ws_port": None},
+             "ws_port": None, "wave": None},
     "sock": {"port": None, "bind_addr": None, "batch": None, "mtu": None},
     "quic": {"port": None, "bind_addr": None, "batch": None, "mtu": None},
     "poh": {"hashes_per_tick": None, "ticks_per_slot": None,

@@ -29,7 +29,7 @@ import numpy as np
 from .sha2 import sha256
 
 __all__ = ["poh_verify_entries", "host_poh_append", "host_poh_mixin",
-           "PohChain"]
+           "host_poh_mixin_chain", "PohChain"]
 
 
 def _sha256_fixed(msg):
@@ -79,6 +79,20 @@ def host_poh_append(state: bytes, n: int) -> bytes:
 
 def host_poh_mixin(state: bytes, mixin: bytes) -> bytes:
     return hashlib.sha256(state + mixin).digest()
+
+
+def host_poh_mixin_chain(state: bytes, mixins) -> list[bytes]:
+    """One hash-chain call over a WAVE of mixins: returns the state
+    after each mixin, byte-identical to folding host_poh_mixin
+    sequentially (the chain is inherently ordered — this batches the
+    Python call overhead, not the recurrence; tests pin the
+    equivalence). The caller's state after the wave is the last
+    element."""
+    out = []
+    for m in mixins:
+        state = hashlib.sha256(state + m).digest()
+        out.append(state)
+    return out
 
 
 class PohChain:

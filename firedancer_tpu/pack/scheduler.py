@@ -29,6 +29,7 @@ scheduler.
 from __future__ import annotations
 
 import heapq
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..protocol.txn import ParsedTxn, parse_txn
@@ -145,10 +146,14 @@ class PackScheduler:
         self._pending: dict[int, TxnMeta] = {}
         self._next_id = 0
         self._seq = 0
-        # outstanding (in-flight microblock) masks per bank
-        self._out_w = [0] * bank_cnt
-        self._out_r = [0] * bank_cnt
-        self._out_txns: list[list[TxnMeta]] = [[] for _ in range(bank_cnt)]
+        # outstanding (in-flight) microblocks per bank: a FIFO of
+        # (w_mask, r_mask, metas). The wave discipline keeps up to the
+        # caller's wave depth of microblocks in flight per bank;
+        # same-bank microblocks execute serially IN ORDER (the bank
+        # consumes its link FIFO), so only OTHER banks' outstanding
+        # masks are conflict windows — the reference's one-busy-flag
+        # per bank is the wave=1 special case of this queue.
+        self._out: list[deque] = [deque() for _ in range(bank_cnt)]
         # bundles: FIFO of ordered txn groups awaiting atomic placement
         # (ref: fd_pack bundle support — a bundle is never reordered,
         # never split, and outranks the regular pending pool)
@@ -265,9 +270,7 @@ class PackScheduler:
                 self.metrics["bundle_skip"] += 1
                 return None
         self._bundles.pop(0)
-        self._out_w[bank] = g_w
-        self._out_r[bank] = g_r
-        self._out_txns[bank] = mb
+        self._out[bank].append((g_w, g_r, mb))
         self.block_cost += g_cost
         self.block_vote_cost += g_vote
         self.block_microblocks += 1
@@ -302,13 +305,17 @@ class PackScheduler:
                 return False
         return True
 
+    def outstanding_cnt(self, bank: int) -> int:
+        """In-flight microblocks queued on `bank` (the caller's wave
+        budget gate — microblock_done retires them FIFO)."""
+        return len(self._out[bank])
+
     def schedule_microblock(self, bank: int) -> list[TxnMeta]:
         """Emit the next microblock for `bank` (empty when nothing
-        schedulable). The caller must signal microblock_done(bank)
-        before asking for another microblock on the same bank.
+        schedulable). Multiple microblocks may be outstanding on one
+        bank (the wave discipline); the caller signals
+        microblock_done(bank) once per microblock, in FIFO order.
         (ref contract: fd_pack.c:2477 schedule_next_microblock)."""
-        assert not self._out_txns[bank], \
-            "previous microblock on this bank not completed"
         if self.block_microblocks >= self.limits.max_microblocks_per_block:
             return []
         out_w = 0
@@ -316,8 +323,9 @@ class PackScheduler:
         for b in range(self.bank_cnt):
             if b == bank:
                 continue
-            out_w |= self._out_w[b]
-            out_rw |= self._out_w[b] | self._out_r[b]
+            for bw, br, _ in self._out[b]:
+                out_w |= bw
+                out_rw |= bw | br
 
         # bundles outrank the pending pool and occupy a microblock
         # exclusively (never mixed, never reordered, never split)
@@ -371,9 +379,7 @@ class PackScheduler:
 
         if not mb:
             return []
-        self._out_w[bank] = mb_w
-        self._out_r[bank] = mb_r
-        self._out_txns[bank] = mb
+        self._out[bank].append((mb_w, mb_r, mb))
         self.block_cost += mb_cost
         self.block_microblocks += 1
         for m in mb:
@@ -387,16 +393,18 @@ class PackScheduler:
         return mb
 
     def microblock_done(self, bank: int):
-        """Bank finished executing its microblock: release account locks
-        (block-level cost accounting is permanent until end_block)."""
-        for m in self._out_txns[bank]:
+        """Bank finished executing its OLDEST outstanding microblock:
+        release that microblock's account locks (banks consume their
+        link FIFO, so completions arrive in schedule order; block-level
+        cost accounting is permanent until end_block)."""
+        if not self._out[bank]:
+            return                    # idle bank: done is a no-op
+        _, _, metas = self._out[bank].popleft()
+        for m in metas:
             for k in m.writes:
                 self._bits.release(k)
             for k in m.reads:
                 self._bits.release(k)
-        self._out_txns[bank] = []
-        self._out_w[bank] = 0
-        self._out_r[bank] = 0
 
     def end_block(self):
         """Reset per-block accounting (ref: fd_pack_end_block)."""
@@ -406,4 +414,5 @@ class PackScheduler:
         self._acct_write_cost.clear()
 
     def outstanding(self, bank: int) -> list[TxnMeta]:
-        return list(self._out_txns[bank])
+        """Every txn in flight on `bank`, oldest microblock first."""
+        return [m for _, _, metas in self._out[bank] for m in metas]

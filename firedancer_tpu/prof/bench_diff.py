@@ -29,7 +29,14 @@ GATE_METRICS = (
     ("value", "kernel vps"),
     ("e2e_tps", "e2e tps"),
     ("e2e_knee_tps", "e2e knee tps"),
+    ("e2e_leader_knee_tps", "leader knee tps"),
 )
+
+# the knee subset: what bench.py's implicit previous-round gate
+# (FDTPU_BENCH_PREV unset -> latest BENCH_r*.json) compares — knee
+# regressions are the r13 contract; kernel/raw-tps noise across
+# heterogeneous rounds stays report-only there
+KNEE_METRICS = ("e2e_knee_tps", "e2e_leader_knee_tps")
 
 
 def load_bench(path: str) -> dict:
@@ -105,11 +112,16 @@ def diff_bench(old: dict, new: dict) -> dict:
     return {"metrics": metrics, "links": links, "profile": profile}
 
 
-def gate_regressions(diff: dict, threshold: float = 0.05) -> list[dict]:
+def gate_regressions(diff: dict, threshold: float = 0.05,
+                     keys=None) -> list[dict]:
     """Gated metrics whose fractional drop exceeds the threshold —
-    non-empty means the gate fails (exit 1)."""
+    non-empty means the gate fails (exit 1). `keys` restricts the
+    gate to a metric subset (KNEE_METRICS for the implicit
+    previous-round gate); None gates everything."""
     out = []
     for key, rec in diff["metrics"].items():
+        if keys is not None and key not in keys:
+            continue
         frac = rec.get("frac")
         if frac is not None and frac < -threshold:
             out.append({"metric": key, "label": rec["label"],

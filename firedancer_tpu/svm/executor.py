@@ -98,11 +98,29 @@ def _build_waves(txns, key_idx):
     return waves, (src, dst, amt, fee, tix, act)
 
 
-def _jax_wave_scan(bal_hi, bal_lo, tables):
+def _bucket(n: int, lo: int = 4) -> int:
+    """Next power of two >= max(n, lo): the padded-shape discipline
+    that keeps the jitted wave scan at a bounded set of compiled
+    variants (the verify tile's fixed-batch rule, applied per axis)."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _scan_packed(packed, bal_hi, bal_lo):
+    """The jitted kernel: ONE packed (W, C, 7) uint32 table — src, dst,
+    amt_hi, amt_lo, fee_hi, fee_lo, act per lane — split on-device
+    (the _StageBuf discipline: host->device is a single transfer per
+    wave, lanes unpack inside the program)."""
     import jax
     import jax.numpy as jnp
 
-    src, dst, amt, fee, tix, act = (jnp.asarray(x) for x in tables)
+    src = packed[..., 0].astype(jnp.int32)
+    dst = packed[..., 1].astype(jnp.int32)
+    amt = packed[..., 2:4]
+    fee = packed[..., 4:6]
+    act = packed[..., 6] != 0
 
     def u64_ge(ah, al, bh, bl):
         return (ah > bh) | ((ah == bh) & (al >= bl))
@@ -147,59 +165,149 @@ def _jax_wave_scan(bal_hi, bal_lo, tables):
         return (bh, bl), status
 
     (bh, bl), statuses = jax.lax.scan(
-        wave_step, (jnp.asarray(bal_hi), jnp.asarray(bal_lo)),
-        (src, dst, amt, fee, act))
-    return np.asarray(bh), np.asarray(bl), np.asarray(statuses)
+        wave_step, (bal_hi, bal_lo), (src, dst, amt, fee, act))
+    return bh, bl, statuses
+
+
+@dataclass
+class StagedWave:
+    """One staged device wave: the packed conflict tables (already in
+    flight to the device — the transfer is balance-independent, so it
+    overlaps whatever the device was computing) plus the host-side
+    decode maps. Built by WaveExecutor.stage, consumed by dispatch."""
+    txns: list
+    key_idx: dict
+    packed_dev: object          # device array (or None when empty)
+    tix: np.ndarray
+    act: np.ndarray
+
+
+@dataclass
+class DispatchedWave:
+    """An in-flight wave: the funk fork is prepared, balances are on
+    the wire, the scan's result futures are pending. finalize() forces
+    them and commits."""
+    staged: StagedWave
+    xid: object
+    prior: dict
+    fut: tuple                  # (bal_hi, bal_lo, statuses) futures
+
+
+class WaveExecutor:
+    """Device-wave block execution split into stage -> dispatch ->
+    finalize, so a pipelining caller (the bank tile) can overlap wave
+    k+1's staging transfer with wave k's compute:
+
+      stage(txns)      build conflict waves, pack ALL lane tables into
+                       ONE (W, C, 7) uint32 buffer, async device_put —
+                       balance-INdependent, safe before the previous
+                       wave committed
+      dispatch(...)    prepare the funk fork, read balances (after the
+                       previous wave's commit), launch the jitted scan
+                       — returns futures, never blocks
+      finalize(...)    force the futures, commit lamports into the
+                       fork, return per-txn statuses in insertion order
+
+    Shapes are padded to power-of-two buckets per axis so the jit
+    compiles a bounded set of variants (verify's fixed-shape rule)."""
+
+    def __init__(self):
+        self._fn = None
+
+    def _jit(self):
+        if self._fn is None:
+            import jax
+            self._fn = jax.jit(_scan_packed)
+        return self._fn
+
+    def stage(self, txns) -> StagedWave:
+        txns = list(txns)
+        key_idx: dict = {}
+        for t in txns:
+            for k in (t.src, t.dst):
+                if k not in key_idx:
+                    key_idx[k] = len(key_idx)
+        if not txns:
+            return StagedWave(txns, key_idx, None,
+                              np.zeros((0, 0), np.int32),
+                              np.zeros((0, 0), bool))
+        _, (src, dst, amt, fee, tix, act) = _build_waves(txns, key_idx)
+        w, c = tix.shape
+        wp, cp = _bucket(w), _bucket(c)
+        dummy = len(key_idx)
+        packed = np.zeros((wp, cp, 7), np.uint32)
+        # padding lanes aim at the dummy slot with act=0: their
+        # write-back is a same-value no-op by construction
+        packed[..., 0] = dummy
+        packed[..., 1] = dummy
+        packed[:w, :c, 0] = src
+        packed[:w, :c, 1] = dst
+        packed[:w, :c, 2:4] = amt
+        packed[:w, :c, 4:6] = fee
+        packed[:w, :c, 6] = act
+        import jax
+        return StagedWave(txns, key_idx, jax.device_put(packed),
+                          tix, act)
+
+    def dispatch(self, funk, parent_xid, xid, staged: StagedWave
+                 ) -> DispatchedWave:
+        funk.txn_prepare(parent_xid, xid)
+        if not staged.txns:
+            return DispatchedWave(staged, xid, {}, None)
+        from .accdb import Account
+        n = len(staged.key_idx)
+        np_acct = _bucket(n + 1)
+        bal_hi = np.zeros((np_acct,), np.uint32)
+        bal_lo = np.zeros((np_acct,), np.uint32)
+        prior: dict = {}
+        for k, i in staged.key_idx.items():
+            rec = funk.rec_query(parent_xid, k)
+            prior[k] = rec
+            # funk values are either typed accdb Accounts or bare
+            # lamports ints (legacy genesis path); both carry u64
+            v = rec.lamports if isinstance(rec, Account) \
+                else (0 if rec is None else int(rec))
+            bal_hi[i] = v >> 32
+            bal_lo[i] = v & _MASK32
+        fut = self._jit()(staged.packed_dev, bal_hi, bal_lo)
+        return DispatchedWave(staged, xid, prior, fut)
+
+    def finalize(self, funk, disp: DispatchedWave) -> list[int]:
+        staged = disp.staged
+        if disp.fut is None:
+            return []
+        bh, bl, st = (np.asarray(x) for x in disp.fut)
+        statuses = [STATUS_PAD] * len(staged.txns)
+        tix, act = staged.tix, staged.act
+        for wi in range(tix.shape[0]):
+            for li in range(tix.shape[1]):
+                if act[wi, li]:
+                    statuses[int(tix[wi, li])] = int(st[wi, li])
+        from .accdb import Account, commit_lamports
+        typed = any(isinstance(v, Account) for v in disp.prior.values())
+        for k, i in staged.key_idx.items():
+            commit_lamports(funk, disp.xid, k,
+                            (int(bh[i]) << 32) | int(bl[i]), typed,
+                            disp.prior[k])
+        return statuses
+
+
+_DEFAULT_WX: WaveExecutor | None = None
 
 
 def execute_block(funk, parent_xid, xid, txns) -> list[int]:
     """Replay a block of system transfers on the device and commit the
     result as funk fork `xid` (prepared from `parent_xid`). Returns
-    per-txn statuses in insertion order.
+    per-txn statuses in insertion order. One synchronous
+    stage -> dispatch -> finalize round on the shared WaveExecutor —
+    the bank tile pipelines the same three calls itself.
 
     funk record format: key = pubkey bytes, val = int lamports.
     """
-    txns = list(txns)
-    funk.txn_prepare(parent_xid, xid)
-    if not txns:
-        return []
-
-    # dense account table for this block
-    key_idx: dict = {}
-    for t in txns:
-        for k in (t.src, t.dst):
-            if k not in key_idx:
-                key_idx[k] = len(key_idx)
-    keys = list(key_idx)
-    n = len(keys)
-    # slot n is the dummy account targeted by padding lanes
-    bal_hi = np.zeros((n + 1,), np.uint32)
-    bal_lo = np.zeros((n + 1,), np.uint32)
-    from .accdb import Account
-    prior: dict = {}
-    for k, i in key_idx.items():
-        rec = funk.rec_query(parent_xid, k)
-        prior[k] = rec
-        # funk values are either typed accdb Accounts or bare lamports
-        # ints (legacy genesis path); both carry u64 lamports
-        v = rec.lamports if isinstance(rec, Account) \
-            else (0 if rec is None else int(rec))
-        bal_hi[i] = v >> 32
-        bal_lo[i] = v & _MASK32
-
-    waves, tables = _build_waves(txns, key_idx)
-    bh, bl, st = _jax_wave_scan(bal_hi, bal_lo, tables)
-
-    statuses = [STATUS_PAD] * len(txns)
-    tix, act = tables[4], tables[5]
-    for wi in range(tix.shape[0]):
-        for li in range(tix.shape[1]):
-            if act[wi, li]:
-                statuses[int(tix[wi, li])] = int(st[wi, li])
-
-    from .accdb import commit_lamports
-    typed = any(isinstance(v, Account) for v in prior.values())
-    for k, i in key_idx.items():
-        commit_lamports(funk, xid, k,
-                        (int(bh[i]) << 32) | int(bl[i]), typed, prior[k])
-    return statuses
+    global _DEFAULT_WX
+    if _DEFAULT_WX is None:
+        _DEFAULT_WX = WaveExecutor()
+    wx = _DEFAULT_WX
+    staged = wx.stage(txns)
+    disp = wx.dispatch(funk, parent_xid, xid, staged)
+    return wx.finalize(funk, disp)

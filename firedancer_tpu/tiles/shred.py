@@ -78,7 +78,8 @@ class ShredLeaderCore:
                  out_ring=None, out_fseqs=None,
                  shred_version: int = 0, fanout: int = 200,
                  flush_bytes: int = 31840, batch_out=None,
-                 batch_fseqs=None, drop_slot_every: int = 0):
+                 batch_fseqs=None, drop_slot_every: int = 0,
+                 cnc=None):
         """cluster: [ClusterNode]; sock: bound UDP socket for egress.
         batch_out: optional ring that mirrors every flushed entry batch
         (u64 slot | u8 block_complete | bytes) — the byte-identity
@@ -100,6 +101,15 @@ class ShredLeaderCore:
         self.cur_slot = None
         self.cur_tick = 0
         self.buf = bytearray()
+        # mirror-link egress staging (r13): _tx buffers wires here and
+        # flush_egress ships them as ONE credit-gated publish_batch —
+        # a slot's worth of shreds must not cost one Python publish
+        # each on the out ring (UDP egress stays per wire: a sendto is
+        # a syscall per datagram by nature). cnc lets the batched
+        # publish abort instead of spinning if the tile is halted
+        # while backpressured.
+        self._egress: list[tuple[bytes, int]] = []
+        self._cnc = cnc
         self.metrics = {"entries": 0, "batches": 0, "fec_sets": 0,
                         "data_shreds": 0, "parity_shreds": 0,
                         "sent": 0, "no_dest": 0, "sign_fail": 0,
@@ -179,8 +189,22 @@ class ShredLeaderCore:
         else:
             self.metrics["no_dest"] += 1
         if self.out_ring is not None:
-            self._publish(self.out_ring, self.out_fseqs, wire, sig=idx)
+            self._egress.append((wire, idx))
         return n
+
+    def flush_egress(self) -> int:
+        """Publish every buffered mirror wire as one credit-gated
+        batch (stop-row resume on a mid-batch stall, halt-aware via
+        the shared publish_wave helper). The adapter calls this once
+        per poll and on halt; in-process tests that drive on_entry
+        directly call it to observe the mirror ring."""
+        if not self._egress:
+            return 0
+        wires, self._egress = self._egress, []
+        from ..disco.tiles import publish_wave
+        return publish_wave(self.out_ring, self.out_fseqs,
+                            [(idx, w) for w, idx in wires],
+                            cnc=self._cnc)
 
     @staticmethod
     def _publish(ring, fseqs, frame: bytes, sig: int):

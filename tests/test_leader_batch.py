@@ -1,0 +1,621 @@
+"""Leader-loop batching byte-exactness (r13).
+
+The wave discipline (pack microblock waves, bank device-wave
+execution, batched PoH mixin, batched entry/slot/mirror publishes)
+must be a pure THROUGHPUT change: every frame on every ring is
+byte-identical to what the per-frag path produced. These suites pin
+that down component by component with sequential oracles, plus the
+scheduler's multi-outstanding (wave) conflict invariant and the synth
+ramp schedule's token integral.
+"""
+import hashlib
+import os
+import struct
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops.poh import (host_poh_append, host_poh_mixin,
+                                    host_poh_mixin_chain)
+from firedancer_tpu.runtime import Fseq, Ring, Workspace
+
+pytestmark = pytest.mark.leader
+
+
+@pytest.fixture(scope="module")
+def wksp():
+    w = Workspace(f"/fdtpu_lb_{os.getpid()}", 1 << 24)
+    yield w
+    w.close()
+    w.unlink()
+
+
+def _drain(ring, seq=0):
+    out = []
+    while True:
+        rc, frag = ring.consume(seq)
+        if rc != 0:
+            break
+        out.append((bytes(ring.payload(frag)), frag.sig))
+        seq += 1
+    return out, seq
+
+
+# ---------------------------------------------------------------------------
+# PoH: batched mixin chain + tile-level frame oracle
+# ---------------------------------------------------------------------------
+
+def test_poh_mixin_chain_matches_sequential_fold():
+    state = bytes(range(32))
+    mixins = [hashlib.sha256(b"m%d" % i).digest() for i in range(37)]
+    got = host_poh_mixin_chain(state, mixins)
+    want, s = [], state
+    for m in mixins:
+        s = host_poh_mixin(s, m)
+        want.append(s)
+    assert got == want
+    assert host_poh_mixin_chain(state, []) == []
+
+
+def _poh_oracle(frames_in, hpt, tps, seed=bytes(32)):
+    """The r12 per-record sequential PoH walk: returns the exact entry
+    frames + slot frags the old tile published for this input."""
+    state = seed
+    slot = tick_in_slot = hashes_in_tick = 0
+    entries, slots = [], []
+
+    def emit(num_hashes, prev, mixin, blob=b"", cnt=0, slot_done=False):
+        f = struct.pack("<QII B", slot, tick_in_slot, num_hashes,
+                        1 if mixin else 0)
+        f += prev + state + (mixin or bytes(32))
+        f += bytes([1 if slot_done else 0]) + struct.pack("<H", cnt) \
+            + blob
+        entries.append(f)
+
+    def tick():
+        nonlocal state, hashes_in_tick, tick_in_slot, slot
+        remaining = hpt - hashes_in_tick
+        prev = state
+        state = host_poh_append(prev, remaining)
+        emit(remaining, prev, None,
+             slot_done=tick_in_slot + 1 >= tps)
+        hashes_in_tick = 0
+        tick_in_slot += 1
+        if tick_in_slot >= tps:
+            slots.append(slot)
+            slot += 1
+            tick_in_slot = 0
+
+    for mixin, cnt, blob in frames_in:
+        if hashes_in_tick + 1 >= hpt:
+            tick()
+        prev = state
+        state = host_poh_mixin(prev, mixin)
+        hashes_in_tick += 1
+        emit(1, prev, mixin, blob=blob, cnt=cnt if blob else 0)
+    return entries, slots
+
+
+def _mk_poh(wksp, hpt=4, tps=2, in_depth=64):
+    """PohAdapter over real rings with a minimal fake ctx."""
+    from firedancer_tpu.disco.tiles import PohAdapter
+    in_ring = Ring.create(wksp, depth=in_depth, mtu=256)
+    entry_ring = Ring.create(wksp, depth=256, mtu=512)
+    slot_ring = Ring.create(wksp, depth=64, mtu=64)
+    plan = {"links": {"in": {"mtu": 256}, "entries": {"mtu": 512},
+                      "slots": {"mtu": 64}}}
+    ctx = SimpleNamespace(
+        tile_name="poh", plan=plan,
+        in_rings={"in": in_ring},
+        out_rings={"entries": entry_ring, "slots": slot_ring},
+        out_fseqs={"entries": [], "slots": []},
+        in_seqs0=lambda: {"in": 0})
+    tile = PohAdapter(ctx, {"hashes_per_tick": hpt,
+                            "ticks_per_slot": tps,
+                            "slot_link": "slots"})
+    return tile, in_ring, entry_ring, slot_ring
+
+
+def test_poh_wave_frames_byte_identical_to_sequential(wksp):
+    """Drive the batched PoH tile with uneven waves of bank frames
+    (runs crossing tick boundaries) and compare every entry frame and
+    slot frag against the sequential oracle, byte for byte."""
+    tile, in_ring, entry_ring, slot_ring = _mk_poh(wksp, hpt=4, tps=2)
+    frames_in = []
+    for i in range(11):
+        mixin = hashlib.sha256(b"mb-%d" % i).digest()
+        blob = (b"\x05\x00" + bytes([i]) * 5) if i % 3 else b""
+        frames_in.append((mixin, 1 if blob else 0, blob))
+    # publish in uneven bursts so poll-time waves split across runs
+    sent = 0
+    for burst in (1, 4, 6):
+        for mixin, cnt, blob in frames_in[sent:sent + burst]:
+            in_ring.publish(struct.pack("<QH", sent, cnt) + mixin
+                            + blob, sig=sent)
+            sent += 1
+        tile.poll_once()
+    tile.poll_once()          # idle flush (nothing pending expected)
+    want_entries, want_slots = _poh_oracle(frames_in, hpt=4, tps=2)
+    got_entries, _ = _drain(entry_ring)
+    got_slots, _ = _drain(slot_ring)
+    assert [f for f, _ in got_entries] == want_entries
+    assert [sig for _, sig in got_entries] == list(range(len(
+        want_entries)))
+    assert [struct.unpack("<Q", f)[0] for f, _ in got_slots] \
+        == want_slots
+    assert tile.m["mixins"] == len(frames_in)
+
+
+def test_poh_wave_backpressure_resumes_exact(wksp):
+    """A reliable consumer smaller than the wave: the batched entry
+    publish stalls mid-wave and resumes from the stop row with no
+    frame lost, reordered, or altered."""
+    from firedancer_tpu.disco.tiles import PohAdapter
+    in_ring = Ring.create(wksp, depth=64, mtu=256)
+    entry_ring = Ring.create(wksp, depth=8, mtu=512)   # tiny window
+    fs = Fseq(wksp)
+    plan = {"links": {"in": {"mtu": 256}, "entries": {"mtu": 512}}}
+    ctx = SimpleNamespace(
+        tile_name="poh", plan=plan, in_rings={"in": in_ring},
+        out_rings={"entries": entry_ring},
+        out_fseqs={"entries": [fs]},
+        in_seqs0=lambda: {"in": 0})
+    tile = PohAdapter(ctx, {"hashes_per_tick": 64,
+                            "ticks_per_slot": 8})
+    frames_in = []
+    for i in range(12):
+        mixin = hashlib.sha256(b"bp-%d" % i).digest()
+        in_ring.publish(struct.pack("<QH", i, 0) + mixin, sig=i)
+        frames_in.append((mixin, 0, b""))
+
+    import threading
+    got = []
+
+    def consumer():
+        seq = 0
+        import time
+        deadline = time.monotonic() + 30
+        while len(got) < 12 and time.monotonic() < deadline:
+            rc, frag = entry_ring.consume(seq)
+            if rc != 0:
+                time.sleep(0.002)
+                continue
+            got.append(bytes(entry_ring.payload(frag)))
+            seq += 1
+            fs.update(seq)
+            time.sleep(0.001)     # keep the window tight
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    tile.poll_once()
+    t.join(timeout=30)
+    want_entries, _ = _poh_oracle(frames_in, hpt=64, tps=8)
+    assert got == want_entries
+    assert tile.m["backpressure"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Pack: wave scheduling + batched bank-link publish
+# ---------------------------------------------------------------------------
+
+def _mk_pack(wksp, wave=4, banks=1):
+    from firedancer_tpu.disco.tiles import PackAdapter
+    txn_ring = Ring.create(wksp, depth=256, mtu=1280)
+    bank_rings = [Ring.create(wksp, depth=64, mtu=16384)
+                  for _ in range(banks)]
+    done_rings = [Ring.create(wksp, depth=64, mtu=64)
+                  for _ in range(banks)]
+    links = {"txns": {"mtu": 1280}}
+    in_rings = {"txns": txn_ring}
+    out_rings, out_fseqs = {}, {}
+    done_names = []
+    for b in range(banks):
+        links[f"bank{b}"] = {"mtu": 16384}
+        links[f"done{b}"] = {"mtu": 64}
+        out_rings[f"bank{b}"] = bank_rings[b]
+        out_fseqs[f"bank{b}"] = []
+        in_rings[f"done{b}"] = done_rings[b]
+        done_names.append(f"done{b}")
+    ctx = SimpleNamespace(
+        tile_name="pack", plan={"links": links}, in_rings=in_rings,
+        out_rings=out_rings, out_fseqs=out_fseqs,
+        in_seqs0=lambda: {ln: 0 for ln in in_rings})
+    tile = PackAdapter(ctx, {
+        "txn_in": "txns",
+        "bank_links": [f"bank{b}" for b in range(banks)],
+        "done_links": done_names,
+        "max_txn_per_microblock": 4, "wave": wave, "slot_ms": 1e9})
+    return tile, txn_ring, bank_rings, done_rings
+
+
+def test_pack_wave_frames_byte_identical(wksp):
+    """One poll emits a WAVE of microblocks through publish_batch;
+    every frame on the ring is byte-identical to the per-frag
+    serializer's output for that microblock (recorded via the same
+    _serialize the old per-microblock publish shipped verbatim)."""
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    tile, txn_ring, bank_rings, done_rings = _mk_pack(wksp, wave=4)
+    recorded = {}
+    real = tile._serialize
+
+    def record(bank, mb_id, metas):
+        f = real(bank, mb_id, metas)
+        recorded[mb_id] = f
+        return f
+
+    tile._serialize = record
+    txns = make_signed_txns(16, seed=21)
+    for i, t in enumerate(txns):
+        txn_ring.publish(t, sig=i)
+    tile.poll_once()
+    frames, _ = _drain(bank_rings[0])
+    # synth txns share 16 signer keys -> conflicts bound microblock
+    # fill, but the wave cap (4) bounds the poll's emission
+    assert 1 <= len(frames) <= 4
+    assert tile.m["microblocks"] == len(frames)
+    for frame, sig in frames:
+        assert frame == recorded[sig]
+    # wire-format roundtrip: every payload is one of the inserted txns
+    seen = []
+    for frame, _ in frames:
+        bank, cnt, mb_id, slot = struct.unpack_from("<HHQQ", frame, 0)
+        assert bank == 0
+        off = 20
+        for _ in range(cnt):
+            (ln,) = struct.unpack_from("<H", frame, off)
+            off += 2
+            seen.append(frame[off:off + ln])
+            off += ln
+        assert off == len(frame)
+    assert set(seen) <= set(txns) and len(seen) == len(set(seen))
+    # completions retire the wave FIFO and free the budget
+    q0 = list(tile.busy[0])
+    for mb_id in q0:
+        done_rings[0].publish(struct.pack("<Q", mb_id), sig=mb_id)
+    tile.poll_once()
+    assert tile.m["completions"] == len(q0)
+    assert not tile.busy[0]
+
+
+def test_pack_wave_respects_credit_window(wksp):
+    """The wave is bounded by the bank link's credit window: with a
+    reliable consumer that never advances, only `credits` microblocks
+    are scheduled and published — the batched publish cannot stall
+    mid-wave against a live consumer."""
+    from firedancer_tpu.disco.tiles import PackAdapter
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    txn_ring = Ring.create(wksp, depth=256, mtu=1280)
+    bank_ring = Ring.create(wksp, depth=2, mtu=16384)  # 2 credits
+    done_ring = Ring.create(wksp, depth=64, mtu=64)
+    fs = Fseq(wksp)
+    ctx = SimpleNamespace(
+        tile_name="pack",
+        plan={"links": {"txns": {"mtu": 1280},
+                        "bank0": {"mtu": 16384},
+                        "done0": {"mtu": 64}}},
+        in_rings={"txns": txn_ring, "done0": done_ring},
+        out_rings={"bank0": bank_ring},
+        out_fseqs={"bank0": [fs]},
+        in_seqs0=lambda: {"txns": 0, "done0": 0})
+    tile = PackAdapter(ctx, {
+        "txn_in": "txns", "bank_links": ["bank0"],
+        "done_links": ["done0"], "max_txn_per_microblock": 1,
+        "wave": 8, "slot_ms": 1e9})
+    for i, t in enumerate(make_signed_txns(8, seed=23)):
+        txn_ring.publish(t, sig=i)
+    tile.poll_once()
+    assert len(tile.busy[0]) == 2       # depth-capped, not wave-capped
+    assert bank_ring.seq == 2
+
+
+def test_pack_scheduler_multi_outstanding_no_cross_bank_conflict():
+    """Wave discipline invariant: with several microblocks outstanding
+    per bank, no txn in flight on bank A writes an account any txn in
+    flight on bank B touches (brute force on the raw account sets,
+    never trusting the bitsets)."""
+    import random
+
+    from firedancer_tpu.pack import PackScheduler, TxnMeta
+    rng = random.Random(7)
+    s = PackScheduler(bank_cnt=2)
+    for i in range(64):
+        accts = rng.sample(range(24), k=3)
+        s.insert(TxnMeta(
+            payload=bytes([i]), txn=None, reward=rng.randint(1, 9999),
+            cost=10_000,
+            writes=tuple(bytes([a]) * 32 for a in accts[:2]),
+            reads=(bytes([accts[2]]) * 32,)))
+    for _ in range(20):
+        bank = rng.randrange(2)
+        if s.outstanding_cnt(bank) < 4:
+            s.schedule_microblock(bank)
+        elif s.outstanding_cnt(bank):
+            s.microblock_done(bank)
+        a, b = s.outstanding(0), s.outstanding(1)
+        for ma in a:
+            for mb in b:
+                aw, ar = set(ma.writes), set(ma.reads)
+                bw, br = set(mb.writes), set(mb.reads)
+                assert not (aw & bw) and not (aw & br) \
+                    and not (ar & bw)
+
+
+# ---------------------------------------------------------------------------
+# Bank: device-wave execution == per-microblock == serial oracle
+# ---------------------------------------------------------------------------
+
+def test_bank_wave_execution_matches_serial_oracle():
+    """Concatenating a wave of microblocks into ONE staged dispatch is
+    bit-identical to executing the microblocks one block at a time,
+    and both match the serial host oracle."""
+    import random
+
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.svm.executor import (SystemTxn, WaveExecutor,
+                                             execute_block,
+                                             execute_block_serial)
+    rng = random.Random(11)
+    keys = [hashlib.sha256(b"k%d" % i).digest() for i in range(12)]
+    bal0 = {k: rng.randint(0, 50_000) for k in keys}
+    txns = [SystemTxn(src=rng.choice(keys), dst=rng.choice(keys),
+                      amount=rng.randint(0, 20_000),
+                      fee=rng.choice((0, 10, 5_000)))
+            for _ in range(28)]
+    microblocks = [txns[i:i + 7] for i in range(0, len(txns), 7)]
+
+    def fresh_funk():
+        f = Funk()
+        for k, v in bal0.items():
+            f.rec_write(None, k, v)
+        return f
+
+    # (a) serial oracle
+    oracle = dict(bal0)
+    want_st = execute_block_serial(oracle, txns)
+    # (b) one execute_block per microblock
+    f_seq = fresh_funk()
+    st_seq = []
+    for i, mb in enumerate(microblocks):
+        st_seq.extend(execute_block(f_seq, None, f"mb{i}", mb))
+        f_seq.txn_publish(f"mb{i}")
+    # (c) the wave path: stage -> dispatch -> finalize, pipelined the
+    # way the bank tile drives it (stage k+1 before finalize k)
+    f_wave = fresh_funk()
+    wx = WaveExecutor()
+    pending = None
+    st_wave = []
+    waves = [sum(microblocks[i:i + 2], [])
+             for i in range(0, len(microblocks), 2)]
+    for wi, wave in enumerate(waves):
+        staged = wx.stage(wave)
+        if pending is not None:
+            st_wave.extend(wx.finalize(f_wave, pending))
+            f_wave.txn_publish(pending.xid)
+        pending = wx.dispatch(f_wave, None, f"w{wi}", staged)
+    st_wave.extend(wx.finalize(f_wave, pending))
+    f_wave.txn_publish(pending.xid)
+
+    assert st_seq == want_st
+    assert st_wave == want_st
+    for k in keys:
+        assert f_seq.rec_query(None, k) == oracle.get(k, 0) \
+            or (oracle.get(k, 0) == 0
+                and f_seq.rec_query(None, k) in (0, None))
+        assert f_wave.rec_query(None, k) == f_seq.rec_query(None, k)
+
+
+def test_bank_wave_padding_buckets_are_inert():
+    """Padded wave/lane/account slots (the power-of-two jit buckets)
+    never touch live balances: a 1-txn wave and a bucket-boundary wave
+    both match the oracle exactly."""
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.svm.executor import (SystemTxn, execute_block,
+                                             execute_block_serial)
+    a, b = b"\xaa" * 32, b"\xbb" * 32
+    for n in (1, 5, 17):          # crosses the pow2 bucket boundaries
+        txns = [SystemTxn(src=a, dst=b, amount=10, fee=1)
+                for _ in range(n)]
+        funk = Funk()
+        funk.rec_write(None, a, 1_000_000)
+        oracle = {a: 1_000_000}
+        want = execute_block_serial(oracle, txns)
+        got = execute_block(funk, None, "x", txns)
+        funk.txn_publish("x")
+        assert got == want
+        assert funk.rec_query(None, a) == oracle[a]
+        assert funk.rec_query(None, b) == oracle[b]
+
+
+# ---------------------------------------------------------------------------
+# Shred: batched mirror egress
+# ---------------------------------------------------------------------------
+
+def test_shred_mirror_batch_byte_identical(wksp):
+    """The leader core's buffered mirror egress publishes exactly the
+    wires (and sigs) the per-shred path published, in order."""
+    from firedancer_tpu.shred.shred_dest import ClusterNode
+    from firedancer_tpu.tiles.shred import ShredLeaderCore
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    from firedancer_tpu.utils.ed25519_ref import keypair, sign
+    from tests.test_shred_tile import _gen_entries
+    seed = bytes(range(32))
+    _, _, pub = keypair(seed)
+    sent = []
+
+    class _Sock:
+        def sendto(self, wire, addr):
+            sent.append(bytes(wire))
+
+    mirror = Ring.create(wksp, depth=256, mtu=1280)
+    core = ShredLeaderCore(
+        lambda root: sign(seed, root), pub,
+        [ClusterNode(b"\x55" * 32, 100, ("127.0.0.1", 9))], _Sock(),
+        out_ring=mirror, out_fseqs=[])
+    txns = make_signed_txns(4, seed=31)
+    frames, _ = _gen_entries(5, [txns[:2], txns[2:]])
+    for f in frames:
+        core.on_entry(f)
+    assert core._egress and not mirror.seq     # buffered, not shipped
+    n = core.flush_egress()
+    got, _ = _drain(mirror)
+    assert n == len(got) == len(sent) > 0
+    assert [w for w, _ in got] == sent         # byte-identical, in order
+    for wire, sig in got:
+        idx, = struct.unpack_from("<I", wire, 0x49)
+        assert sig == idx
+    assert core.flush_egress() == 0            # drained
+
+
+# ---------------------------------------------------------------------------
+# verify_tile_cnt >= 2: rr-sharded topology expansion + live loop
+# ---------------------------------------------------------------------------
+
+def test_sharded_tile_expansion():
+    """Builder + config expansion: N shards share the ins, own one out
+    link each, carry rr_cnt/rr_idx, distribute list args, and pin
+    cpu0+i."""
+    from firedancer_tpu.disco import Topology
+    topo = (
+        Topology("shardx")
+        .link("ingest", depth=64).link("vd0", depth=64)
+        .link("vd1", depth=64).link("out", depth=64)
+        .tcache("tc0").tcache("tc1").tcache("dtc")
+        .tile("synth", "synth", outs=["ingest"], count=4)
+        .sharded_tile("verify", "verify", 2, ins=["ingest"],
+                      outs=["vd0", "vd1"], cpu0=3, batch=16,
+                      tcache=["tc0", "tc1"])
+        .tile("dedup", "dedup", ins=["vd0", "vd1"], outs=["out"],
+              tcache="dtc")
+        .tile("sink", "sink", ins=["out"]))
+    for i in range(2):
+        t = topo.tiles[f"verify{i}"]
+        assert t.args["rr_cnt"] == 2 and t.args["rr_idx"] == i
+        assert t.args["cpu_idx"] == 3 + i
+        assert t.args["tcache"] == f"tc{i}"
+        assert t.outs == [f"vd{i}"]
+        assert [i_["link"] for i_ in t.ins] == ["ingest"]
+    # config-side: tile_cnt on a [[tile]] stanza expands identically
+    from firedancer_tpu.app.config import build_topology
+    cfg = {
+        "link": [{"name": "ingest", "depth": 64},
+                 {"name": "vd0", "depth": 64},
+                 {"name": "vd1", "depth": 64},
+                 {"name": "out", "depth": 64}],
+        "tcache": [{"name": "tc0"}, {"name": "tc1"},
+                   {"name": "dtc"}],
+        "tile": [
+            {"name": "synth", "kind": "synth", "outs": ["ingest"],
+             "count": 4},
+            {"name": "verify", "kind": "verify", "tile_cnt": 2,
+             "ins": ["ingest"], "outs": ["vd0", "vd1"],
+             "batch": 16, "tcache": ["tc0", "tc1"], "cpu0": 1},
+            {"name": "dedup", "kind": "dedup",
+             "ins": ["vd0", "vd1"], "outs": ["out"], "tcache": "dtc"},
+            {"name": "sink", "kind": "sink", "ins": ["out"]},
+        ],
+    }
+    topo2 = build_topology(cfg, name="shardy")
+    assert set(topo2.tiles) == {"synth", "verify0", "verify1",
+                                "dedup", "sink"}
+    assert topo2.tiles["verify1"].args["rr_idx"] == 1
+    assert topo2.tiles["verify1"].args["tcache"] == "tc1"
+    # and the static pass accepts the sharded model (incl. the
+    # list-valued tcache arg)
+    from firedancer_tpu.lint.graph import lint_config, lint_topology
+    assert not [f for f in lint_topology(topo2) if f.level == "error"]
+    assert not [f for f in lint_config(cfg, "<cfg>")
+                if f.level == "error"]
+
+
+@pytest.mark.slow
+def test_leader_loop_with_two_verify_tiles():
+    """Conformance with verify_tile_cnt=2: the full leader loop
+    (synth -> verify x2 rr-sharded -> dedup -> pack -> bank(svm waves)
+    -> poh) executes every funded transfer exactly once, mixes every
+    microblock into a chain that re-verifies, and both shards carry
+    traffic — dedup stays the cross-shard convergence point."""
+    import time
+
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    from firedancer_tpu.tiles.synth import synth_signer_seed
+    from firedancer_tpu.utils.ed25519_ref import keypair
+    os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+    n = 24
+    genesis = {keypair(synth_signer_seed(i))[-1].hex(): 1 << 44
+               for i in range(16)}
+    topo = (
+        Topology(f"l2v{os.getpid()}", wksp_size=1 << 25)
+        .link("ingest", depth=128, mtu=1280)
+        .link("vd0", depth=128, mtu=1280)
+        .link("vd1", depth=128, mtu=1280)
+        .link("dedup_pack", depth=128, mtu=1280)
+        .link("pack_bank0", depth=32, mtu=1 << 15)
+        .link("bank0_done", depth=32, mtu=64)
+        .link("bank0_poh", depth=64, mtu=64)
+        .link("poh_entries", depth=2048, mtu=256)
+        .link("poh_slots", depth=64, mtu=64)
+        .tcache("vtc0", depth=4096).tcache("vtc1", depth=4096)
+        .tcache("dedup_tc", depth=4096)
+        .tile("synth", "synth", outs=["ingest"], count=n, unique=n,
+              seed=6)
+        .sharded_tile("verify", "verify", 2, ins=["ingest"],
+                      outs=["vd0", "vd1"], batch=16,
+                      tcache=["vtc0", "vtc1"])
+        .tile("dedup", "dedup", ins=["vd0", "vd1"],
+              outs=["dedup_pack"], tcache="dedup_tc")
+        .tile("pack", "pack",
+              ins=["dedup_pack", "bank0_done", "poh_slots"],
+              outs=["pack_bank0"], txn_in="dedup_pack",
+              bank_links=["pack_bank0"], done_links=["bank0_done"],
+              slot_in="poh_slots", max_txn_per_microblock=8, wave=4)
+        .tile("bank0", "bank", ins=["pack_bank0"],
+              outs=["bank0_done", "bank0_poh"], exec="svm", wave=4,
+              poh_link="bank0_poh", genesis=genesis)
+        .tile("poh", "poh", ins=["bank0_poh"],
+              outs=["poh_entries", "poh_slots"],
+              slot_link="poh_slots", hashes_per_tick=16,
+              ticks_per_slot=4)
+        .tile("entsink", "sink", ins=["poh_entries"]))
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=540)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            runner.check_failures()
+            if runner.metrics("bank0")["transfers"] == n and \
+                    runner.metrics("poh")["mixins"] \
+                    == runner.metrics("bank0")["microblocks"]:
+                break
+            time.sleep(0.05)
+        b = runner.metrics("bank0")
+        assert b["transfers"] == n and b["exec_fail"] == 0
+        v0, v1 = (runner.metrics(f"verify{i}") for i in (0, 1))
+        # disjoint rr ownership covers every frag exactly once
+        assert v0["rx"] + v1["rx"] == n
+        assert v0["rx"] > 0 and v1["rx"] > 0
+        assert v0["verify_fail"] == v1["verify_fail"] == 0
+        assert runner.metrics("dedup")["tx"] == n
+        assert runner.metrics("poh")["mixins"] == b["microblocks"]
+    finally:
+        runner.halt()
+        runner.close()
+
+
+# ---------------------------------------------------------------------------
+# Synth: ramp schedule token integral
+# ---------------------------------------------------------------------------
+
+def test_synth_ramp_earned_integral():
+    from firedancer_tpu.disco.tiles import SynthAdapter
+    sa = SynthAdapter.__new__(SynthAdapter)
+    sa.ramp = None
+    sa.rate_tps = 100.0
+    assert sa._earned(0.5) == 50
+    sa.ramp = [(1.0, 100.0), (2.0, 50.0)]
+    assert sa._earned(0.5) == 50
+    assert sa._earned(1.0) == 100
+    assert sa._earned(2.0) == 150
+    assert sa._earned(3.0) == 200
+    # past the schedule: the LAST stanza's rate holds
+    assert sa._earned(5.0) == 300

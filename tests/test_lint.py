@@ -505,6 +505,66 @@ def test_per_frag_loop_tcache_insert():
         """), "per-frag-loop")
 
 
+def test_per_frag_loop_pack_bank_fill_shape():
+    """The pre-r13 pack shape — one credit-checked publish per idle
+    bank inside the bank loop — is exactly what the wave rewrite
+    removed; the rule must keep it out (publish_batch outside the
+    loop is the fix)."""
+    fires_once(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                for bank, ln in enumerate(self.bank_links):
+                    out = self.ctx.out_rings[ln]
+                    fseqs = self.ctx.out_fseqs[ln]
+                    if fseqs and out.credits(fseqs) <= 0:
+                        continue
+                    metas = self.sched.schedule_microblock(bank)
+                    if not metas:
+                        continue
+                    out.publish(self._serialize(bank, 0, metas), sig=0)
+        """), "per-frag-loop")
+
+
+def test_per_frag_loop_bank_microblock_shape():
+    """The pre-r13 bank shape — per-gathered-frame execution emitting
+    its poh + completion publishes inside the frame loop — stays
+    flagged; the wave rewrite batches both publishes per poll."""
+    f = _tiles_findings("""
+        class T:
+            def poll_once(self):
+                self._wait_credits()
+                for i in range(n):
+                    frame = bytes(buf[i])
+                    self._execute(frame)
+                    self.poh_out.publish(frame, sig=1)
+                    self.out.publish(b"done", sig=1)
+        """)
+    assert rule_count(f, "per-frag-loop") == 2
+
+
+def test_per_frag_loop_wave_batch_shape_is_clean():
+    """The r13 wave shape — schedule/serialize in the loop, ONE
+    publish_batch after it (while-resume on backpressure) — is the
+    rule-clean rewrite of both pack and bank."""
+    assert rule_count(_tiles_findings("""
+        class T:
+            def poll_once(self):
+                frames = []
+                for bank in range(self.n_banks):
+                    metas = self.sched.schedule_microblock(bank)
+                    if metas:
+                        frames.append(self._serialize(bank, 0, metas))
+                start = 0
+                while True:
+                    stop, pub = self.out.publish_batch(
+                        wb, sz, ids, mask, fseqs=self.fseqs,
+                        start=start)
+                    start = stop
+                    if start >= len(frames):
+                        break
+        """), "per-frag-loop") == 0
+
+
 def test_per_frag_loop_outside_hot_path_is_clean():
     """A per-frag loop in a function poll_once never reaches (boot
     code, test helpers) is not a hot-path defect."""
