@@ -140,34 +140,47 @@ def _child_bench():
         "compile_s": round(compile_s, 1),
     }
 
-    if on_tpu and os.environ.get("FDTPU_BENCH_SKIP_RLC") != "1":
-        # bulk pre-filter path: Pallas MSM RLC batch verification
-        # (cofactored semantics — ops/pallas_msm.py docstring). The
-        # hardware run doubles as the kernel's correctness gate: the
-        # all-valid batch must pass, and a forged lane must fail it.
+    if os.environ.get("FDTPU_BENCH_SKIP_RLC") != "1":
+        # bulk pre-filter path: RLC batch verification (cofactored
+        # semantics — ops/pallas_msm.py docstring), the ROADMAP 1b
+        # rlc_bulk_vps stanza. The hardware run doubles as the
+        # kernel's correctness gate: the all-valid batch must pass,
+        # and a forged lane must fail it. On CPU the jnp limb kernel
+        # runs a SMALL batch (the MSM graph compiles in minutes and
+        # verifies a few hundred lanes/s — the number is recorded for
+        # the platform, the witnessed-fallback carries the chip one)
+        # so CPU-only CI still exercises + records the stanza.
         try:
-            from firedancer_tpu.ops import pallas_msm as pmsm
+            rfn = ed.rlc_verify_fn()   # shared platform dispatch
+            if on_tpu:
+                rbatch, rargs = batch, args
+            else:
+                rbatch = min(batch, int(os.environ.get(
+                    "FDTPU_BENCH_RLC_CPU_BATCH", "16")))
+                rargs = tuple(a[:rbatch] for a in args)
             zrng = np.random.default_rng(7)
-            z = jnp.asarray(zrng.integers(0, 256, (batch, 16),
+            z = jnp.asarray(zrng.integers(0, 256, (rbatch, 16),
                                           dtype=np.uint8))
-            rfn = jax.jit(lambda s, p, m, l, zz:
-                          pmsm.rlc_verify_batch_tpu(s, p, m, l, zz))
             t0 = time.perf_counter()
-            ok, pre = rfn(*args, z)
+            ok, pre = rfn(*rargs, z)
             jax.block_until_ready((ok, pre))
             rlc_compile_s = time.perf_counter() - t0
             assert bool(ok) and bool(np.asarray(pre).all()), \
                 "rlc: valid batch failed"
-            bad_sig = np.array(sig)
-            bad_sig[3, :32] ^= 0xFF        # corrupt lane 3's R
-            ok2, pre2 = rfn(jnp.asarray(bad_sig), *args[1:], z)
-            assert not bool(ok2) and bool(np.asarray(pre2)[3]), \
+            bad_msg = np.array(msg[:rbatch])
+            bad_msg[3, 0] ^= 0x01          # forge lane 3's message:
+            ok2, _ = rfn(rargs[0], rargs[1],  # prechecks still pass,
+                         jnp.asarray(bad_msg),  # the equation must not
+                         rargs[3], z)
+            assert not bool(ok2), \
                 "rlc: forged lane not caught by the batch equation"
+            riters = iters if on_tpu else max(2, iters)
             t0 = time.perf_counter()
-            outs = [rfn(*args, z) for _ in range(iters)]
+            outs = [rfn(*rargs, z) for _ in range(riters)]
             jax.block_until_ready(outs)
             rdt = time.perf_counter() - t0
-            out_rec["rlc_bulk_vps"] = round(batch * iters / rdt, 1)
+            out_rec["rlc_bulk_vps"] = round(rbatch * riters / rdt, 1)
+            out_rec["rlc_bulk_batch"] = rbatch
             out_rec["rlc_compile_s"] = round(rlc_compile_s, 1)
         except Exception as e:  # noqa: BLE001 — annotate, don't break
             out_rec["rlc_error"] = f"{e!r}"[:200]
@@ -652,6 +665,405 @@ def _leader_bench():
     sys.stdout.flush()
 
 
+def _flood_topology(shed_stakes: dict, slo_floor: float | None,
+                    pool: int, rate_pps: float = 300.0):
+    """The front-door topology the adversarial soak attacks: a real
+    UDP sock door (per-peer policing + stake-weighted shedding,
+    disco/shed.py) feeding a bulk_prefilter verify tile (RLC batch
+    equation ahead of strict — tiles/verify.py r14), dedup, sink, and
+    the metric tile whose SLO engine is the pass/fail judge."""
+    from firedancer_tpu.disco import Topology
+    slo = None
+    if slo_floor is not None:
+        # the judge: staked goodput at the sink must hold the floor.
+        # burn_fast 1.0 = a breach means the floor was missed for the
+        # WHOLE fast window — boot/drain edges and the attack-onset
+        # transient (the ring briefly fills with garbage before the
+        # watermark flips the door to stake-weighted shedding) don't
+        # page, a SUSTAINED collapse does. The window is cpu-scaled
+        # (the r11 wedge_timeout_s precedent): on a 1-2 core CI box
+        # the floor is ~25 txns/window and scheduler-descheduling a
+        # healthy 6-process topology for a second dents a 4 s window
+        # ~20% — so small boxes judge at attack length (the criterion
+        # is literally "goodput over the attack >= 80% of clean"),
+        # real hosts keep the stricter 4 s acuity.
+        fast_s = 4.0 if (os.cpu_count() or 1) >= 4 else 8.0
+        slo = {"fast_window_s": fast_s, "slow_window_s": 20.0,
+               "burn_fast": 1.0, "burn_slow": 0.5,
+               "target": [{"name": "flood_goodput",
+                           "expr": f"sink.rx rate > {slo_floor}/s"}]}
+    topo = (
+        Topology(f"flood{os.getpid()}", wksp_size=1 << 26,
+                 slo=slo,
+                 shed={"rate_pps": float(os.environ.get(
+                           "FDTPU_BENCH_FLOOD_RATE_PPS", "0"))
+                       or rate_pps,
+                       # burst bounds the bucket-funded onset spike: a
+                       # Sybil swarm's FIRST packets all ride fresh
+                       # buckets (token buckets cannot police a peer
+                       # that brings a new identity per burst — that
+                       # is the overload gate's job), so sybils*burst
+                       # is garbage the door admits before the
+                       # watermark trips, every frame of it strict-
+                       # kernel work stolen from staked traffic
+                       "burst": 4, "max_peers": 64, "min_stake": 1,
+                       # the hold is the overload duty cycle: each
+                       # expiry is a recovery probe that re-admits one
+                       # bucket-funded burst before the watermark
+                       # re-trips, so floor the hold at attack length
+                       # — ONE admission window per soak; recovery
+                       # latency is bounded by the same expiry either
+                       # way (the drain phase asserts it)
+                       "overload_hold_s": 8.0,
+                       "stakes": shed_stakes})
+        # the ingest ring is deliberately SHALLOW: queued garbage is
+        # latency the staked traffic pays behind it, and the sock
+        # watermark (shed armed, credits <= depth/2) flips to
+        # stake-weighted shedding while there is still room — a deep
+        # ring would just buy the flood a bigger backlog to age in
+        # (and every queued garbage frame is a strict dispatch the
+        # verify tile owes before staked traffic behind it moves)
+        .link("sock_verify", depth=32, mtu=1280)
+        .link("verify_dedup", depth=1024, mtu=1280)
+        .link("dedup_sink", depth=1024, mtu=1280)
+        .tcache("verify_tc", depth=max(8192, 2 * pool))
+        .tcache("dedup_tc", depth=max(8192, 2 * pool))
+        .tile("sock", "sock", outs=["sock_verify"], port=0, batch=32)
+        .tile("verify", "verify", ins=["sock_verify"],
+              outs=["verify_dedup"], batch=16, tcache="verify_tc",
+              # coalesce paced trickles toward full chunks: a strict
+              # dispatch costs the same fixed-shape kernel whatever
+              # the fill, and the prefilter engages on FULL chunks
+              coalesce_us=150000,
+              mode="bulk_prefilter")
+        .tile("dedup", "dedup", ins=["verify_dedup"],
+              outs=["dedup_sink"], tcache="dedup_tc", batch=256)
+        .tile("sink", "sink", ins=["dedup_sink"], batch=256))
+    if slo is not None:
+        topo.tile("metric", "metric", port=0)
+    return topo
+
+
+class _PacedSender:
+    """Daemon thread pacing datagrams at aggregate `pps`, rotating
+    round-robin over one or more bound sockets (each socket = one peer
+    identity at the door). The staked client is a single socket; the
+    Sybil swarm is ONE thread over `sybils` sockets — same identities
+    and aggregate rate as a thread per Sybil, but without handing the
+    scheduler dozens of competing sender threads on a small CI box
+    (the soak judges the FRONT DOOR, not harness-side contention)."""
+
+    def __init__(self, frames: list, port: int, pps: float,
+                 sock=None, nsocks: int = 1):
+        import socket as socket_mod
+        import threading
+        if sock is not None:
+            self.socks = [sock]
+        else:
+            self.socks = [socket_mod.socket(socket_mod.AF_INET,
+                                            socket_mod.SOCK_DGRAM)
+                          for _ in range(nsocks)]
+        self.frames, self.port, self.pps = frames, port, pps
+        self.sent = 0
+        self._stop = threading.Event()
+        self._thr = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thr.start()
+        return self
+
+    def _run(self):
+        t0 = time.perf_counter()
+        while not self._stop.is_set():
+            budget = int((time.perf_counter() - t0) * self.pps)
+            while self.sent < budget and not self._stop.is_set():
+                self.socks[self.sent % len(self.socks)].sendto(
+                    self.frames[self.sent % len(self.frames)],
+                    ("127.0.0.1", self.port))
+                self.sent += 1
+            time.sleep(0.002)
+
+    def stop(self):
+        self._stop.set()
+        self._thr.join(timeout=5)
+        for s in self.socks:
+            s.close()
+        return self.sent
+
+
+def _flood_bench():
+    """Adversarial flood soak (r14, ROADMAP item 4): boot the
+    front-door topology, measure clean staked goodput, then attack it
+    with a seeded forged-sig flood at >= FLOOD_MULT x the clean rate
+    from a Sybil swarm of unstaked peers — with the SLO engine as the
+    judge (goodput floor 80% of clean), zero watchdog trips, and the
+    per-peer table bounded. Prints one JSON line with the flood_* +
+    rlc_prefilter_vps record.
+
+    CPU note: the jnp RLC kernel bounds the whole soak at a few
+    hundred tps (PERF.md flood methodology) — the numbers are small
+    but the DYNAMICS (door shedding, overload duty cycle, prefilter
+    chunk shedding, SLO hold) are the same ones the chip run sees;
+    the witnessed-fallback carries the TPU-scale numbers."""
+    import socket as socket_mod
+
+    sys.path.insert(0, HERE)
+    from firedancer_tpu.disco import TopologyRunner
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    from firedancer_tpu.utils.chaos import attack_frames
+
+    probe_pps = float(os.environ.get("FDTPU_BENCH_FLOOD_PROBE_PPS",
+                                     "80"))
+    mult = float(os.environ.get("FDTPU_BENCH_FLOOD_MULT", "4"))
+    attack_s = float(os.environ.get("FDTPU_BENCH_FLOOD_S", "8"))
+    sybils = int(os.environ.get("FDTPU_BENCH_FLOOD_SYBILS", "24"))
+    clean_s = 6.0
+    pool = int(probe_pps * (clean_s + attack_s + 40))
+    txns = make_signed_txns(pool, seed=23)
+    forged = attack_frames("flood_forged", 64, seed=29)
+
+    # the staked identity binds first so its "ip:port" key can be in
+    # the topology's [shed.stakes] table
+    staked_sock = socket_mod.socket(socket_mod.AF_INET,
+                                    socket_mod.SOCK_DGRAM)
+    staked_sock.bind(("127.0.0.1", 0))
+    skey = f"127.0.0.1:{staked_sock.getsockname()[1]}"
+    out = {}
+
+    def _port(runner):
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            p = runner.metrics("sock").get("port")
+            if p:
+                return int(p)
+            time.sleep(0.05)
+        raise TimeoutError("sock port never published")
+
+    # --- boot 1: capacity probe (the clean knee of this box) --------------
+    # saturating paced run: achieved goodput == the pipeline's capacity
+    # on this host (on a 1-core CI box the whole 5-process topology
+    # shares one CPU, so this is tens of tps; on real hosts/TPU it is
+    # the strict-kernel rate — the protocol is host-relative by design)
+    runner = TopologyRunner(
+        _flood_topology({skey: 1000}, None, pool,
+                        rate_pps=2 * probe_pps).build()).start()
+    try:
+        runner.wait_running(timeout_s=840)
+        port = _port(runner)
+        sender = _PacedSender(txns, port, probe_pps,
+                              sock=staked_sock).start()
+        time.sleep(2.0)                  # pipeline fill excluded
+        rx0 = runner.metrics("sink")["rx"]
+        t0 = time.perf_counter()
+        time.sleep(clean_s)
+        cap_tps = (runner.metrics("sink")["rx"] - rx0) \
+            / (time.perf_counter() - t0)
+        sender._stop.set()
+        sender._thr.join(timeout=5)
+
+        # staked offered rate sits WELL UNDER capacity so the clean
+        # run is unsaturated (a goodput baseline measured at the knee
+        # would just re-measure capacity) and the attack must steal
+        # headroom to breach; the flood itself is sized against
+        # CAPACITY (>= mult x the clean knee per the protocol).
+        # Capped by the pre-rendered txn pool: the staked sender signs
+        # host-side from a FINITE pool, and on a host fast enough that
+        # 0.33*capacity outruns it the sender would wrap — every
+        # replayed frame dedup-drops and the judged goodput collapses
+        # for a harness reason, not a front-door one. ~120 s covers
+        # the worst-case remaining protocol (clean ref + SLO wait +
+        # baseline + attack + drain + exercise).
+        clean_pps = max(4.0, float(os.environ.get(
+            "FDTPU_BENCH_FLOOD_CLEAN_PPS", "0")) or 0.33 * cap_tps)
+        clean_pps = min(clean_pps, (pool - sender.sent) / 120.0)
+
+        # unsaturated clean REFERENCE on the same boot: the SLO floor
+        # is 80% of what this host actually DELIVERS at clean_pps, not
+        # 80% of the offered rate — on a loaded CI box achieved runs a
+        # few % under offered and that gap would silently tighten the
+        # judge's bar past the acceptance criterion ("80% of clean-run
+        # goodput"). 8 s drains the saturated probe's backlog first
+        # (ring + verify in-flight hold ~100 frames; at a small-box
+        # capacity of ~20 tps that tail would otherwise inflate the
+        # reference measurement).
+        sent_clean = sender.sent
+        sender = _PacedSender(txns[sent_clean:], port, clean_pps,
+                              sock=staked_sock).start()
+        time.sleep(8.0)
+        rx0 = runner.metrics("sink")["rx"]
+        t0 = time.perf_counter()
+        time.sleep(4.0)
+        clean_ref = (runner.metrics("sink")["rx"] - rx0) \
+            / (time.perf_counter() - t0)
+        sender._stop.set()
+        sender._thr.join(timeout=5)
+        sent_clean += sender.sent
+    finally:
+        runner.halt()
+        runner.close()
+    out["flood_capacity_tps"] = round(cap_tps, 1)
+    out["flood_clean_ref_tps"] = round(clean_ref, 1)
+    floor = round(0.8 * min(clean_ref, clean_pps), 1)
+    attack_pps = max(mult * cap_tps, 4 * clean_pps)
+
+    # --- boot 2: the attack, judged by the SLO engine ---------------------
+    txns_b = txns[sent_clean:]
+    runner = TopologyRunner(
+        _flood_topology({skey: 1000}, floor, pool,
+                        rate_pps=max(20.0, 3 * clean_pps))
+        .build()).start()
+    senders, flood = [], []
+    try:
+        runner.wait_running(timeout_s=840)
+        port = _port(runner)
+        sender = _PacedSender(txns_b, port, clean_pps,
+                              sock=staked_sock).start()
+        senders.append(sender)
+        # let the engine see the clean floor held before attacking
+        # (the boot window legitimately starts breached: rate 0)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if runner.metrics("metric")["slo_evals"] > 0 \
+                    and runner.metrics("metric")["slo_breach"] == 0:
+                break
+            time.sleep(0.2)
+        assert runner.metrics("metric")["slo_breach"] == 0, \
+            "clean staked traffic never satisfied the SLO floor"
+        pre_breaches = runner.metrics("metric")["slo_breaches"]
+        # the clean-run goodput baseline, measured unsaturated
+        rx0 = runner.metrics("sink")["rx"]
+        t0 = time.perf_counter()
+        time.sleep(4.0)
+        clean_tps = (runner.metrics("sink")["rx"] - rx0) \
+            / (time.perf_counter() - t0)
+        out["flood_clean_tps"] = round(clean_tps, 1)
+
+        rx0 = runner.metrics("sink")["rx"]
+        t0 = time.perf_counter()
+        flood = [_PacedSender(forged, port, attack_pps,
+                              nsocks=sybils).start()]
+        peers_peak, breach_ticks = 0, 0
+        while time.perf_counter() - t0 < attack_s:
+            runner.check_failures()
+            m = runner.metrics("sock")
+            peers_peak = max(peers_peak, m["peers"])
+            if runner.metrics("metric")["slo_breach"]:
+                breach_ticks += 1
+            time.sleep(0.2)
+        wall = time.perf_counter() - t0
+        goodput = (runner.metrics("sink")["rx"] - rx0) / wall
+        attack_sent = sum(s.stop() for s in flood)
+        flood = []
+        # drain: the attack is over (the staked sender keeps running —
+        # the engine's rate floor should judge recovery under normal
+        # traffic, and the exercise phase below still needs it); the
+        # engine must CLEAR (recovery is part of the overload
+        # contract) and no ring may be wedged
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            runner.check_failures()
+            if runner.metrics("metric")["slo_breach"] == 0:
+                break
+            time.sleep(0.2)
+        sockm = runner.metrics("sock")
+        trips = sum(runner.metrics(t).get("sup_watchdog_trips", 0)
+                    for t in ("sock", "verify", "dedup", "sink",
+                              "metric"))
+        # the ratio denominator is capped at the OFFERED clean rate:
+        # the in-place clean window starts right after the boot fill,
+        # so its measurement can catch queued backlog draining through
+        # and read a few % above what the sender actually paced — an
+        # inflated baseline would demand goodput the staked client
+        # never even offered
+        clean_eff = min(clean_tps, clean_pps)
+        out.update({
+            "flood_goodput_tps": round(goodput, 1),
+            "flood_goodput_ratio": round(goodput / clean_eff, 3)
+            if clean_eff else 0.0,
+            "flood_offered_attack_pps": round(attack_sent / wall, 1),
+            "flood_attack_mult": round(attack_sent / wall / clean_tps,
+                                       2) if clean_tps else 0.0,
+            "flood_shed_pct": round(100.0 * sockm["shed"]
+                                    / max(1, sockm["shed"]
+                                          + sockm["rx"]), 1),
+            "flood_peers_peak": peers_peak,
+            "flood_peers_bound": sockm["peers"] <= 64,
+            "flood_slo_breaches": runner.metrics("metric")
+            ["slo_breaches"] - pre_breaches,
+            "flood_slo_breach_final": runner.metrics("metric")
+            ["slo_breach"],
+            "flood_watchdog_trips": trips,
+        })
+
+        # --- prefilter exercise (rlc_prefilter_vps) -----------------------
+        # the judged numbers above are FROZEN; now deterministically
+        # exercise the WIRED RLC path for its throughput stanza. A
+        # well-tuned door sheds the whole soak at the socket (the
+        # desired outcome!) and a PACED flood never piles the ring
+        # high enough for full chunks — so after each overload hold
+        # expires, BLAST one back-to-back burst from fresh Sybil
+        # identities (fresh buckets admit until the ring is full,
+        # ~depth frames in under a millisecond): the verify gathers go
+        # full, chunks assemble at `batch` lanes, and every one of
+        # them must cross the RLC equation.
+        import socket as socket_mod
+        for _ in range(2):
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                runner.check_failures()
+                if runner.metrics("sock")["overload"] == 0:
+                    break
+                time.sleep(0.2)
+            blast = [socket_mod.socket(socket_mod.AF_INET,
+                                       socket_mod.SOCK_DGRAM)
+                     for _ in range(sybils)]
+            sent_b = 0
+            for _ in range(4):           # ~4*sybils frames, instantly
+                for s in blast:
+                    s.sendto(forged[sent_b % len(forged)],
+                             ("127.0.0.1", port))
+                    sent_b += 1
+            time.sleep(4.0)              # let verify chew the chunks
+            for s in blast:
+                s.close()
+        runner.check_failures()
+        verifym = runner.metrics("verify")
+        out.update({
+            "flood_rlc_shed": verifym["rlc_shed"],
+            "flood_rlc_batches": verifym["rlc_batches"],
+            "flood_rlc_lanes": verifym["rlc_lanes"],
+            "flood_rlc_pass": verifym["rlc_pass"],
+            "flood_verify_fail": verifym["verify_fail"],
+        })
+        if verifym["rlc_ns"] and verifym["rlc_lanes"] >= 32:
+            # only a real measurement (attack + exercise combined):
+            # two full chunks minimum — compile happened at boot and
+            # every call rides the one pinned shape, so the ratio is
+            # steady-state kernel time, not warmup noise; the chip run
+            # sees far more lanes through the same counters
+            out["rlc_prefilter_vps"] = round(
+                verifym["rlc_lanes"] * 1e9 / verifym["rlc_ns"], 1)
+        sender._stop.set()
+        sender._thr.join(timeout=5)
+        # zero falsely-accepted frags: everything at the sink is a
+        # staked txn (forged/shed traffic must never land) — asserted
+        # across clean + attack + drain + exercise
+        assert runner.metrics("sink")["rx"] <= sender.sent + 1, \
+            "forged frags reached the sink"
+    finally:
+        for s in flood:
+            s.stop()
+        runner.halt()
+        runner.close()
+    staked_sock.close()
+    out["flood_pass"] = (out.get("flood_slo_breaches", 1) == 0
+                         and out.get("flood_watchdog_trips", 1) == 0
+                         and out.get("flood_peers_bound", False)
+                         and out.get("flood_goodput_ratio", 0) >= 0.8)
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _run_child(env_extra: dict, timeout_s: float,
                require_key: str | None = "metric"):
     """Spawn bench.py as a child with extra env; return the last JSON
@@ -680,6 +1092,9 @@ def main():
         return
     if os.environ.get("FDTPU_BENCH_LEADER_CHILD") == "1":
         _leader_bench()
+        return
+    if os.environ.get("FDTPU_BENCH_FLOOD_CHILD") == "1":
+        _flood_bench()
         return
     if os.environ.get("FDTPU_BENCH_CHILD") == "1":
         _child_bench()
@@ -764,6 +1179,27 @@ def main():
                     result[k] = v
         except Exception as e4:  # noqa: BLE001
             result["e2e_leader_error"] = f"{e4!r}"[:300]
+
+    # adversarial flood soak (r14): the front-door topology under a
+    # seeded forged-sig flood, SLO engine as judge — runs on every
+    # platform (CPU numbers are small but the shedding/overload/
+    # prefilter dynamics are identical; PERF.md flood methodology).
+    if os.environ.get("FDTPU_BENCH_SKIP_FLOOD") != "1":
+        try:
+            env = {"FDTPU_BENCH_FLOOD_CHILD": "1"}
+            if result.get("platform", "").startswith("cpu"):
+                env["FDTPU_JAX_PLATFORM"] = "cpu"
+                env["JAX_PLATFORMS"] = "cpu"
+            fl = _run_child(
+                env,
+                float(os.environ.get("FDTPU_BENCH_FLOOD_TIMEOUT",
+                                     "1200")),
+                require_key="flood_goodput_tps")
+            for k, v in fl.items():
+                if k.startswith("flood_") or k.startswith("rlc_"):
+                    result[k] = v
+        except Exception as e5:  # noqa: BLE001
+            result["flood_error"] = f"{e5!r}"[:300]
 
     # bench-trend gate (fdbench): compare this round against the
     # previous BENCH json — kernel vps / e2e tps / knee regressions

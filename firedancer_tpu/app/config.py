@@ -82,12 +82,25 @@ Schema:
                              #  sources: tile.metric, tile.wait|work|tpu,
                              #  link.<link>.<counter>
 
+    [shed]                   # front-door policing (disco/shed.py):
+    rate_pps = 1000.0        #  per-peer token buckets, bounded peer
+    max_peers = 4096         #  table, stake-weighted overload shedding
+    min_stake = 1            #  — read by the ingest tiles (sock/quic/
+                             #  gossip); [shed.stakes] maps peer keys
+                             #  ("ip:port" / origin hex) to stake
+
+    [tile.shed]              # per-tile override (same keys; highest
+    rate_pps = 50.0          #  precedence, like [tile.trace])
+
     [[tile.chaos.events]]    # seeded fault plan (utils/chaos.py):
     action = "crash"         #  crash | freeze_hb | wedge | stall_fseq
     at_rx = 24               #  | fail_dispatch (verify tile); fire at
                              #  stem iteration (at_iter) or cumulative
                              #  frags consumed (at_rx); [lo, hi] picks
-                             #  seeded-uniform from tile.chaos.seed
+                             #  seeded-uniform from tile.chaos.seed;
+                             #  traffic plans (flood_forged | flood_dup
+                             #  | flood_torsion | flood_malformed_quic
+                             #  | flood_crds_spam) add frames= + seed=
 
 Unknown top-level sections are rejected (typo safety — the reference
 validates its config the same way, fd_config_validate); a bad
@@ -111,7 +124,7 @@ except ModuleNotFoundError:          # py<3.11
                 "install 'tomli'") from e
 
 _TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo",
-                 "prof"}
+                 "prof", "shed"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -160,7 +173,7 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
             if key in layer:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
-        for key in ("topology", "trace", "slo", "prof"):
+        for key in ("topology", "trace", "slo", "prof", "shed"):
             if key in layer:
                 merged = _deep_merge(cfg.get(key, {}), layer[key])
                 if key == "slo" and "target" in layer[key]:
@@ -220,9 +233,16 @@ def build_topology(cfg: dict, name: str | None = None):
     prof_cfg = cfg.get("prof")
     if prof_cfg is not None:
         normalize_prof(prof_cfg)
+    # [shed] front-door policing — same gate (disco/shed.py is the one
+    # validator; per-tile `shed` overrides validate at topo.build)
+    from ..disco.shed import normalize_shed
+    shed_cfg = cfg.get("shed")
+    if shed_cfg is not None:
+        normalize_shed(shed_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
                     wksp_size=int(top.get("wksp_size", 1 << 26)),
-                    trace=trace_cfg, slo=slo_cfg, prof=prof_cfg)
+                    trace=trace_cfg, slo=slo_cfg, prof=prof_cfg,
+                    shed=shed_cfg)
     for ln in cfg.get("link", []):
         topo.link(ln["name"], depth=int(ln.get("depth", 128)),
                   mtu=int(ln.get("mtu", 1280)))

@@ -143,6 +143,14 @@ class Stem:
                 if self._stalled_links is None:
                     self._stalled_links = set()
                 self._stalled_links.add(ev["link"])   # None = all links
+            else:
+                # adversarial traffic plans (utils/chaos.py
+                # TRAFFIC_ACTIONS): the stem records the injection (the
+                # chaos_event above) and hands the event to the tile
+                # adapter, which owns rendering + flooding the frames
+                hook = getattr(self.tile, "on_chaos", None)
+                if hook is not None:
+                    hook(ev)
 
     def _stop_sampler(self):
         """Stop the fdprof sampler on ANY loop exit (halt, fail,

@@ -143,7 +143,7 @@ class SynthAdapter:
     stanza per sweep point; past the schedule's end the last stanza's
     rate holds, so a long tail never silently unpaces)."""
 
-    METRICS = ["tx", "backpressure"]
+    METRICS = ["tx", "backpressure", "attack_tx", "attack_drop"]
 
     def __init__(self, ctx, args):
         import numpy as np
@@ -151,6 +151,12 @@ class SynthAdapter:
         from ..tiles.synth import make_signed_txns
         self.ctx = ctx
         self.count = int(args.get("count", 1024))
+        # adversarial traffic plans (utils/chaos.py TRAFFIC_ACTIONS):
+        # the stem fires the plan's events into on_chaos below; the
+        # synth renders + floods the frames into its out ring
+        self.attack_tx = 0
+        self.attack_drop = 0
+        self._attack_sig = 1 << 48       # ring sigs clear of tx range
         self.burst = int(args.get("burst", 32))
         rt = args.get("rate_tps", 0.0)
         if isinstance(rt, (list, tuple)) and rt:
@@ -212,6 +218,49 @@ class SynthAdapter:
         self.sent += pub
         return pub
 
+    def on_chaos(self, ev: dict):
+        """Adversarial traffic plan hook (stem hands TRAFFIC_ACTIONS
+        events here AFTER recording the EV_CHAOS injection): render
+        the attack pool and flood the out ring at line rate. The flood
+        is credit-gated like all egress but NEVER spins: rows the ring
+        refuses are dropped-newest (attack_drop) — hostile traffic
+        must not be able to wedge the attacker tile's own halt path
+        either."""
+        import numpy as np
+
+        from ..utils.chaos import TRAFFIC_ACTIONS, attack_frames
+        if ev["action"] not in TRAFFIC_ACTIONS:
+            return
+        frames = attack_frames(ev["action"], ev["frames"],
+                               seed=ev["seed"])
+        if not frames:
+            return
+        k = len(frames)
+        wb = np.zeros((k, max(len(f) for f in frames)), np.uint8)
+        sz = np.zeros(k, np.uint32)
+        for i, f in enumerate(frames):
+            wb[i, :len(f)] = np.frombuffer(f, np.uint8)
+            sz[i] = len(f)
+        sigs = np.arange(self._attack_sig, self._attack_sig + k,
+                         dtype=np.uint64)
+        self._attack_sig += k
+        start = 0
+        stalls = 0
+        while start < k:
+            stop, pub = self.out.publish_batch(
+                wb, sz, sigs, np.ones(k, np.uint8),
+                fseqs=self.fseqs, start=start)
+            self.attack_tx += pub
+            if stop == start:
+                stalls += 1
+                if stalls >= 2:          # no credits twice: drop rest
+                    self.attack_drop += k - start
+                    break
+                time.sleep(50e-6)
+                continue
+            stalls = 0
+            start = stop
+
     def _earned(self, dt: float) -> int:
         """Token budget earned after dt seconds: flat rate, or the
         ramp schedule's integral (holding the last stanza's rate past
@@ -227,18 +276,28 @@ class SynthAdapter:
         return int(total + dt * self.ramp[-1][1])
 
     def metrics_items(self):
-        return {"tx": self.sent, "backpressure": self.bp}
+        return {"tx": self.sent, "backpressure": self.bp,
+                "attack_tx": self.attack_tx,
+                "attack_drop": self.attack_drop}
 
 
 @register("verify")
 class VerifyAdapter:
     """TPU sigverify bridge tile (ref: src/disco/verify/fd_verify_tile.h).
     args: batch, max_len, tcache (name), device_retries,
-    device_timeout_s, device_fail_limit, chaos (fault plan)."""
+    device_timeout_s, device_fail_limit, chaos (fault plan),
+    mode ("strict" | "bulk_prefilter" — the r14 RLC flood front door),
+    prefilter_shed (allow shedding all-garbage chunks under
+    saturation; False = filter observes but never drops)."""
 
     METRICS = ["rx", "parse_fail", "dedup_drop", "verify_fail", "tx",
                "overruns", "batches", "backpressure", "device_errors",
                "cpu_fallback",
+               # bulk RLC pre-filter (mode="bulk_prefilter"): equation
+               # runs / passes / lanes / lanes shed / kernel ns — the
+               # rlc_prefilter_vps bench stanza reads lanes & ns
+               "rlc_batches", "rlc_pass", "rlc_lanes", "rlc_shed",
+               "rlc_ns",
                # device telemetry (fdmetrics v2): promoted by the
                # prometheus renderer to fdtpu_tile_tpu_* series
                "tpu_jit_compiles", "tpu_jit_cache_miss",
@@ -281,6 +340,8 @@ class VerifyAdapter:
             device_retries=int(args.get("device_retries", 2)),
             device_fail_limit=int(args.get("device_fail_limit", 3)),
             coalesce_us=float(args.get("coalesce_us", 0.0)),
+            mode=args.get("mode", "strict"),
+            prefilter_shed=bool(args.get("prefilter_shed", True)),
             chaos=args.get("chaos"),
             trace=ctx.trace,
             trace_link=(ctx.trace.link_id(out_ln)
@@ -1092,14 +1153,37 @@ class BankAdapter:
         return dict(self.m)
 
 
+def _shed_for(ctx, args):
+    """Resolve one ingest tile's effective shed table from the plan's
+    [shed] section + the tile's own `shed` override (disco/shed.py).
+    None = no gate object, zero per-packet cost."""
+    from .shed import effective_shed
+    return effective_shed(ctx.plan.get("shed"), args.get("shed"))
+
+
+def _shed_slo_poll(ctx, gate):
+    """Cross-tile overload coupling, polled at housekeeping cadence:
+    an [slo] breach anywhere (the metric tile's slo_breach gauge)
+    trips this tile's door into stake-weighted shedding for the hold
+    window — the explicit overload mode the SLO engine drives."""
+    if gate is None:
+        return
+    from .shed import slo_breach_count
+    if slo_breach_count(ctx.plan, ctx.wksp):
+        gate.trip_overload()
+
+
 @register("sock")
 class SockAdapter:
     """UDP socket ingest (ref: src/disco/net/sock/fd_sock_tile.c).
     args: port (0 = ephemeral; bound port published in metrics),
-    bind_addr, batch, mtu."""
+    bind_addr, batch, mtu, shed (per-tile policing override —
+    disco/shed.py; merged over the topology [shed] section)."""
 
-    METRICS = ["rx", "bytes", "oversz", "backpressure", "port"]
-    GAUGES = ["port"]
+    METRICS = ["rx", "bytes", "oversz", "backpressure", "shed",
+               "shed_unstaked", "shed_overflow", "peers", "overload",
+               "port"]
+    GAUGES = ["peers", "overload", "port"]
 
     def __init__(self, ctx, args):
         from ..tiles.sock import SockTile
@@ -1110,10 +1194,17 @@ class SockAdapter:
             out, fseqs, port=int(args.get("port", 0)),
             bind_addr=args.get("bind_addr", "127.0.0.1"),
             batch=int(args.get("batch", 64)),
-            mtu=int(args.get("mtu", 1500)))
+            mtu=int(args.get("mtu", 1500)),
+            shed=_shed_for(ctx, args))
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
+
+    def housekeeping(self):
+        _shed_slo_poll(self.ctx, self.tile.shed)
+
+    def on_halt(self):
+        self.tile.close()
 
     def metrics_items(self):
         return dict(self.tile.metrics)
@@ -1127,8 +1218,9 @@ class QuicAdapter:
     port in metrics), bind_addr, batch, mtu."""
 
     METRICS = ["rx", "txns", "conns", "bad_pkts", "oversz",
-               "backpressure", "dropped", "replayed", "port"]
-    GAUGES = ["port"]
+               "backpressure", "dropped", "replayed", "shed",
+               "shed_unstaked", "peers", "overload", "port"]
+    GAUGES = ["peers", "overload", "port"]
 
     def __init__(self, ctx, args):
         from ..tiles.quic import QuicTile
@@ -1143,10 +1235,30 @@ class QuicAdapter:
             port=int(args.get("port", 0)),
             bind_addr=args.get("bind_addr", "127.0.0.1"),
             batch=int(args.get("batch", 64)),
-            mtu=min(int(args.get("mtu", 1500)), link_mtu))
+            mtu=min(int(args.get("mtu", 1500)), link_mtu),
+            shed=_shed_for(ctx, args))
+        self._attack_peer = 0
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
+
+    def housekeeping(self):
+        _shed_slo_poll(self.ctx, self.tile.shed)
+
+    def on_chaos(self, ev: dict):
+        """flood_malformed_quic traffic plan: push the hostile
+        datagrams straight through the policed rx path, each from a
+        fresh fake source address (TEST-NET-3) — they must die in the
+        QUIC parser as bad_pkts, never crash, never publish a txn."""
+        from ..utils.chaos import attack_frames
+        if ev["action"] != "flood_malformed_quic":
+            return
+        for f in attack_frames(ev["action"], ev["frames"],
+                               seed=ev["seed"]):
+            self._attack_peer += 1
+            self.tile.inject(
+                f, (f"203.0.113.{self._attack_peer % 254 + 1}",
+                    1024 + self._attack_peer % 60000))
 
     def on_halt(self):
         self.tile.close()
@@ -1870,14 +1982,19 @@ class GossipAdapter:
     """Gossip tile (ref: src/discof/gossip/ + src/flamenco/gossip/):
     CRDS over UDP with signed values. args: seed (hex), port,
     bind_addr, entrypoints (["host:port", ...]), publish (list of
-    {kind, index, data_hex} values to originate at boot)."""
+    {kind, index, data_hex} values to originate at boot),
+    gossvf_bulk (front the gossvf device batch with the RLC bulk
+    kernel — gossip/gossvf.py mode="bulk"), shed (per-tile policing
+    override, merged over the topology [shed] section)."""
 
     METRICS = ["gossvf_bad", "rx", "tx", "values", "contacts",
-               "bad_msg", "port"]
-    GAUGES = ["values", "contacts", "port"]
+               "bad_msg", "shed", "shed_unstaked", "peers",
+               "overload", "port"]
+    GAUGES = ["values", "contacts", "peers", "overload", "port"]
 
     def __init__(self, ctx, args):
         from ..tiles.gossip import GossipTile
+        self.ctx = ctx
         if args.get("device_verify"):
             _setup_jax()
         self.tile = GossipTile(
@@ -1885,7 +2002,10 @@ class GossipAdapter:
             port=int(args.get("port", 0)),
             bind_addr=args.get("bind_addr", "127.0.0.1"),
             entrypoints=args.get("entrypoints", ()),
-            device_verify=bool(args.get("device_verify", False)))
+            device_verify=bool(args.get("device_verify", False)),
+            gossvf_bulk=bool(args.get("gossvf_bulk", False)),
+            shed=_shed_for(ctx, args))
+        self._attack_peer = 0
         for v in args.get("publish", []):
             self.tile.publish(int(v["kind"]), int(v.get("index", 0)),
                               bytes.fromhex(v["data_hex"]))
@@ -1893,8 +2013,25 @@ class GossipAdapter:
     def poll_once(self) -> int:
         return self.tile.poll_once()
 
+    def on_chaos(self, ev: dict):
+        """flood_crds_spam traffic plan: validly signed CRDS push spam
+        from many throwaway unstaked origins (the Sybil flood),
+        injected through the policed rx path from fake TEST-NET-2
+        socket addresses — the bounded peer table + stake gate must
+        absorb it without growing."""
+        from ..utils.chaos import attack_frames
+        if ev["action"] != "flood_crds_spam":
+            return
+        for f in attack_frames(ev["action"], ev["frames"],
+                               seed=ev["seed"]):
+            self._attack_peer += 1
+            self.tile.inject(
+                f, (f"198.51.100.{self._attack_peer % 254 + 1}",
+                    1024 + self._attack_peer % 60000))
+
     def housekeeping(self):
         self.tile.housekeeping()
+        _shed_slo_poll(self.ctx, self.tile.shed)
 
     def on_halt(self):
         self.tile.close()

@@ -84,7 +84,7 @@ class Topology:
 
     def __init__(self, name: str, wksp_size: int = 1 << 26,
                  trace: dict | None = None, slo: dict | None = None,
-                 prof: dict | None = None):
+                 prof: dict | None = None, shed: dict | None = None):
         self.name = name
         self.wksp_size = wksp_size
         self.links: dict[str, LinkSpec] = {}
@@ -99,6 +99,10 @@ class Topology:
         self.slo = slo
         # [prof] continuous-profiler config (prof/recorder.py schema)
         self.prof = prof
+        # [shed] front-door policing defaults (disco/shed.py schema);
+        # ingest tiles resolve their effective gate from this + their
+        # own `shed` override at adapter construction
+        self.shed = shed
 
     def link(self, name: str, depth: int = 128, mtu: int = 1280,
              external: bool = False):
@@ -246,7 +250,16 @@ class Topology:
                         f"prof.{key} names unknown tile(s) "
                         f"{sorted(unknown)}")
             plan["prof"] = prof_cfg
+            # [shed] policing defaults: schema-validated here (the
+            # same fail-before-launch gate as trace/prof/slo) and
+            # carried on the plan for the ingest adapters; per-tile
+            # overrides validate below with the tile loop
+            from .shed import normalize_shed as _norm_shed
+            plan["shed"] = _norm_shed(self.shed) \
+                if self.shed is not None else None
             for tn, t in self.tiles.items():
+                if "shed" in t.args:
+                    _norm_shed(t.args["shed"], per_tile=True)
                 if t.kind == "gui":
                     # [tile.gui] schema gate (gui/schema.py is the one
                     # validator — same three-layer contract as

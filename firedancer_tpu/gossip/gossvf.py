@@ -8,28 +8,106 @@ shape is one `verify_batch` kernel call per packet (or per poll burst)
 instead of per-value host verifies — the same microbatch discipline
 the verify tile applies to transactions.
 
+Bulk pre-filter (r14, mode="bulk"): the RLC MSM batch kernel
+(ops/ed25519.rlc_verify_batch / ops/pallas_msm on accelerators) checks
+the WHOLE packet's signatures as one random-linear-combination
+equation. A passing batch accepts every prechecked lane under the
+COFACTORED semantics that kernel pins (tests/test_rlc.py) — sound for
+CRDS, where a torsion-malleated signature still requires the origin's
+OWN secret key (S = r + k·a), so no third-party value can ever be
+falsely accepted; the store is keyed by origin regardless. A failing
+batch falls back to the strict individual kernel — the existing verify
+path — so forged floods cost one MSM to reject and honest packets
+never lose a legitimately signed value.
+
 Padding: messages pad to the batch max length rounded up to a 64-byte
 bucket so compile shapes stay cacheable across packets.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 MAX_SIGNABLE = 1232            # gossip values ride single datagrams
+
+# per-process secret RLC coefficient stream: z must be unpredictable
+# to value senders (the batch equation's soundness lives in the draw)
+_Z_RNG = np.random.default_rng(
+    int.from_bytes(os.urandom(16), "little"))
+_RLC_FN = None                 # lazily platform-dispatched + jitted
+
+# the bulk equation's ONE pinned shape (the verify-tile discipline:
+# tracing the MSM graph costs minutes on CPU, so the jit must only
+# ever see one shape — warmed up at tile BOOT via warmup_bulk, dead
+# lanes ride z = 0 which zeroes their every scalar term). Packets with
+# more live values than RLC_LANES skip the filter and take the strict
+# path — CRDS packets ride single datagrams, so that is the rare case,
+# and correctness never depends on the filter running.
+RLC_LANES = 32
+RLC_WIDTH = -(-MAX_SIGNABLE // 64) * 64
 
 
 def _bucket(n: int) -> int:
     return max(64, -(-n // 64) * 64)
 
 
-def batch_verify(values) -> list[bool]:
+def _rlc_batch_ok(sig, pub, msg, ln) -> tuple[bool, np.ndarray]:
+    """One RLC batch equation over assembled lanes -> (batch_ok,
+    lane_pre), padded to the pinned (RLC_LANES, RLC_WIDTH) shape. The
+    shared platform-dispatched kernel resolver (ops/ed25519.
+    rlc_verify_fn: Pallas MSM on accelerators, jnp limb kernel on CPU
+    — identical verdict semantics). Oversize packets (> RLC_LANES
+    values) return a failed batch so the caller strict-verifies —
+    never a fresh compile shape mid-run."""
+    global _RLC_FN
+    import jax.numpy as jnp
+    n = sig.shape[0]
+    if n > RLC_LANES:
+        return False, np.zeros(n, bool)
+    if _RLC_FN is None:
+        from ..ops.ed25519 import rlc_verify_fn
+        _RLC_FN = rlc_verify_fn()
+    ps = np.zeros((RLC_LANES, 64), np.uint8)
+    pp = np.zeros((RLC_LANES, 32), np.uint8)
+    pm = np.zeros((RLC_LANES, RLC_WIDTH), np.uint8)
+    pl = np.zeros(RLC_LANES, np.int32)
+    ps[:n], pp[:n] = sig, pub
+    pm[:n, :msg.shape[1]] = msg
+    pl[:n] = ln
+    z = np.zeros((RLC_LANES, 16), np.uint8)
+    z[:n] = _Z_RNG.integers(0, 256, (n, 16), dtype=np.uint8)
+    ok, lane_pre = _RLC_FN(jnp.asarray(ps), jnp.asarray(pp),
+                           jnp.asarray(pm), jnp.asarray(pl),
+                           jnp.asarray(z))
+    return bool(ok), np.asarray(lane_pre)[:n]
+
+
+def warmup_bulk():
+    """Pre-compile the bulk prefilter's one pinned shape NOW — called
+    by the gossip tile at BOOT (the watchdog-exempt window); a mid-run
+    compile would starve heartbeats for minutes on CPU and get a
+    healthy tile killed. Raises on a backend without the kernel so the
+    caller can fall back to individual-only verification."""
+    _rlc_batch_ok(np.zeros((1, 64), np.uint8),
+                  np.zeros((1, 32), np.uint8),
+                  np.zeros((1, 64), np.uint8),
+                  np.zeros(1, np.int32))
+
+
+def batch_verify(values, mode: str = "individual") -> list[bool]:
     """values: [CrdsValue] -> per-value signature verdicts. The common
     case (signable <= MAX_SIGNABLE) verifies on the device as ONE
     batch; oversize values fall back to the host oracle so verdicts
     NEVER diverge from the host path — truncating would wrongly drop
-    legitimately signed large values."""
+    legitimately signed large values.
+
+    mode="bulk" fronts the device batch with the RLC pre-filter (see
+    module docstring); "individual" is the strict per-lane kernel."""
     if not values:
         return []
+    if mode not in ("individual", "bulk"):
+        raise ValueError(f"unknown gossvf mode {mode!r}")
     from ..ops.ed25519 import verify_batch
     from ..utils.ed25519_ref import verify as host_verify
     msgs = [v.signable() for v in values]
@@ -52,6 +130,15 @@ def batch_verify(values) -> list[bool]:
             msg[i, :len(m)] = np.frombuffer(m, np.uint8)
             ln[i] = len(m)
     if int(ln.max(initial=0)) > 0:
+        if mode == "bulk":
+            batch_ok, lane_pre = _rlc_batch_ok(sig, pub, msg, ln)
+            if batch_ok:
+                for i in range(n):
+                    if out[i] is None:
+                        out[i] = bool(lane_pre[i]) and int(ln[i]) > 0
+                return [bool(o) for o in out]
+            # batch equation failed: strict-re-verify the survivors
+            # individually via the existing path (below)
         ok = np.asarray(verify_batch(sig, pub, msg, ln))
         for i in range(n):
             if out[i] is None:

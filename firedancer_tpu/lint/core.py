@@ -100,6 +100,13 @@ RULES: dict[str, tuple[str, str, str]] = {
         "out-of-range ws_max_clients/ws_queue/ws_sndbuf, empty "
         "tps/bench/report strings) — the fdgui v2 knob set, "
         "gui/schema.py normalize_gui"),
+    "bad-shed": (
+        "graph", "error",
+        "[shed] section or per-tile `shed` override rejected by the "
+        "disco/shed.py schema (unknown key with did-you-mean, "
+        "non-positive rate_pps/burst/overload_hold_s, max_peers < 2, "
+        "malformed stakes table), or shed configured on a tile kind "
+        "with no ingest door to police"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

@@ -76,7 +76,7 @@ def model_from_config(cfg: dict) -> dict:
                             "args": args}
     return {"links": links, "tcaches": tcaches, "tiles": tiles,
             "trace": cfg.get("trace"), "slo": cfg.get("slo"),
-            "prof": cfg.get("prof")}
+            "prof": cfg.get("prof"), "shed": cfg.get("shed")}
 
 
 def model_from_topology(topo) -> dict:
@@ -91,7 +91,8 @@ def model_from_topology(topo) -> dict:
     return {"links": links, "tcaches": set(topo.tcaches),
             "tiles": tiles, "trace": getattr(topo, "trace", None),
             "slo": getattr(topo, "slo", None),
-            "prof": getattr(topo, "prof", None)}
+            "prof": getattr(topo, "prof", None),
+            "shed": getattr(topo, "shed", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -236,6 +237,42 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_slo(model, kinds, path, lines))
     out.extend(_check_prof(model, path, lines))
     out.extend(_check_gui(model, lines))
+    out.extend(_check_shed(model, path, lines))
+    return out
+
+
+# tile kinds with an ingest door the shed gate can police (the only
+# readers of an effective shed table — shed on anything else is dead
+# config, flagged so a topo that THINKS it is protected actually is)
+SHED_KINDS = {"sock", "quic", "gossip"}
+
+
+def _check_shed(model, path, lines) -> list[Finding]:
+    """[shed] section + per-tile `shed` overrides: the disco/shed.py
+    schema gate (one validator, same as config load and topo.build),
+    plus a dead-config check — a tile-level shed override on a kind
+    that has no ingest door to police protects nothing."""
+    from ..disco.shed import normalize_shed
+    out: list[Finding] = []
+    spec = model.get("shed")
+    if spec is not None:
+        try:
+            normalize_shed(spec)
+        except Exception as e:
+            out.append(finding("bad-shed", path, 0, f"[shed]: {e}"))
+    for tn, t in model["tiles"].items():
+        if "shed" not in t["args"]:
+            continue
+        try:
+            normalize_shed(t["args"]["shed"], per_tile=True)
+        except Exception as e:
+            _emit(out, lines, "bad-shed", tn, f"tile {tn!r}: {e}")
+            continue
+        if t["kind"] not in SHED_KINDS:
+            _emit(out, lines, "bad-shed", tn,
+                  f"tile {tn!r}: kind {t['kind']!r} has no ingest "
+                  f"door to police — shed is only read by "
+                  f"{sorted(SHED_KINDS)}")
     return out
 
 

@@ -29,6 +29,7 @@ COMMON_KEYS: dict[str, str | None] = {
     "chaos": None,          # utils/chaos.py fault plan
     "trace": None,          # trace/recorder.py per-tile override table
     "prof": None,           # prof/recorder.py per-tile override table
+    "shed": None,           # disco/shed.py per-tile policing override
     "cpu_idx": None,        # launch: sched_setaffinity pin
     "sandbox": None,        # launch: utils/sandbox hardening
     "sandbox_files": None,
@@ -60,6 +61,15 @@ SLO_SECTION_KEYS = ("fast_window_s", "slow_window_s", "burn_fast",
 SLO_TARGET_KEYS = ("name", "expr", "fast_window_s", "slow_window_s",
                    "burn_fast", "burn_slow")
 
+# [shed] topology-section keys (mirror of disco/shed.py SHED_DEFAULTS /
+# TILE_SHED_KEYS — tests/test_shed.py keeps the mirror honest). The
+# per-tile `shed` override (COMMON_KEYS) takes the same table; both are
+# validated by normalize_shed at config load, topo.build, and the graph
+# analyzer's bad-shed rule.
+SHED_SECTION_KEYS = ("enable", "rate_pps", "burst", "max_peers",
+                     "min_stake", "overload_hold_s", "stakes")
+TILE_SHED_KEYS = SHED_SECTION_KEYS
+
 TILE_ARGS: dict[str, dict[str, str | None]] = {
     "synth": {"count": None, "burst": None, "unique": None, "seed": None,
               "rate_tps": None},
@@ -71,7 +81,12 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
                # app/config.py: tile_cnt shards, one out link each,
                # optional cpu0+i core pinning; a list-valued tcache
                # distributes per shard)
-               "tile_cnt": None, "cpu0": None},
+               "tile_cnt": None, "cpu0": None,
+               # front-door bulk pre-filter (r14): mode =
+               # "bulk_prefilter" gates every strict dispatch behind
+               # the RLC batch kernel — fail -> bisect, shed garbage
+               # halves under ingest saturation (tiles/verify.py)
+               "mode": None, "prefilter_shed": None},
     "dedup": {"tcache": TCACHE, "batch": None},
     "pack": {"txn_in": IN, "bank_links": OUT_LIST, "done_links": IN_LIST,
              "slot_in": IN, "bundle_in": IN, "slot_ms": None,
@@ -104,7 +119,11 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
     "playback": {"path": None},
     "gossip": {"seed": None, "port": None, "bind_addr": None,
                "entrypoints": None, "publish": None,
-               "device_verify": None},
+               "device_verify": None,
+               # gossvf bulk pre-filter (r14): front the per-packet
+               # device sigcheck with the RLC batch kernel
+               # (gossip/gossvf.py mode="bulk")
+               "gossvf_bulk": None},
     "snapld": {"path": None, "chunk": None},
     "snapdc": {},
     "snapin": {"format": None},

@@ -667,3 +667,21 @@ def verify_batch_rlc(sig, pub, msg, msg_len, rng=None):
     if bool(ok):
         return np.asarray(lane_pre)
     return np.asarray(verify_batch(sig, pub, msg, msg_len))
+
+
+def rlc_verify_fn():
+    """The platform-dispatched, jitted RLC batch-verify callable: the
+    jnp limb kernel here on CPU, the Pallas MSM kernel on accelerators
+    — identical verdict semantics (tests/test_pallas_msm.py pins the
+    equivalence). The ONE resolver every wired prefilter shares (verify
+    tile, gossvf bulk mode, the bench rlc stanza), so a kernel rename
+    or dispatch change happens in exactly one place. Callers own
+    warmup: tracing the MSM graph costs minutes on CPU, so anything
+    with a heartbeat must call the returned fn once at BOOT (the
+    watchdog-exempt window) at its pinned shape."""
+    if jax.devices()[0].platform == "cpu":
+        fn = rlc_verify_batch
+    else:
+        from . import pallas_msm
+        fn = pallas_msm.rlc_verify_batch_tpu
+    return jax.jit(fn)  # fdlint: disable=missing-donate — callers pass host numpy (copied on transfer), nothing device-resident to donate

@@ -12,7 +12,9 @@ WHERE the time went — instead of a bare before/after number.
         [--threshold 0.05]                      # allowed fractional drop
 
 Gated metrics (higher is better): the kernel vps (`value`), `e2e_tps`,
-and `e2e_knee_tps`. A metric absent from either side is reported but
+`e2e_knee_tps`, the leader knee, and the r14 front-door set
+(`rlc_bulk_vps`, `rlc_prefilter_vps`, `flood_goodput_tps`). A metric
+absent from either side is reported but
 never gated (a CPU-fallback round must not fail the gate for skipping
 e2e — the witnessed_tpu record stands in when present, the same
 fallback bench.py's own FDTPU_BENCH_GATE_E2E uses). The profile top-k
@@ -30,6 +32,11 @@ GATE_METRICS = (
     ("e2e_tps", "e2e tps"),
     ("e2e_knee_tps", "e2e knee tps"),
     ("e2e_leader_knee_tps", "leader knee tps"),
+    # front-door survival (r14): RLC bulk kernel + prefilter rate and
+    # staked goodput under the seeded forged-sig flood
+    ("rlc_bulk_vps", "rlc bulk vps"),
+    ("rlc_prefilter_vps", "rlc prefilter vps"),
+    ("flood_goodput_tps", "flood goodput tps"),
 )
 
 # the knee subset: what bench.py's implicit previous-round gate

@@ -49,9 +49,33 @@ class GossipTile:
     def __init__(self, seed: bytes, port: int = 0,
                  bind_addr: str = "127.0.0.1", entrypoints=(),
                  stake_of=None, now_ms: int = 0,
-                 device_verify: bool = False):
+                 device_verify: bool = False,
+                 gossvf_bulk: bool = False, shed: dict | None = None):
         self.seed = seed
         self.device_verify = device_verify
+        # gossvf bulk pre-filter (r14): verify each packet's CRDS
+        # values through the RLC batch kernel first, individual strict
+        # verify only when the batch equation fails (gossip/gossvf.py
+        # mode="bulk" — cofactored semantics, sound for CRDS where the
+        # only divergence class is the origin malleating its OWN sigs).
+        # Warmed up NOW: construction is the BOOT window (watchdog-
+        # exempt), and gossvf pins one compile shape — a mid-run MSM
+        # trace costs minutes on CPU and would starve heartbeats. A
+        # backend without the kernel degrades to individual-only.
+        self.gossvf_bulk = bool(gossvf_bulk)
+        if self.gossvf_bulk:
+            try:
+                from ..gossip.gossvf import warmup_bulk
+                warmup_bulk()
+            except Exception:            # noqa: BLE001
+                from ..utils import log
+                log.warning("gossip: gossvf bulk warmup failed — "
+                            "individual sigcheck only")
+                self.gossvf_bulk = False
+        self.shed = None
+        if shed is not None:
+            from ..disco.shed import PeerGate
+            self.shed = PeerGate(shed)
         _, _, self.pubkey = keypair(seed)
         self.node = GossipNode(
             self.pubkey, stake_of=stake_of,
@@ -70,7 +94,9 @@ class GossipTile:
         self._tick = 0
         self.metrics = {"gossvf_bad": 0,
                         "rx": 0, "tx": 0, "values": 0, "contacts": 0,
-                        "bad_msg": 0, "port": self.addr[1]}
+                        "bad_msg": 0, "shed": 0, "shed_unstaked": 0,
+                        "peers": 0, "overload": 0,
+                        "port": self.addr[1]}
         self.node.publish_contact_info(self.addr)
 
     # -- addressing ---------------------------------------------------------
@@ -94,6 +120,19 @@ class GossipTile:
 
     # -- rx ----------------------------------------------------------------
 
+    def inject(self, data: bytes, addr):
+        """One datagram through the policed rx path (shared by the
+        socket drain and the chaos traffic injector): the source
+        address is policed BEFORE any parse/crypto work, hostile bytes
+        die as bad_msg — never a crash."""
+        self.metrics["rx"] += 1
+        if self.shed is not None and not self.shed.admit(addr):
+            return
+        try:
+            self._handle(data, addr)
+        except Exception:  # noqa: BLE001 — hostile datagrams drop
+            self.metrics["bad_msg"] += 1
+
     def poll_once(self) -> int:
         n = 0
         while n < 64:
@@ -102,19 +141,30 @@ class GossipTile:
             except BlockingIOError:
                 break
             n += 1
-            self.metrics["rx"] += 1
-            try:
-                self._handle(data, addr)
-            except Exception:  # noqa: BLE001 — hostile datagrams drop
-                self.metrics["bad_msg"] += 1
+            self.inject(data, addr)
+        if n >= 64 and self.shed is not None:
+            # a full drain means ingest outpaces us: trip overload so
+            # unstaked sources shed at the door for the hold window
+            # (no out ring here — saturation IS the pressure signal)
+            self.shed.trip_overload()
         self.metrics["values"] = len(self.node.crds.values)
         self.metrics["contacts"] = len(self.node.crds.contact_infos())
+        if self.shed is not None:
+            self.metrics.update(self.shed.counters())
         return n
 
     def _handle(self, data: bytes, addr):
         view = gw.parse_message(data)
         kind = view["kind"]
         if kind in ("push", "pull_response"):
+            if self.shed is not None and \
+                    not self.shed.admit(view["from"]):
+                # second policing axis: the CRDS SENDER identity (a
+                # Sybil spams validly-signed values from throwaway
+                # origins through one socket — the bounded peer table
+                # + stake gate absorb it; keys are origin pubkey hex,
+                # disjoint from the "ip:port" namespace by format)
+                return
             values = [CrdsValue(v["origin"], v["tag"],
                                 v["payload"][0] if v["tag"] == gw.V_VOTE
                                 else 0,
@@ -124,9 +174,14 @@ class GossipTile:
             pre = False
             if self.device_verify and values:
                 # gossvf: ONE device batch checks the whole packet's
-                # signatures (gossip/gossvf.py); invalid values drop
+                # signatures (gossip/gossvf.py); invalid values drop.
+                # mode="bulk" fronts the check with the RLC MSM kernel
+                # (one batch equation per packet; strict individual
+                # verify only for batches that fail it)
                 from ..gossip.gossvf import batch_verify
-                verdicts = batch_verify(values)
+                verdicts = batch_verify(
+                    values, mode="bulk" if self.gossvf_bulk
+                    else "individual")
                 self.metrics["gossvf_bad"] += \
                     sum(1 for ok in verdicts if not ok)
                 values = [v for v, ok in zip(values, verdicts) if ok]

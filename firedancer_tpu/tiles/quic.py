@@ -5,6 +5,15 @@ completed TPU streams publish into the verify ring via
 fd_tpu_reasm_publish_fast). The socket is nonblocking; each poll drains
 a burst of datagrams through the QUIC server, and every completed
 unidirectional stream publishes one txn frag downstream.
+
+Front-door policing (r14): with a `shed` table configured
+(disco/shed.py), every datagram's source address is policed BEFORE the
+QUIC server spends decrypt/parse work on it (the reference's stance:
+conn quotas ahead of the TPU reasm, src/waltz/quic/). Under
+backpressure with the shed armed, the tile trips overload and
+drain-and-drops a burst (drop-newest at the door — the sock tile's
+discipline), so a flood never ages in the kernel queue and never
+wedges the ring.
 """
 from __future__ import annotations
 
@@ -17,7 +26,7 @@ from ..waltz.quic import QuicServer
 class QuicTile:
     def __init__(self, out_ring, out_fseqs, port: int = 0,
                  bind_addr: str = "127.0.0.1", batch: int = 64,
-                 mtu: int = 1500):
+                 mtu: int = 1500, shed: dict | None = None):
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind((bind_addr, port))
         self.sock.setblocking(False)
@@ -26,6 +35,10 @@ class QuicTile:
         self.batch = batch
         self.mtu = mtu
         self._seq = 0
+        self.shed = None
+        if shed is not None:
+            from ..disco.shed import PeerGate
+            self.shed = PeerGate(shed)
 
         def on_txn(payload: bytes):
             if len(payload) > self.mtu:
@@ -48,14 +61,48 @@ class QuicTile:
         self.server = QuicServer(self.sock, on_txn)
         self.metrics = {"rx": 0, "txns": 0, "conns": 0, "bad_pkts": 0,
                         "oversz": 0, "backpressure": 0, "dropped": 0,
-                        "replayed": 0, "port": 0}
+                        "replayed": 0, "shed": 0, "shed_unstaked": 0,
+                        "peers": 0, "overload": 0, "port": 0}
         self.metrics["port"] = self.sock.getsockname()[1]
 
+    def _shed_counters(self):
+        if self.shed is not None:
+            self.metrics.update(self.shed.counters())
+
+    def inject(self, data: bytes, addr) -> bool:
+        """One datagram through the policed rx path (shared by the
+        socket drain and the chaos traffic injector): shed first, THEN
+        decrypt/parse — hostile bytes die before they cost anything."""
+        if self.shed is not None and not self.shed.admit(addr):
+            return False           # gate counters carry the shed tick
+        self.server.on_datagram(data, addr)
+        return True
+
     def poll_once(self) -> int:
-        # leave datagrams in the kernel buffer while downstream has no
-        # credits (don't decrypt work we'd have to drop)
-        if self.out_fseqs and self.out.credits(self.out_fseqs) <= 0:
+        credits = self.out.credits(self.out_fseqs) if self.out_fseqs \
+            else self.batch
+        if self.shed is not None and self.out_fseqs \
+                and credits <= self.out.depth // 2:
+            # early watermark (the sock tile's rule): shed unstaked
+            # while there is still room for staked
+            self.shed.trip_overload()
+        if self.out_fseqs and credits <= 0:
             self.metrics["backpressure"] += 1
+            if self.shed is None:
+                # leave datagrams in the kernel buffer while downstream
+                # has no credits (don't decrypt work we'd have to drop)
+                return 0
+            # shed armed: trip overload and drain-and-drop a burst so
+            # a flood never ages in the kernel queue (drop-newest at
+            # the door, never a ring wait — the sock tile's contract)
+            self.shed.trip_overload()
+            for _ in range(self.batch):
+                try:
+                    _, addr = self.sock.recvfrom(2048)
+                except OSError:
+                    break
+                self.shed.count_drop(addr)
+            self._shed_counters()
             return 0
         n = 0
         for _ in range(self.batch):
@@ -63,12 +110,13 @@ class QuicTile:
                 data, addr = self.sock.recvfrom(2048)
             except OSError:
                 break
-            self.server.on_datagram(data, addr)
+            self.inject(data, addr)
             n += 1
         m = self.server.metrics
         self.metrics.update(rx=m["pkts"], txns=m["txns"],
                             conns=m["conns"], bad_pkts=m["bad_pkts"],
                             replayed=m["replayed"])
+        self._shed_counters()
         return n
 
     def close(self):

@@ -59,6 +59,30 @@ capacity-bounded (overflow drops are counted); the host-local set is
 sound because ha-dedup tcaches are per-tile and round-robin frag
 ownership is disjoint.
 
+Bulk RLC pre-filter (r14, `[tile.verify] mode = "bulk_prefilter"`):
+a FULL assembled chunk — or any chunk while the ingest-saturation
+window is open — is gated by ONE random-linear-combination batch
+equation (ops/ed25519.rlc_verify_batch on CPU, ops/pallas_msm on
+accelerators, secret per-chunk z) BEFORE the strict dispatch — the
+flood front door ROADMAP item 4 names. Sub-full chunks in peacetime
+skip the equation entirely: the filter's economics only work at batch
+grain (a trickle pays less running the strict kernel directly), and a
+flood by definition fills chunks. The strict kernel stays the
+final accept authority (rlc is cofactored, NOT a consensus drop-in —
+tests/test_rlc.py pins the torsion divergence class), so a batch that
+slips the filter is still judged strictly and zero frags are ever
+falsely accepted. What the filter buys is the flood path: a chunk that
+FAILS the batch equation while ingest is saturated — a FULL chunk is
+its own saturation proof, the hot window covers partial chunks during
+a sustained burst — is bisected, and if BOTH halves fail too (an
+all-garbage chunk — a forged-sig flood at line rate) the whole chunk
+is dropped at MSM cost without spending a strict dispatch; a mixed
+chunk (either half clean) always proceeds to strict so legitimate
+traffic sharing a chunk with garbage is never collateral. A sub-full
+failing chunk off-hot just proceeds to strict (fail-closed, zero
+behavior change in peacetime beyond the one batch check). rlc_* metrics count batches/lanes/sheds and accumulate kernel
+time for the rlc_prefilter_vps bench stanza.
+
 Device robustness: dispatch is wrapped in bounded retry, readback in a
 timeout; a persistent device failure (consecutive errors >=
 device_fail_limit, or a readback timeout) degrades the tile to the CPU
@@ -109,6 +133,14 @@ def parse_batch(buf: np.ndarray, sizes: np.ndarray, seed: bytes):
     return meta, tags
 
 
+# process-local compiled-dispatch cache: the jitted packed-verify fn
+# is a pure function of (batch, max_len, devices, platform), but
+# jax.jit caches per CLOSURE — so N same-shape tiles in one process
+# (rr shards, test suites) would each pay the full strict-kernel
+# compile. Sharing the jit is safe: it holds no tile state.
+_FN_CACHE: dict = {}
+
+
 class _StageBuf:
     """One rotating staging set: a single contiguous host buffer whose
     lane regions (len|sig|pub|msg) are numpy views the native assembler
@@ -140,7 +172,8 @@ class VerifyTile:
                  device_timeout_s: float | None = None,
                  device_fail_limit: int = 3, chaos: dict | None = None,
                  trace=None, trace_link: int = 0,
-                 trace_link_in: int = 0, coalesce_us: float = 0.0):
+                 trace_link_in: int = 0, coalesce_us: float = 0.0,
+                 mode: str = "strict", prefilter_shed: bool = True):
         self.in_ring, self.out_ring, self.tcache = in_ring, out_ring, tcache
         # horizontal sharding: N verify tiles consume the SAME ingest
         # link; tile rr_idx owns frags with seq % rr_cnt == rr_idx
@@ -164,7 +197,28 @@ class VerifyTile:
             "rx": 0, "parse_fail": 0, "dedup_drop": 0, "verify_fail": 0,
             "tx": 0, "overruns": 0, "batches": 0, "backpressure": 0,
             "device_errors": 0, "cpu_fallback": 0,
+            # bulk RLC pre-filter (mode="bulk_prefilter"): equation
+            # runs / passes / lanes checked / lanes shed / kernel ns
+            "rlc_batches": 0, "rlc_pass": 0, "rlc_lanes": 0,
+            "rlc_shed": 0, "rlc_ns": 0,
         }
+        if mode not in ("strict", "bulk_prefilter"):
+            raise ValueError(f"unknown verify mode {mode!r} "
+                             f"(strict | bulk_prefilter)")
+        self.mode = mode
+        self.prefilter_shed = bool(prefilter_shed)
+        self._rlc_fn = None
+        # per-tile secret RLC coefficient stream: the batch equation's
+        # soundness lives in z being unpredictable to txn senders
+        # (tests rig _draw_z to pin the torsion divergence class)
+        self._rlc_rng = np.random.default_rng(
+            int.from_bytes(os.urandom(16), "little"))
+        # ingest-saturation clock: a full gather means the ring is
+        # outpacing us — the prefilter may shed all-garbage chunks
+        # only inside this window (drop-newest under pressure, never
+        # in peacetime)
+        self._hot_until = 0
+        self._hot_hold_ns = 100_000_000
         # graceful degradation: bounded retry around dispatch, timeout
         # around readback; persistent failure flips to the CPU reference
         # path instead of killing the tile (the watchdog-visible metric
@@ -259,18 +313,24 @@ class VerifyTile:
             bsz, mlen = batch, max_len
             o_sig, o_pub = 4 * bsz, (4 + 64) * bsz
             o_msg = o_pub + 32 * bsz
+            fn_key = (bsz, mlen, ndev,
+                      jax.devices()[0].platform)
+            if fn_key in _FN_CACHE:
+                self._fn = _FN_CACHE[fn_key]
+            else:
+                def _packed(flat):
+                    import jax.numpy as jnp
+                    lb = flat[:o_sig].reshape(bsz, 4).astype(jnp.int32)
+                    ln = (lb[:, 0] | (lb[:, 1] << 8) | (lb[:, 2] << 16)
+                          | (lb[:, 3] << 24))
+                    return vb(flat[o_sig:o_pub].reshape(bsz, 64),
+                              flat[o_pub:o_msg].reshape(bsz, 32),
+                              flat[o_msg:].reshape(bsz, mlen), ln)
 
-            def _packed(flat):
-                import jax.numpy as jnp
-                lb = flat[:o_sig].reshape(bsz, 4).astype(jnp.int32)
-                ln = (lb[:, 0] | (lb[:, 1] << 8) | (lb[:, 2] << 16)
-                      | (lb[:, 3] << 24))
-                return vb(flat[o_sig:o_pub].reshape(bsz, 64),
-                          flat[o_pub:o_msg].reshape(bsz, 32),
-                          flat[o_msg:].reshape(bsz, mlen), ln)
-
-            donate = (0,) if jax.devices()[0].platform != "cpu" else ()
-            self._fn = jax.jit(_packed, donate_argnums=donate)
+                donate = (0,) if jax.devices()[0].platform != "cpu" \
+                    else ()
+                self._fn = _FN_CACHE[fn_key] = jax.jit(
+                    _packed, donate_argnums=donate)
         else:
             raise ValueError(backend)
         # pipelined dispatch: keep up to `inflight` device batches in
@@ -310,6 +370,27 @@ class VerifyTile:
             self.metrics["device_errors"] += 1
         else:
             self._degrade("device warmup failed")
+        if self.mode == "bulk_prefilter" and not self.degraded \
+                and os.environ.get(
+                    "FDTPU_VERIFY_SKIP_RLC_WARMUP") != "1":
+            # pre-compile the prefilter's ONE shape NOW (BOOT is
+            # watchdog-exempt; a mid-run compile would starve
+            # heartbeats and get a healthy tile killed). A backend
+            # without the RLC kernel falls back to strict-only — the
+            # prefilter is a flood optimization, never the authority.
+            # The skip env is for tests that inject a host-oracle
+            # _rlc_fn (tracing + compiling the MSM graph costs minutes
+            # on CPU — tier-1 exercises the wiring against the oracle,
+            # the slow suite runs the real kernel).
+            try:
+                self._rlc_ok(self._bufsets[0], 0, min(2, self.batch),
+                             self.batch)
+            except Exception:            # noqa: BLE001
+                self.metrics["device_errors"] += 1
+                self.mode = "strict"
+                from ..utils import log
+                log.warning("verify: rlc prefilter warmup failed — "
+                            "strict-only mode")
         self.compile_ns = monotonic_ns() - warmup_t0
 
     def _warmup_once(self, bs: _StageBuf) -> bool:
@@ -354,6 +435,87 @@ class VerifyTile:
         guard), so the async transfer always reads stable memory."""
         import jax
         return self._fn(jax.device_put(bs.flat))
+
+    def _draw_z(self, n: int) -> np.ndarray:
+        """Secret per-chunk RLC coefficients (n,16) u8. A method so the
+        evasion tests can rig the draw into the documented divergence
+        class (z ≡ 0 mod 8 keeps a torsion residual invisible to the
+        cofactored equation — tests/test_rlc.py)."""
+        return self._rlc_rng.integers(0, 256, (n, 16), dtype=np.uint8)
+
+    def _rlc_ok(self, bs: _StageBuf, start: int, stop: int,
+                width: int) -> bool:
+        """One cofactored RLC batch equation over assembled lanes
+        [start, stop), padded to `width` (= batch everywhere, so the
+        jit only ever sees ONE shape: tracing the MSM graph costs
+        minutes on CPU and a mid-run retrace would starve heartbeats
+        and trip the wedge watchdog; bisect halves just ride the full
+        width with dead lanes). Pad lanes carry z = 0, which zeroes
+        every one of their scalar terms — an identity contribution to
+        the sum regardless of what the stale lane bytes decode to.
+        Platform-dispatched like gossvf: the Pallas MSM kernel on
+        accelerators, the jnp limb kernel on CPU — identical verdict
+        semantics (tests/test_pallas_msm.py).
+
+        Lanes failing structural prechecks are masked OUT of the sum,
+        so a chunk where every live lane is structural garbage passes
+        the equation vacuously — that counts as a FAILURE here
+        (nothing survived the prechecks, the all-garbage-flood
+        signature), while a mixed chunk keeps its masked pass and
+        proceeds to strict."""
+        if self._rlc_fn is None:
+            from ..ops.ed25519 import rlc_verify_fn
+            self._rlc_fn = rlc_verify_fn()
+        import jax.numpy as jnp
+        k = stop - start
+        sig = np.zeros((width, 64), np.uint8)
+        pub = np.zeros((width, 32), np.uint8)
+        msg = np.zeros((width, self.max_len), np.uint8)
+        ln = np.zeros(width, np.int32)
+        sig[:k] = bs.sig[start:stop]
+        pub[:k] = bs.pub[start:stop]
+        msg[:k] = bs.msg[start:stop]
+        ln[:k] = bs.ln[start:stop]
+        z = np.zeros((width, 16), np.uint8)
+        z[:k] = self._draw_z(k)
+        ok, pre = self._rlc_fn(jnp.asarray(sig), jnp.asarray(pub),
+                               jnp.asarray(msg), jnp.asarray(ln),
+                               jnp.asarray(z))
+        return bool(ok) and bool(np.asarray(pre)[:k].any())
+
+    def _rlc_prefilter(self, bs: _StageBuf, lanes: int) -> bool:
+        """The flood front door: one RLC batch equation per assembled
+        chunk, BEFORE the strict dispatch. Returns False only when the
+        chunk should be SHED (equation failed while ingest is
+        saturated — chunk full, or the hot window open — AND both
+        bisection halves fail too — an all-garbage
+        chunk, the forged-sig-flood signature); True always proceeds
+        to the strict kernel, which remains the sole accept authority
+        (rlc is cofactored — tests/test_rlc.py pins the divergence)."""
+        t0 = monotonic_ns()
+        self.metrics["rlc_batches"] += 1
+        self.metrics["rlc_lanes"] += lanes
+        ok = self._rlc_ok(bs, 0, lanes, self.batch)
+        keep = True
+        if ok:
+            self.metrics["rlc_pass"] += 1
+        elif self.prefilter_shed and lanes >= 2:
+            # the caller already attested saturation (full chunk, or
+            # the hot window open at assembly) — deliberately NOT
+            # re-sampled here: the equation above costs real wall time
+            # on slow backends (~175ms on the CPU jnp kernel) and the
+            # shed decision must reflect the ingest state the chunk
+            # ARRIVED under, not whether the window survived the
+            # filter's own latency
+            # bisect: a mixed chunk (either half clean) ALWAYS goes to
+            # strict so legitimate traffic sharing a chunk with garbage
+            # is never collateral; only an all-garbage chunk sheds
+            h = lanes // 2
+            self.metrics["rlc_batches"] += 2
+            keep = self._rlc_ok(bs, 0, h, self.batch) \
+                or self._rlc_ok(bs, h, lanes, self.batch)
+        self.metrics["rlc_ns"] += monotonic_ns() - t0
+        return keep
 
     def _hb_tick(self, i: int):
         """Heartbeat every few host verifies: a pure-Python ed25519
@@ -464,10 +626,15 @@ class VerifyTile:
         batch k+1, hiding the tunnel's per-dispatch latency.
         Returns number of frags CONSUMED (0 only when the ring was idle)."""
         self._drain(block=False)
+        want = self.batch - self._hold_n
         n, self.seq, buf, sizes, sigs, ovr, seqs = self.in_ring.gather(
-            self.seq, self.batch - self._hold_n, self.max_len,
-            want_seqs=True)
+            self.seq, want, self.max_len, want_seqs=True)
         self.metrics["overruns"] += ovr
+        if self.mode != "strict" and (n >= want or ovr):
+            # a full gather (or an overrun) means ingest is outpacing
+            # us: open the prefilter's shed window for the hold —
+            # refreshed while saturation persists, expires on its own
+            self._hot_until = monotonic_ns() + self._hot_hold_ns
         if not n:
             # idle ingest: a held sub-batch dispatches now rather than
             # waiting for traffic that may never come — unless batches
@@ -616,6 +783,20 @@ class VerifyTile:
                 bs.txn.ctypes.data_as(_i32p))
             if not lanes:
                 break
+            if self.mode == "bulk_prefilter" and not self.degraded \
+                    and (lanes >= self.batch
+                         or monotonic_ns() < self._hot_until) \
+                    and not self._rlc_prefilter(bs, lanes):
+                # all-garbage chunk under ingest saturation: shed the
+                # whole chunk at MSM cost — an all-False verdict array
+                # (never forwarded) instead of a strict dispatch. The
+                # strict kernel stays the accept authority for every
+                # chunk that is NOT shed, so nothing is ever accepted
+                # on the cofactored equation alone.
+                self.metrics["rlc_shed"] += lanes
+                chunks.append((np.zeros(self.batch, bool),
+                               bs.txn[:lanes].copy()))
+                continue
             fut = self._dispatch(bs, lanes)
             if not isinstance(fut, np.ndarray):
                 self._bufset_fut[k] = fut

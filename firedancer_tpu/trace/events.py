@@ -70,6 +70,11 @@ FRAG_EVENTS = {EV_CONSUME, EV_PUBLISH}
 CHAOS_ACTION_IDS = {
     "crash": 1, "freeze_hb": 2, "wedge": 3, "stall_fseq": 4,
     "fail_dispatch": 5,
+    # adversarial traffic plans (r14): injected hostile TRAFFIC, not
+    # infrastructure faults — recorded before the frames flow so a
+    # black-box dump names the attack even when the tile died mid-flood
+    "flood_forged": 6, "flood_torsion": 7, "flood_dup": 8,
+    "flood_malformed_quic": 9, "flood_crds_spam": 10,
 }
 CHAOS_ACTION_NAMES = {v: k for k, v in CHAOS_ACTION_IDS.items()}
 

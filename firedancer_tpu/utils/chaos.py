@@ -38,13 +38,44 @@ Action understood by the verify tile (tiles/verify.py):
 
   fail_dispatch  fail the next `count` device dispatches (count=-1:
                  every dispatch — the persistent-TPU-loss drill)
+
+Adversarial TRAFFIC plans (r14): the same schema also carries attack
+actions — instead of breaking infrastructure they inject hostile
+traffic, fired by the stem into the tile adapter's `on_chaos` hook
+(the synth tile renders and floods the frames at line rate). Each
+event takes the shared triggers plus `frames` (how many to inject):
+
+  flood_forged          parse-valid txns with forged signatures at
+                        line rate (the sigverify front door's worst
+                        case: every lane burns device work and fails)
+  flood_torsion         RLC-evasion batch: signatures whose residual
+                        is a pure 8-torsion point — passes the NAIVE
+                        cofactored batch equation when the z draw
+                        cooperates; the deployed prefilter must still
+                        reject every one (tests/test_rlc.py is the
+                        semantics oracle)
+  flood_dup             duplicate storm: one valid txn replayed
+                        (dedup-window pressure, zero new work earned)
+  flood_malformed_quic  garbage datagrams wearing QUIC long headers
+                        (parse-fail pressure on quic/verify)
+  flood_crds_spam       gossip CRDS push spam: validly signed values
+                        from many throwaway (unstaked) origins — the
+                        Sybil flood the bounded peer table must absorb
+
+Every injection is recorded as an EV_CHAOS trace event BEFORE the
+frames flow (trace/events.CHAOS_ACTION_IDS stays in lockstep with
+ACTIONS — tests/test_trace.py), so a post-mortem names the attack even
+when the tile died mid-flood.
 """
 from __future__ import annotations
 
+import hashlib
 import random
 
 STEM_ACTIONS = ("crash", "freeze_hb", "wedge", "stall_fseq")
-ACTIONS = STEM_ACTIONS + ("fail_dispatch",)
+TRAFFIC_ACTIONS = ("flood_forged", "flood_torsion", "flood_dup",
+                   "flood_malformed_quic", "flood_crds_spam")
+ACTIONS = STEM_ACTIONS + ("fail_dispatch",) + TRAFFIC_ACTIONS
 
 
 class ChaosPlan:
@@ -72,6 +103,13 @@ class ChaosPlan:
             parsed = {"action": act, "fired": False,
                       "link": ev.get("link"),
                       "code": int(ev.get("code", 70))}
+            if act in TRAFFIC_ACTIONS:
+                # traffic plans carry a frame budget and a per-event
+                # seed derived from the plan seed (same plan -> same
+                # attack bytes; the generators below are deterministic)
+                parsed["frames"] = int(ev.get("frames", 256))
+                parsed["seed"] = int(ev.get("seed",
+                                            rng.randint(0, 1 << 30)))
             for key in ("at_iter", "at_rx"):
                 if key in ev:
                     v = ev[key]
@@ -112,3 +150,143 @@ class ChaosPlan:
 class ChaosDeviceError(RuntimeError):
     """Injected device-dispatch failure (distinguishable in logs from a
     real device error, handled identically by the fallback path)."""
+
+
+# ---------------------------------------------------------------------------
+# adversarial traffic generators (seeded, deterministic)
+# ---------------------------------------------------------------------------
+#
+# Each generator pre-renders a SMALL pool of hostile payloads (the
+# expensive host crypto runs once) which attack_frames replays
+# cyclically to the requested frame count — the benchg discipline: the
+# flood's hot loop is a pool replay, never per-frame signing.
+
+_POOL = 8           # distinct payloads per action pool
+
+
+def _torsion_point():
+    """A nonzero 8-torsion point in host-reference arithmetic: clear
+    the prime-order component of an arbitrary curve point ([L]P lies
+    in E[8]); keep drawing until the torsion part is nonzero AND has
+    exact order 8 (the class test_rlc.py pins)."""
+    from . import ed25519_ref as ref
+    for i in range(256):
+        y = int.from_bytes(hashlib.sha256(b"tors-%d" % i).digest(),
+                           "little") % ref.P
+        pt = ref.pt_decompress(y.to_bytes(32, "little"))
+        if pt is None:
+            continue
+        t = ref.pt_mul(ref.L, pt)
+        zi = pow(t[2], ref.P - 2, ref.P)
+        aff = (t[0] * zi % ref.P, t[1] * zi % ref.P)
+        if aff == (0, 1):
+            continue                     # pure prime-order point
+        # exact order 8: [4]T is not the identity
+        q = ref.pt_mul(4, t)
+        zi = pow(q[2], ref.P - 2, ref.P)
+        if (q[0] * zi % ref.P, q[1] * zi % ref.P) != (0, 1):
+            return t
+    raise AssertionError("no order-8 torsion point found")
+
+
+def torsion_sign(seed_bytes: bytes, msg: bytes) -> tuple[bytes, bytes]:
+    """RLC-evasion forgery with OUR OWN key: R* = rB + T with T pure
+    8-torsion, S = r + k·a — the scalar relation holds, so the batch
+    residual is exactly −z·T. Individual (cofactorless) verification
+    ALWAYS rejects; the naive cofactored batch equation accepts iff
+    the z draw kills the torsion (z ≡ 0 mod 8, p = 1/8) — the exact
+    divergence class tests/test_rlc.py pins. Returns (pub, sig)."""
+    from . import ed25519_ref as ref
+    a, prefix, pub = ref.keypair(seed_bytes)
+    r = int.from_bytes(hashlib.sha512(prefix + b"t" + msg).digest(),
+                       "little") % ref.L
+    r_star = ref.pt_add(ref.pt_mul(r, ref.BASEPOINT), _torsion_point())
+    rb = ref.pt_compress(r_star)
+    k = int.from_bytes(hashlib.sha512(rb + pub + msg).digest(),
+                       "little") % ref.L
+    s = (r + k * a) % ref.L
+    return pub, rb + s.to_bytes(32, "little")
+
+
+def _txn_pool(action: str, n: int, seed: int) -> list[bytes]:
+    from ..tiles.synth import make_signed_txns
+    if action == "flood_dup":
+        # duplicate storm: ONE valid txn — every replay is dedup work
+        return make_signed_txns(1, seed=seed)
+    if action == "flood_torsion":
+        return make_signed_txns(n, seed=seed, signer=torsion_sign)
+    txns = make_signed_txns(n, seed=seed)
+    out = []
+    for i, t in enumerate(txns):
+        bad = bytearray(t)
+        # corrupt inside the signature AND the message so the dedup
+        # tag differs per frame (a forged flood must not collapse into
+        # the dedup tile's duplicate path)
+        bad[5 + (i % 32)] ^= 0x40
+        bad[-1 - (i % 8)] ^= 0x01
+        out.append(bytes(bad))
+    return out
+
+
+def malformed_quic_datagrams(n: int, seed: int = 0,
+                             size: int = 512) -> list[bytes]:
+    """Garbage datagrams wearing a QUIC long header (version +
+    Initial-ish type bits, then noise): cheap to generate at line
+    rate, must die in the QUIC parser as bad_pkts — never a crash,
+    never a txn."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        body = bytes(rng.getrandbits(8) for _ in range(size - 5))
+        out.append(bytes([0xC0 | (i & 0x3F)])
+                   + b"\x00\x00\x00\x01" + body)
+    return out
+
+
+def crds_spam_datagrams(n_peers: int, per_peer: int = 2,
+                        seed: int = 0) -> list[bytes]:
+    """Gossip CRDS push spam: VALIDLY SIGNED NodeInstance values from
+    `n_peers` throwaway origins, encoded as real push containers — the
+    Sybil flood: every signature verifies, every origin is unstaked,
+    so only the peer table bound + stake-weighted shedding stop it."""
+    from ..flamenco import gossip_wire as gw
+    from ..gossip.crds import CrdsValue, KIND_NODE_INSTANCE
+    from . import ed25519_ref as ref
+    out = []
+    rng = random.Random(seed)
+    for p in range(n_peers):
+        kseed = hashlib.sha256(b"crds-spam-%d-%d" % (seed, p)).digest()
+        _, _, pub = ref.keypair(kseed)
+        vals = []
+        for j in range(per_peer):
+            # NodeInstance payload (56B fixed on the wire): pubkey +
+            # wallclock + token + instance id (gossip_wire
+            # _payload_size/V_NODE_INSTANCE)
+            wallclock = 1_000_000 + p * 1000 + j
+            data = pub + wallclock.to_bytes(8, "little") \
+                + rng.getrandbits(64).to_bytes(8, "little") \
+                + rng.getrandbits(64).to_bytes(8, "little")
+            v = CrdsValue(pub, KIND_NODE_INSTANCE, 0, wallclock, data)
+            sig = ref.sign(kseed, v.signable())
+            vals.append(CrdsValue(pub, KIND_NODE_INSTANCE, 0,
+                                  wallclock, data, sig))
+        out.append(gw.encode_container(
+            gw.MSG_PUSH, pub, [v.to_wire() for v in vals]))
+    return out
+
+
+def attack_frames(action: str, frames: int, seed: int = 0) -> list[bytes]:
+    """Render `frames` hostile payloads for a traffic-plan action —
+    deterministic in (action, seed), pool-replayed so generation cost
+    is O(pool), not O(frames)."""
+    if action not in TRAFFIC_ACTIONS:
+        raise ValueError(f"unknown traffic action {action!r}")
+    if frames <= 0:
+        return []
+    if action == "flood_malformed_quic":
+        pool = malformed_quic_datagrams(min(frames, _POOL), seed=seed)
+    elif action == "flood_crds_spam":
+        pool = crds_spam_datagrams(min(frames, _POOL), seed=seed)
+    else:
+        pool = _txn_pool(action, min(frames, _POOL), seed)
+    return [pool[i % len(pool)] for i in range(frames)]

@@ -295,3 +295,83 @@ def test_stalled_consumer_fseq_recovers_via_watchdog():
     finally:
         runner.halt(join_timeout_s=10)
         runner.close()
+
+
+# -- adversarial traffic plans (r14) ----------------------------------------
+
+def test_traffic_plan_schema_and_deterministic_frames():
+    """Traffic-plan events carry a frame budget + per-event seed
+    derived from the plan seed: same plan -> same attack bytes; the
+    CHAOS_ACTION_IDS lockstep (test_trace) covers the new actions."""
+    from firedancer_tpu.utils.chaos import attack_frames
+    spec = {"seed": 7, "events": [
+        {"action": "flood_forged", "at_iter": 10, "frames": 32},
+        {"action": "flood_crds_spam", "at_iter": 20}]}
+    a = ChaosPlan(spec).events
+    b = ChaosPlan(spec).events
+    assert a[0]["frames"] == 32 and a[1]["frames"] == 256  # default
+    assert [e["seed"] for e in a] == [e["seed"] for e in b]
+    assert attack_frames("flood_forged", 8, seed=a[0]["seed"]) \
+        == attack_frames("flood_forged", 8, seed=b[0]["seed"])
+    with pytest.raises(ValueError, match="unknown traffic action"):
+        attack_frames("flood_meteor", 4)
+    assert attack_frames("flood_dup", 0) == []
+
+
+def test_attack_plan_injection_survives_tile_crash():
+    """An attack plan's injection events survive the attacker tile's
+    own crash mid-flood (the stalled-consumer drill's contract,
+    extended to traffic actions): the stem records EV_CHAOS BEFORE
+    rendering frames, so the supervisor's black-box dump names the
+    attack — flood first, crash after — and the flooded frames
+    already reached the sink."""
+    import json
+
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    from firedancer_tpu.trace.events import CHAOS_ACTION_IDS
+    topo = (
+        Topology(f"atkbb{os.getpid()}", wksp_size=1 << 22,
+                 trace={"enable": True, "depth": 512, "sample": 1})
+        .link("a_b", depth=256, mtu=1280)
+        .tile("a", "synth", outs=["a_b"], count=4096, unique=16,
+              burst=8,
+              supervise={"policy": "restart", "backoff_s": 0.1,
+                         "max_restarts": 1, "window_s": 30.0},
+              chaos={"events": [
+                  {"action": "flood_forged", "at_iter": 6,
+                   "frames": 24, "seed": 9},
+                  {"action": "crash", "at_iter": 40, "code": 71}]})
+        .tile("b", "sink", ins=["a_b"]))
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=60)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if "a" in runner.supervisor.blackbox:
+                break
+            try:
+                runner.check_failures()
+            except RuntimeError:
+                pass                   # the crash IS the drill
+            time.sleep(0.05)
+        path = runner.supervisor.blackbox.get("a")
+        assert path, "crash must leave a black-box dump"
+        with open(path) as f:
+            dump = json.load(f)
+        chaos = [(e["ts"], e["count"]) for e in dump["events"]
+                 if e["ev"] == "chaos"]
+        ids = [c for _, c in chaos]
+        assert CHAOS_ACTION_IDS["flood_forged"] in ids
+        assert CHAOS_ACTION_IDS["crash"] in ids
+        flood_ts = min(t for t, c in chaos
+                       if c == CHAOS_ACTION_IDS["flood_forged"])
+        crash_ts = max(t for t, c in chaos
+                       if c == CHAOS_ACTION_IDS["crash"])
+        assert flood_ts < crash_ts     # attack named BEFORE the death
+        # the flood's frames made it out before the crash
+        assert runner.metrics("a")["attack_tx"] > 0
+        assert runner.metrics("b")["rx"] > 0
+        os.unlink(path)                # test hygiene (/dev/shm)
+    finally:
+        runner.halt(join_timeout_s=10)
+        runner.close()

@@ -327,6 +327,42 @@ def test_slo_section_is_clean_when_valid():
     assert lint_config(cfg, "<fixture>") == []
 
 
+def test_bad_shed_schema_did_you_mean_and_dead_config():
+    # typo'd [shed] key: the disco/shed.py schema gate with suggestion
+    cfg = _cfg(shed={"rate_ppz": 1.0})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-shed")
+    assert "did you mean 'rate_pps'" in findings[0].message
+    # out-of-range value
+    fires_once(lint_config(_cfg(shed={"max_peers": 1}), "<fixture>"),
+               "bad-shed")
+    # malformed per-tile override
+    fires_once(lint_config(_cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"],
+         "shed": {"burst": 0}}]), "<fixture>"), "bad-shed")
+    # dead config: a shed override on a kind with no ingest door —
+    # a topo that THINKS it is protected must actually be
+    findings = lint_config(_cfg(tiles=[
+        {"name": "src", "kind": "synth", "outs": ["a_b"]},
+        {"name": "dst", "kind": "sink", "ins": ["a_b"],
+         "shed": {"rate_pps": 5.0}}]), "<fixture>")
+    fires_once(findings, "bad-shed")
+    assert "no ingest door" in findings[0].message
+
+
+def test_shed_section_is_clean_when_valid():
+    cfg = _cfg(
+        links=[{"name": "a_b", "depth": 64, "mtu": 1280}],
+        tiles=[{"name": "src", "kind": "sock", "outs": ["a_b"],
+                "shed": {"rate_pps": 50.0}},
+               {"name": "dst", "kind": "sink", "ins": ["a_b"]}],
+        shed={"rate_pps": 1000.0, "burst": 64, "max_peers": 256,
+              "min_stake": 1, "overload_hold_s": 2.0,
+              "stakes": {"127.0.0.1:9001": 500}})
+    assert lint_config(cfg, "<fixture>") == []
+
+
 def test_lint_topology_programmatic():
     """Programmatic Topology builds get the same pass as TOML."""
     from firedancer_tpu.disco import Topology

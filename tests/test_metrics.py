@@ -651,7 +651,8 @@ def pipeline():
 @slow
 def test_plan_carries_slot_names(pipeline):
     tiles = pipeline.plan["tiles"]
-    assert tiles["synth"]["metrics_names"] == ["tx", "backpressure"]
+    assert tiles["synth"]["metrics_names"] == \
+        ["tx", "backpressure", "attack_tx", "attack_drop"]
     assert tiles["sink"]["metrics_names"] == ["rx", "bytes", "overruns"]
     # readers resolve by plan names — values land under the right keys
     # (synth publishes its whole count in one poll; give its NEXT
