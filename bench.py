@@ -1130,21 +1130,19 @@ def main():
         # witnessed TPU record inside the official artifact, so an
         # outage never erases the chip-measured number (the r3 lesson:
         # "a perf claim that isn't in the driver artifact doesn't
-        # exist")
+        # exist"). Discovery is glob-latest over BENCH_r*_witnessed.json
+        # (numeric round order), shared with fdwitness and fdbench —
+        # the hardcoded filename used to go stale every round.
         if result.get("platform", "").startswith("cpu"):
-            for name in ("BENCH_r05_witnessed.json",
-                         "BENCH_r04_witnessed.json"):
-                wit_path = os.path.join(HERE, name)
-                if not os.path.exists(wit_path):
-                    continue
-                try:
-                    with open(wit_path) as f:
-                        wit = json.load(f)
-                    if wit.get("platform") == "tpu":
-                        result["witnessed_tpu"] = wit
-                        break
-                except (OSError, json.JSONDecodeError):
-                    pass
+            from firedancer_tpu.witness import latest_witnessed
+            hit = latest_witnessed(HERE, require_platform="tpu")
+            if hit:
+                _, wit = hit
+                # the embedded fallback stays the compact bare record;
+                # the full fdwitness chain lives in the artifact itself
+                result["witnessed_tpu"] = {
+                    k: v for k, v in wit.items()
+                    if k not in ("witness", "witnessed")}
     else:
         try:
             e2e = _run_child(
@@ -1201,6 +1199,24 @@ def main():
         except Exception as e5:  # noqa: BLE001
             result["flood_error"] = f"{e5!r}"[:300]
 
+    # multichip layout stanza (ROADMAP 1b): the same machine-readable
+    # candidate-layout record dryrun_multichip prints into the
+    # MULTICHIP tail, persisted as FIELDS of this round's BENCH json
+    # so fdwitness/fdbench can diff layout choices round over round
+    # (the measured choice itself comes from the fdwitness multichip
+    # stage and rides the witnessed artifact as `multichip_choice`)
+    try:
+        sys.path.insert(0, HERE)
+        from __graft_entry__ import multichip_layout_stanza
+        # mesh size mirrors dryrun_multichip's 8-device default (this
+        # parent must not touch jax to count devices itself) so the
+        # BENCH field diffs cleanly against the MULTICHIP tail record
+        n_dev = int(os.environ.get("FDTPU_BENCH_MULTICHIP_DEVICES",
+                                   "8"))
+        result["multichip_layout"] = multichip_layout_stanza(n_dev)
+    except Exception as e:  # noqa: BLE001 — annotate, don't break
+        result["multichip_layout_error"] = f"{e!r}"[:200]
+
     # bench-trend gate (fdbench): compare this round against the
     # previous BENCH json — kernel vps / e2e tps / knee regressions
     # beyond the threshold fail the run, and the printed diff says
@@ -1215,7 +1231,8 @@ def main():
     if not prev:
         import glob as _glob
         rounds = sorted(_glob.glob(os.path.join(HERE, "BENCH_r*.json")))
-        rounds = [r for r in rounds if "witnessed" not in r]
+        rounds = [r for r in rounds
+                  if "witnessed" not in os.path.basename(r)]
         if rounds:
             prev = rounds[-1]
             knee_only = True
@@ -1267,11 +1284,24 @@ def _emit_report(result: dict):
             json.dump(result, f)
         try:
             from firedancer_tpu.gui.report import report_from_bench
+            from firedancer_tpu.witness import latest_witnessed
             rounds = sorted(_glob.glob(
                 os.path.join(HERE, "BENCH_r*.json")))
+            # witnessed artifacts chart through their own reports (and
+            # the fallback embed) — as trend rounds they would double
+            # up with the driver round they witness
+            rounds = [r for r in rounds
+                      if "witnessed" not in os.path.basename(r)]
+            # provenance header panel: the latest witnessed run's
+            # chain summary (git sha, device fingerprint, per-stanza
+            # witnessed-vs-fallback badges) rides every bench report
+            hit = latest_witnessed(HERE, require_platform=None)
+            wit = hit[1] if hit else {}
             # bench_series preserves caller order, so THIS round is
             # the trajectory's last point wherever tempdir sorts
-            report_from_bench(rounds + [cur], out_path)
+            report_from_bench(rounds + [cur], out_path,
+                              witness=wit.get("witness"),
+                              witnessed=wit.get("witnessed"))
         finally:
             os.unlink(cur)
         result["report"] = out_path

@@ -92,6 +92,14 @@ Schema:
     [tile.shed]              # per-tile override (same keys; highest
     rate_pps = 50.0          #  precedence, like [tile.trace])
 
+    [witness]                # fdwitness sweep plan (witness/plan.py):
+    stages = ["kernel_vps"]  #  ordered stage subset, watch-mode
+    park_s = 30.0            #  backoff, per-stage deadlines; read by
+    park_max_s = 360.0       #  tools/fdwitness, not the topology
+
+    [witness.stage.kernel_vps]   # per-stage override: enable,
+    timeout_s = 900.0            #  timeout_s, cmd (argv), env
+
     [[tile.chaos.events]]    # seeded fault plan (utils/chaos.py):
     action = "crash"         #  crash | freeze_hb | wedge | stall_fseq
     at_rx = 24               #  | fail_dispatch (verify tile); fire at
@@ -124,7 +132,7 @@ except ModuleNotFoundError:          # py<3.11
                 "install 'tomli'") from e
 
 _TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo",
-                 "prof", "shed"}
+                 "prof", "shed", "witness"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -173,7 +181,8 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
             if key in layer:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
-        for key in ("topology", "trace", "slo", "prof", "shed"):
+        for key in ("topology", "trace", "slo", "prof", "shed",
+                    "witness"):
             if key in layer:
                 merged = _deep_merge(cfg.get(key, {}), layer[key])
                 if key == "slo" and "target" in layer[key]:
@@ -239,6 +248,14 @@ def build_topology(cfg: dict, name: str | None = None):
     shed_cfg = cfg.get("shed")
     if shed_cfg is not None:
         normalize_shed(shed_cfg)
+    # [witness] sweep plan — same gate (witness/plan.py is the one
+    # validator; the section configures tools/fdwitness, not the
+    # topology, but a typo'd stage name must still fail at load with a
+    # did-you-mean, not at 3am when the tunnel finally comes up)
+    from ..witness.plan import normalize_witness
+    wit_cfg = cfg.get("witness")
+    if wit_cfg is not None:
+        normalize_witness(wit_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
                     wksp_size=int(top.get("wksp_size", 1 << 26)),
                     trace=trace_cfg, slo=slo_cfg, prof=prof_cfg,

@@ -56,6 +56,13 @@ cursor:default}
 border-radius:4px;margin:8px 0;padding:6px}
 .chart h3{font-size:12px;color:var(--acc);margin:0 0 4px}
 #flame h3{font-size:12px;color:var(--acc);margin:12px 0 2px}
+#prov{background:var(--panel);border:1px solid var(--line);
+border-radius:4px;padding:6px 12px;margin:8px 0;font-size:12px}
+#prov .wbadge{display:inline-block;border:1px solid var(--line);
+border-radius:3px;padding:0 6px;margin:1px 4px 1px 0;font-size:11px}
+#prov .wit{color:var(--ok);border-color:var(--ok)}
+#prov .fallb{color:var(--warn);border-color:var(--warn)}
+#prov .wfail{color:var(--bad);border-color:var(--bad)}
 </style></head><body>
 <h1>firedancer-tpu <span id="topo"></span>
 <small id="digest"></small><span id="mode" class="badge">live</span></h1>
@@ -64,6 +71,7 @@ border-radius:4px;margin:8px 0;padding:6px}
 <div class="kpi"><div class="kv" id="kbreach">0</div><div class="kl">SLO breached now</div></div>
 <div class="kpi"><div class="kv" id="ktiles">-</div><div class="kl">tiles up</div></div>
 </div>
+<div id="prov" hidden></div>
 <nav>
 <button data-tab="topo" class="on">topology</button>
 <button data-tab="slo">slo</button>
@@ -331,6 +339,37 @@ function renderBench(rows){
   root.appendChild(div);}
 }
 
+/* ---- provenance / witness header (fdwitness chain summary) ---- */
+function renderProv(w){
+ const el=$("prov");if(!w){el.hidden=true;return;}
+ el.hidden=false;
+ /* stage results come verbatim from stage-subprocess stdout and land
+    in single-quoted title attributes below — escape ' too */
+ const esc=s=>String(s==null?"":s).replace(/[&<>"']/g,
+  c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;",
+       "'":"&#39;"}[c]));
+ const g=w.git||{},d=w.device||{},v=w.versions||{};
+ let h="<b>witnessed run</b> "+esc(w.run_id||"?")+
+  (w.cpu_smoke?" <span class='wbadge fallb'>cpu-smoke</span>":"")+
+  "<br>git "+esc((g.sha||"?").slice(0,12))+
+  (g.dirty?" <span class='wbadge wfail'>dirty</span>":
+   " <span class='wbadge wit'>clean</span>")+
+  "&nbsp; device "+esc(d.platform||"?")+
+  (d.device_kind?" / "+esc(d.device_kind):"")+
+  (d.device_count?" &times;"+esc(d.device_count):"")+
+  (v.jax?"&nbsp; jax "+esc(v.jax):"")+
+  (w.head?"&nbsp; chain "+esc(String(w.head).slice(0,12))+"&hellip;":
+   "")+"<br>";
+ for(const s of w.stages||[]){
+  const cls=s.status!=="ok"?"wfail":s.witnessed?"wit":"fallb";
+  const tag=s.status!=="ok"?s.status:
+   s.witnessed?"witnessed":"cpu-fallback";
+  h+="<span class='wbadge "+cls+"' title='"+esc((s.platform||"")+
+   (s.duration_s!=null?" "+s.duration_s+"s":""))+"'>"+
+   esc(s.stage)+": "+tag+"</span>";}
+ el.innerHTML=h;
+}
+
 /* ---- boot: static report vs live websocket ---- */
 function boot(snapshot){
  S=snapshot;$("topo").textContent=S.topology;
@@ -340,6 +379,7 @@ function boot(snapshot){
 if(DATA){
  $("mode").textContent="static report";
  boot(DATA.snapshot);
+ renderProv(DATA.witness||null);
  for(const d of DATA.deltas||[])applyDelta(d);
  loadFlame();loadBench();
 }else{

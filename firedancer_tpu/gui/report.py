@@ -113,8 +113,53 @@ def render_html(data: dict) -> str:
         f"<script>window.FDGUI_DATA={blob}</script>")
 
 
+def witness_panel_data(witness: dict | None,
+                       witnessed: dict | None = None) -> dict | None:
+    """Compress an fdwitness chain block into what the provenance
+    header panel renders: git sha + dirty flag, device fingerprint,
+    run id, and one witnessed-vs-cpu-fallback badge per stanza. The
+    full chain stays in the BENCH json; the report only needs the
+    summary (a dashboard header, not an audit log)."""
+    if not witness:
+        return None
+    from ..witness.artifact import stage_platform
+    header = witness.get("header") or {}
+    stages = []
+    device = {}
+    for ckpt in witness.get("stages", []):
+        res = ckpt.get("result") or {}
+        if ckpt.get("stage") == "device_probe" and res:
+            device = res
+        # same platform resolution as the artifact's witnessed map
+        # (explicit stage platform, else the probe fingerprint the
+        # runner stamped into the checkpoint's provenance)
+        plat = stage_platform(ckpt, res)
+        stages.append({
+            "stage": ckpt.get("stage"),
+            "status": ckpt.get("status"),
+            "witnessed": ckpt.get("status") == "ok" and bool(plat)
+            and not plat.startswith("cpu"),
+            "platform": plat or None,
+            "duration_s": ckpt.get("duration_s"),
+        })
+    return {
+        "run_id": witness.get("run_id"),
+        "cpu_smoke": bool(witness.get("cpu_smoke")),
+        "git": header.get("git") or {},
+        "versions": header.get("versions") or {},
+        "host": header.get("host") or {},
+        "device": {k: device.get(k)
+                   for k in ("platform", "device_kind", "device_count")
+                   if device.get(k) is not None},
+        "head": witness.get("head"),
+        "stages": stages,
+        "metrics": witnessed or {},
+    }
+
+
 def report_from_shm(topology: str, out_path: str,
-                    bench_glob: str | None = None) -> str:
+                    bench_glob: str | None = None,
+                    witness: dict | None = None) -> str:
     """Attach by topology name (live or post-mortem shm) and write the
     artifact; returns the output path."""
     from ..disco.monitor import attach
@@ -125,21 +170,29 @@ def report_from_shm(topology: str, out_path: str,
         wksp.close()
     data["bench"] = bench_series(sorted(glob.glob(bench_glob))) \
         if bench_glob else []
+    data["witness"] = witness_panel_data(witness)
     with open(out_path, "w") as f:
         f.write(render_html(data))
     return out_path
 
 
-def report_from_bench(paths, out_path: str) -> str:
+def report_from_bench(paths, out_path: str,
+                      witness: dict | None = None,
+                      witnessed: dict | None = None,
+                      flame: dict | None = None) -> str:
     """Bench-only artifact: no shm, just the trend page (the shape
-    bench.py emits per round under FDTPU_BENCH_REPORT)."""
+    bench.py emits per round under FDTPU_BENCH_REPORT). `witness` is
+    an fdwitness chain block rendered as the provenance header panel;
+    `flame` optional folded-stack data (the per-stage profile digests
+    fdwitness merges into its final report)."""
     data = {
         "snapshot": {"type": "snapshot", "v": 2,
                      "topology": "bench trends", "cfg_digest": "-",
                      "tiles": {}, "links": {},
                      "slo": {"targets": []}},
-        "deltas": [], "flame": {},
+        "deltas": [], "flame": flame or {},
         "bench": bench_series(paths),
+        "witness": witness_panel_data(witness, witnessed),
     }
     with open(out_path, "w") as f:
         f.write(render_html(data))

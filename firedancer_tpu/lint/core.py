@@ -107,6 +107,13 @@ RULES: dict[str, tuple[str, str, str]] = {
         "non-positive rate_pps/burst/overload_hold_s, max_peers < 2, "
         "malformed stakes table), or shed configured on a tile kind "
         "with no ingest door to police"),
+    "bad-witness": (
+        "graph", "error",
+        "[witness] section rejected by the witness/plan.py schema "
+        "(unknown key with did-you-mean, unknown stage name, "
+        "non-positive timeout/park values, malformed per-stage "
+        "cmd/env override) — the fdwitness sweep plan must validate "
+        "at review, not at 3am when the tunnel finally comes up"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

@@ -76,7 +76,8 @@ def model_from_config(cfg: dict) -> dict:
                             "args": args}
     return {"links": links, "tcaches": tcaches, "tiles": tiles,
             "trace": cfg.get("trace"), "slo": cfg.get("slo"),
-            "prof": cfg.get("prof"), "shed": cfg.get("shed")}
+            "prof": cfg.get("prof"), "shed": cfg.get("shed"),
+            "witness": cfg.get("witness")}
 
 
 def model_from_topology(topo) -> dict:
@@ -92,7 +93,8 @@ def model_from_topology(topo) -> dict:
             "tiles": tiles, "trace": getattr(topo, "trace", None),
             "slo": getattr(topo, "slo", None),
             "prof": getattr(topo, "prof", None),
-            "shed": getattr(topo, "shed", None)}
+            "shed": getattr(topo, "shed", None),
+            "witness": getattr(topo, "witness", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -238,6 +240,24 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_prof(model, path, lines))
     out.extend(_check_gui(model, lines))
     out.extend(_check_shed(model, path, lines))
+    out.extend(_check_witness(model, path))
+    return out
+
+
+def _check_witness(model, path) -> list[Finding]:
+    """[witness] section: the witness/plan.py schema gate (one
+    validator, same as config load and fdwitness plan build) — unknown
+    keys, unknown stage names, malformed per-stage overrides all land
+    as review-time findings."""
+    from ..witness.plan import normalize_witness
+    out: list[Finding] = []
+    spec = model.get("witness")
+    if spec is not None:
+        try:
+            normalize_witness(spec)
+        except Exception as e:
+            out.append(finding("bad-witness", path, 0,
+                               f"[witness]: {e}"))
     return out
 
 

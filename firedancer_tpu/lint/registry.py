@@ -70,6 +70,17 @@ SHED_SECTION_KEYS = ("enable", "rate_pps", "burst", "max_peers",
                      "min_stake", "overload_hold_s", "stakes")
 TILE_SHED_KEYS = SHED_SECTION_KEYS
 
+# [witness] topology-section keys (mirror of witness/plan.py
+# WITNESS_DEFAULTS / WITNESS_STAGE_KEYS — tests/test_witness.py keeps
+# the mirror honest). Stage names in `stages` / [witness.stage.<name>]
+# resolve against the witness/plan.py STAGES catalog; validated by
+# normalize_witness at config load, plan build (fdwitness run/dry-run),
+# and the graph analyzer's bad-witness rule.
+WITNESS_SECTION_KEYS = ("stages", "out_dir", "round", "stage_timeout_s",
+                        "probe_timeout_s", "park_s", "park_max_s",
+                        "keep_going", "report", "stage")
+WITNESS_STAGE_KEYS = ("enable", "timeout_s", "cmd", "env")
+
 TILE_ARGS: dict[str, dict[str, str | None]] = {
     "synth": {"count": None, "burst": None, "unique": None, "seed": None,
               "rate_tps": None},

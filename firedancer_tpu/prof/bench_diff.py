@@ -10,6 +10,14 @@ WHERE the time went — instead of a bare before/after number.
     tools/fdbench OLD.json NEW.json             # human diff
     tools/fdbench OLD.json NEW.json --gate      # exit 1 on regression
         [--threshold 0.05]                      # allowed fractional drop
+    tools/fdbench --verify BENCH_r05_witnessed.json
+                                                # fdwitness chain check
+
+Provenance is explicit per metric: the diff badges every number
+[wit] (fdwitness chain-stamped on a real device), [cpu] (measured on
+the CPU backend) or [fb] (the prior witnessed record standing in), and
+--verify recomputes a witnessed artifact's provenance hash chain +
+record seal, exiting 1 on tamper.
 
 Gated metrics (higher is better): the kernel vps (`value`), `e2e_tps`,
 `e2e_knee_tps`, the leader knee, and the r14 front-door set
@@ -65,16 +73,58 @@ def load_bench(path: str) -> dict:
     return doc
 
 
+def load_multichip(path: str) -> dict | None:
+    """The machine-readable `multichip_layout` stanza of a driver
+    MULTICHIP_r*.json (its `tail` string carries the dryrun's one JSON
+    line) or of a BENCH json that persists it as a field — so layout
+    records diff round over round without scraping printed text."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc.get("multichip_layout"), dict):
+        return doc["multichip_layout"]
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "multichip_layout" in rec:
+                return rec["multichip_layout"]
+    return None
+
+
 def _metric(doc: dict, key: str):
     """A gated metric, honoring the witnessed-record fallback bench.py
     uses when the e2e stage was skipped (tunnel down)."""
+    return _metric_src(doc, key)[0]
+
+
+def _metric_src(doc: dict, key: str):
+    """(value, source) — the source says EXPLICITLY where the number
+    came from: 'witnessed' (an fdwitness chain stamped it on a real
+    device), 'cpu' (measured, but on the CPU backend — a smoke number,
+    not a chip claim), 'measured' (no provenance info, taken at face
+    value), or 'fallback' (this round skipped the stage and carries
+    the prior witnessed record)."""
     v = doc.get(key)
-    if v is None and key.startswith("e2e"):
+    src = None
+    if v is not None:
+        wit = doc.get("witnessed")
+        if isinstance(wit, dict) and key in wit:
+            src = "witnessed" if wit[key].get("witnessed") else "cpu"
+        elif str(doc.get("platform", "")).startswith("cpu"):
+            src = "cpu"
+        else:
+            src = "measured"
+    elif key.startswith("e2e"):
         v = doc.get("witnessed_tpu", {}).get(key)
+        if v is not None:
+            src = "fallback"
     try:
-        return float(v) if v is not None else None
+        return (float(v), src) if v is not None else (None, None)
     except (TypeError, ValueError):
-        return None
+        return None, None
 
 
 def _top_stacks(doc: dict) -> dict[str, dict[str, int]]:
@@ -91,11 +141,20 @@ def diff_bench(old: dict, new: dict) -> dict:
     per-hop link-budget deltas, and profile top-k churn."""
     metrics = {}
     for key, label in GATE_METRICS:
-        ov, nv = _metric(old, key), _metric(new, key)
-        rec = {"label": label, "old": ov, "new": nv}
+        (ov, osrc), (nv, nsrc) = (_metric_src(old, key),
+                                  _metric_src(new, key))
+        rec = {"label": label, "old": ov, "new": nv,
+               "old_src": osrc, "new_src": nsrc}
         if ov is not None and nv is not None and ov > 0:
             rec["frac"] = (nv - ov) / ov
         metrics[key] = rec
+    # multichip layout choice (fdwitness multichip stage): a layout
+    # flip between rounds is exactly the kind of silent change the
+    # diff must surface
+    multichip = None
+    oc, nc = old.get("multichip_choice"), new.get("multichip_choice")
+    if oc is not None or nc is not None:
+        multichip = {"old": oc, "new": nc, "changed": oc != nc}
     links = {}
     ol = old.get("e2e_link_budget") or {}
     nl = new.get("e2e_link_budget") or {}
@@ -116,7 +175,8 @@ def diff_bench(old: dict, new: dict) -> dict:
                                "new": n.get(stack, 0)}
         if rows:
             profile[tn] = rows
-    return {"metrics": metrics, "links": links, "profile": profile}
+    return {"metrics": metrics, "links": links, "profile": profile,
+            "multichip": multichip}
 
 
 def gate_regressions(diff: dict, threshold: float = 0.05,
@@ -162,6 +222,12 @@ def render_text(diff: dict, regressions: list[dict],
     for label, path in (reports or ()):
         if path:
             lines.append(f"report ({label}): {path}")
+    # provenance badges (fdwitness): [wit] chain-stamped on a device,
+    # [cpu] measured on the CPU backend, [fb] prior witnessed record
+    # standing in — so a diff can never pass off a fallback or a smoke
+    # number as a fresh chip measurement
+    _BADGE = {"witnessed": "[wit]", "cpu": "[cpu]", "fallback": "[fb]",
+              "measured": "", None: ""}
     for key, rec in diff["metrics"].items():
         ov, nv = rec["old"], rec["new"]
         if ov is None and nv is None:
@@ -169,9 +235,17 @@ def render_text(diff: dict, regressions: list[dict],
         arrow = ""
         if rec.get("frac") is not None:
             arrow = f"  ({rec['frac']:+.1%})"
+        ob = _BADGE.get(rec.get("old_src"), "")
+        nb = _BADGE.get(rec.get("new_src"), "")
         lines.append(f"{rec['label']:<16} "
-                     f"{ov if ov is not None else '-':>12} -> "
-                     f"{nv if nv is not None else '-':>12}{arrow}")
+                     f"{ov if ov is not None else '-':>12}{ob:<5} -> "
+                     f"{nv if nv is not None else '-':>12}{nb:<5}"
+                     f"{arrow}")
+    mc = diff.get("multichip")
+    if mc:
+        lines.append(f"multichip layout  "
+                     f"{mc['old'] or '-'} -> {mc['new'] or '-'}"
+                     + ("  (CHANGED)" if mc["changed"] else ""))
     if diff["links"]:
         lines.append("")
         lines.append(f"{'link':<18}{'pub':>16}{'lost':>12}"
@@ -206,14 +280,26 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="fdbench",
         description="diff two BENCH json files; --gate exits nonzero "
-                    "on a regression beyond --threshold")
+                    "on a regression beyond --threshold; --verify "
+                    "checks a witnessed artifact's provenance chain")
     ap.add_argument("old")
-    ap.add_argument("new")
+    ap.add_argument("new", nargs="?", default=None)
     ap.add_argument("--gate", action="store_true")
     ap.add_argument("--threshold", type=float, default=0.05)
     ap.add_argument("--json", action="store_true",
                     help="emit the structured diff document instead")
+    ap.add_argument("--verify", action="store_true",
+                    help="single-file mode: verify the fdwitness "
+                         "provenance hash chain of a "
+                         "BENCH_r*_witnessed.json (exit 1 on tamper)")
     args = ap.parse_args(argv)
+    if args.verify:
+        # one definition of chain verification, shared with
+        # `tools/fdwitness verify`
+        from ..witness.cli import verify_artifact
+        return verify_artifact(args.old)
+    if args.new is None:
+        ap.error("new is required unless --verify is given")
     old = load_bench(args.old)
     new = load_bench(args.new)
     d = diff_bench(old, new)

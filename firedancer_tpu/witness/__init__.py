@@ -1,0 +1,42 @@
+"""fdwitness: the one-command, resumable, provenance-stamped
+witnessed-sweep orchestrator.
+
+ROADMAP item 1's bottleneck is not code, it is PROCESS: rounds of
+performance work queue behind one flaky TPU tunnel window, and the
+witnessed-run procedure lived in /tmp scripts and PERF.md prose. This
+package makes the run a committed artifact of the repo:
+
+    plan.py        the ordered stage catalog (every gated stanza) +
+                   the [witness] config schema (load/build/lint triple)
+    provenance.py  git/stack/device/knob/clock stamps + the per-stage
+                   hash chain (tamper-evident artifacts)
+    runner.py      bounded-subprocess stage execution, atomic per-stage
+                   checkpoints, resume-by-run-id, artifact + merged
+                   fdgui report assembly
+    watch.py       hang-proof backend probe + park/backoff/resume loop
+                   (the committed replacement for /tmp/tpu_watch.sh)
+    multichip.py   the measured shard_map layout shootout (per-chip rr
+                   tiles vs one mesh tile) — ROADMAP 1b's decision
+    artifact.py    glob-latest BENCH_r*_witnessed.json discovery shared
+                   with bench.py and fdbench, artifact assembly
+    cli.py         `python -m firedancer_tpu.witness` / tools/fdwitness
+
+No module here imports jax at module level, and the orchestrator
+process never initializes a backend — the device tunnel belongs to the
+stage subprocesses (whose documented failure mode, hanging, is why
+every stage and probe runs under a hard deadline).
+"""
+from .artifact import (  # noqa: F401
+    assemble, latest_witnessed, merge_stages, next_round,
+    record_sha256, witnessed_rounds,
+)
+from .plan import (  # noqa: F401
+    STAGES, WITNESS_DEFAULTS, WITNESS_STAGE_KEYS, build_plan,
+    normalize_witness,
+)
+from .provenance import (  # noqa: F401
+    chain_hash, checkpoint_payload, provenance_block, seal,
+    verify_chain,
+)
+from .runner import WitnessRun, dry_run  # noqa: F401
+from .watch import probe_backend, watch  # noqa: F401

@@ -1,0 +1,223 @@
+"""fdwitness CLI: one command from tunnel window to witnessed artifact.
+
+    tools/fdwitness run [--cpu-smoke] [--run-id ID] [--stages a,b]
+        [--config cfg.toml] [--out-dir DIR] [--artifact PATH]
+                                 run (or RESUME) the checkpointed sweep
+    tools/fdwitness run --dry-run
+                                 validate the plan + provenance capture
+                                 (prints the resolved plan, runs nothing)
+    tools/fdwitness watch [...]  park on a dead tunnel with backoff,
+        [--park-s S] [--max-probes N] [--allow-cpu]
+                                 run/resume the moment devices return
+    tools/fdwitness verify ARTIFACT.json
+                                 verify the provenance hash chain
+    tools/fdwitness status [--out-dir DIR]
+                                 list runs + per-stage checkpoints
+
+`--watch` / `--dry-run` as the first token are accepted as aliases for
+the subcommands (the ISSUE's spelling).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _load_cfg(path: str | None) -> dict | None:
+    if not path:
+        return None
+    from ..app.config import load_config
+    return load_config(path).get("witness")
+
+
+def _add_run_args(ap):
+    ap.add_argument("--run-id", default=None,
+                    help="resume (or name) this run; default: latest "
+                         "unfinalized run, else a fresh timestamped id")
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="CPU-sized knobs for every stage (full "
+                         "orchestrator drill on a box with no device)")
+    ap.add_argument("--stages", default=None,
+                    help="comma list (subset of the catalog, runs in "
+                         "catalog order)")
+    ap.add_argument("--config", default=None,
+                    help="TOML with a [witness] section")
+    ap.add_argument("--out-dir", default=None,
+                    help="run/checkpoint directory (default: "
+                         "<repo>/.fdwitness)")
+    ap.add_argument("--artifact", default=None,
+                    help="artifact path override (default: "
+                         "<repo>/BENCH_r<NN>_witnessed.json)")
+    ap.add_argument("--keep-going", action="store_true",
+                    help="continue the sweep past a failed stage")
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # ISSUE spelling: `fdwitness --watch` / `fdwitness --dry-run`
+    if argv[:1] == ["--watch"]:
+        argv[0] = "watch"
+    elif "--dry-run" in argv and (not argv or argv[0].startswith("-")):
+        argv.insert(0, "run")
+
+    ap = argparse.ArgumentParser(
+        prog="fdwitness",
+        description="resumable, provenance-stamped witnessed-sweep "
+                    "orchestrator")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="run/resume the sweep")
+    _add_run_args(run_p)
+    run_p.add_argument("--dry-run", action="store_true",
+                       help="validate plan + provenance, run nothing")
+
+    watch_p = sub.add_parser("watch", help="park until devices return, "
+                                           "then run/resume")
+    _add_run_args(watch_p)
+    watch_p.add_argument("--park-s", type=float, default=None,
+                         help="backoff floor (default from [witness])")
+    watch_p.add_argument("--probe-timeout-s", type=float, default=None,
+                         help="hang-proof probe deadline (default "
+                              "from [witness])")
+    watch_p.add_argument("--max-probes", type=int, default=None,
+                         help="give up (exit 3) after N parked probes "
+                              "(default: park forever)")
+    watch_p.add_argument("--allow-cpu", action="store_true",
+                         help="a cpu-only backend counts as up "
+                              "(cpu-smoke watch drills)")
+
+    ver_p = sub.add_parser("verify", help="verify an artifact's chain")
+    ver_p.add_argument("artifact")
+
+    st_p = sub.add_parser("status", help="list runs + checkpoints")
+    st_p.add_argument("--out-dir", default=None)
+
+    args = ap.parse_args(argv)
+    root = _repo_root()
+
+    if args.cmd == "verify":
+        return verify_artifact(args.artifact)
+
+    if args.cmd == "status":
+        return status(root, args.out_dir)
+
+    cfg = _load_cfg(args.config)
+    if args.keep_going:
+        cfg = dict(cfg or {})
+        cfg["keep_going"] = True
+    if getattr(args, "probe_timeout_s", None):
+        # one deadline, both probes: the watch-loop probe AND the
+        # sweep's own device_probe stage (a tunnel slow enough to need
+        # the raised deadline must not pass the first and fail the
+        # second forever)
+        cfg = dict(cfg or {})
+        cfg["probe_timeout_s"] = float(args.probe_timeout_s)
+    stages = [s for s in (args.stages or "").split(",") if s] or None
+
+    if args.cmd == "run" and args.dry_run:
+        from .runner import dry_run
+        try:
+            return dry_run(root, cfg, args.cpu_smoke, stages)
+        except ValueError as e:
+            print(f"fdwitness: {e}", file=sys.stderr)
+            return 2
+
+    from .runner import WitnessRun
+    try:
+        run = WitnessRun.create(root, run_id=args.run_id, cfg=cfg,
+                                cpu_smoke=args.cpu_smoke, stages=stages,
+                                out_dir=args.out_dir,
+                                artifact_path=args.artifact)
+    except ValueError as e:
+        print(f"fdwitness: {e}", file=sys.stderr)
+        return 2
+
+    if args.cmd == "watch":
+        from .plan import normalize_witness
+        from .watch import watch
+        norm = normalize_witness(cfg)
+        return watch(run,
+                     probe_timeout_s=args.probe_timeout_s
+                     or norm["probe_timeout_s"],
+                     park_s=args.park_s or norm["park_s"],
+                     park_max_s=max(norm["park_max_s"],
+                                    args.park_s or 0),
+                     require_accel=not args.allow_cpu
+                     and not args.cpu_smoke,
+                     max_probes=args.max_probes)
+    return run.run()
+
+
+def verify_artifact(path: str) -> int:
+    from .provenance import verify_chain
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"fdwitness: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    wit = doc.get("witness")
+    if not wit:
+        print(f"fdwitness: {path} carries no witness block "
+              f"(pre-fdwitness artifact)", file=sys.stderr)
+        return 2
+    errors = verify_chain(wit)
+    want = wit.get("record_sha256")
+    if want is not None:
+        from .artifact import record_sha256
+        if record_sha256(doc) != want:
+            errors.append("flat record does not match its seal "
+                          "(headline fields tampered)")
+    from .artifact import stage_platform
+    for ckpt in wit.get("stages", []):
+        # same platform resolution as the artifact's witnessed map
+        plat = stage_platform(ckpt, ckpt.get("result") or {})
+        badge = "witnessed" if plat and not plat.startswith("cpu") \
+            else "cpu"
+        print(f"  {ckpt.get('stage'):<14} {ckpt.get('status'):<8} "
+              f"[{badge}] {str(ckpt.get('hash'))[:12]}...")
+    if errors:
+        for e in errors:
+            print(f"fdwitness: TAMPERED: {e}", file=sys.stderr)
+        return 1
+    print(f"fdwitness: chain intact "
+          f"(head {str(wit.get('head'))[:12]}..., "
+          f"{len(wit.get('stages', []))} stages, run "
+          f"{wit.get('run_id')})")
+    return 0
+
+
+def status(root: str, out_dir: str | None) -> int:
+    from .plan import WITNESS_DEFAULTS
+    base = out_dir or os.path.join(root, WITNESS_DEFAULTS["out_dir"])
+    try:
+        runs = sorted(d for d in os.listdir(base)
+                      if os.path.exists(os.path.join(base, d,
+                                                     "run.json")))
+    except OSError:
+        runs = []
+    if not runs:
+        print(f"no runs under {base}")
+        return 0
+    from .runner import WitnessRun
+    for rid in runs:
+        run = WitnessRun.load(root, os.path.join(base, rid),
+                              log=lambda *_: None)
+        ckpts = {c["stage"]: c["status"] for c in run.checkpoints()}
+        states = " ".join(
+            f"{s['name']}={ckpts.get(s['name'], '-')}"
+            for s in run.doc["plan"])
+        tag = "final" if run.finalized() else "in-flight"
+        print(f"{rid}  [{tag}]  {states}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,155 @@
+"""Provenance blocks + the per-stage hash chain.
+
+The north-star gate (>= 1M ed25519 verifies/s, BASELINE.json) is only
+credible if the witnessed artifact carries its own provenance: WHAT
+code ran (git sha + dirty flag), on WHAT stack (jax/jaxlib/libtpu
+versions — read via importlib.metadata, never by importing jax: the
+orchestrator process must not touch the exclusive device tunnel), on
+WHAT hardware (the device fingerprint from the probe stage), with WHAT
+knobs (the full FDTPU_BENCH_* env snapshot), and WHEN (wall + monotonic
+clock anchors, so stage records order even across host clock steps).
+
+Stages are hash-chained in plan order: each checkpoint's `hash` is
+sha256 over the canonical JSON of the checkpoint payload plus the
+previous stage's hash (genesis = the run header). Editing any stage
+result, provenance field, or the header after the fact breaks every
+downstream link — `verify_chain` (used by `fdwitness verify` and
+`tools/fdbench --verify`) names the first tampered stage.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import time
+
+# env prefixes snapshotted into every provenance block: the bench knob
+# space plus the backend selectors that change what a stage measures
+KNOB_PREFIXES = ("FDTPU_BENCH_", "FDTPU_VERIFY_", "FDTPU_WITNESS_")
+KNOB_KEYS = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def canonical(obj) -> bytes:
+    """Deterministic JSON encoding — the only form the chain hashes."""
+    return json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def chain_hash(prev_hash: str, payload: dict) -> str:
+    h = hashlib.sha256()
+    h.update(prev_hash.encode())
+    h.update(canonical(payload))
+    return h.hexdigest()
+
+
+def git_state(repo_root: str) -> dict:
+    """{"sha", "dirty"} — best-effort (a non-repo checkout still gets
+    a self-describing artifact, just an unknown sha)."""
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo_root,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+        dirty = bool(subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo_root,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip())
+    except (OSError, subprocess.SubprocessError):
+        sha, dirty = "unknown", True
+    return {"sha": sha, "dirty": dirty}
+
+
+def pkg_versions() -> dict:
+    """jax/jaxlib/libtpu versions WITHOUT importing jax (the parent
+    must never initialize the backend — it belongs to the stage
+    children)."""
+    from importlib import metadata
+    out = {}
+    for pkg in ("jax", "jaxlib", "libtpu", "libtpu-nightly", "numpy"):
+        try:
+            out[pkg] = metadata.version(pkg)
+        except metadata.PackageNotFoundError:
+            continue
+    return out
+
+
+def knob_snapshot(env: dict | None = None) -> dict:
+    env = os.environ if env is None else env
+    out = {k: v for k, v in env.items()
+           if k.startswith(KNOB_PREFIXES) or k in KNOB_KEYS}
+    return dict(sorted(out.items()))
+
+
+def provenance_block(repo_root: str,
+                     extra_env: dict | None = None) -> dict:
+    """The stamp every stage checkpoint (and the run header) carries.
+    `extra_env` folds the stage's own env overrides into the knob
+    snapshot — the knobs recorded are the knobs the stage SAW."""
+    import platform
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    return {
+        "git": git_state(repo_root),
+        "host": {
+            "hostname": platform.node(),
+            "platform": platform.platform(),
+            "machine": platform.machine(),
+            "cpu_count": os.cpu_count() or 1,
+            "python": platform.python_version(),
+        },
+        "versions": pkg_versions(),
+        "knobs": knob_snapshot(env),
+        "clock": {
+            "wall_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+            "wall_s": round(time.time(), 3),
+            "monotonic_ns": time.monotonic_ns(),
+        },
+    }
+
+
+def checkpoint_payload(ckpt: dict) -> dict:
+    """The hashed portion of a checkpoint: everything except the hash
+    itself (prev_hash IS included — that is the chain link)."""
+    return {k: v for k, v in ckpt.items() if k != "hash"}
+
+
+def seal(ckpt: dict, prev_hash: str) -> dict:
+    """Stamp prev_hash + hash onto a checkpoint dict (in place)."""
+    ckpt["prev_hash"] = prev_hash
+    ckpt["hash"] = chain_hash(prev_hash, checkpoint_payload(ckpt))
+    return ckpt
+
+
+def verify_chain(witness: dict) -> list[str]:
+    """Verify a witness block ({header, genesis, stages, head}) —
+    returns human-readable errors, [] when the chain is intact."""
+    errors = []
+    if not isinstance(witness, dict):
+        return ["witness block is not a dict"]
+    header = witness.get("header")
+    genesis = witness.get("genesis")
+    if header is None or genesis is None:
+        return ["witness block missing header/genesis"]
+    want_genesis = chain_hash("", header)
+    if genesis != want_genesis:
+        errors.append("genesis hash does not match the run header "
+                      "(header tampered)")
+    prev = genesis
+    for i, ckpt in enumerate(witness.get("stages", [])):
+        name = ckpt.get("stage", f"#{i}")
+        if ckpt.get("prev_hash") != prev:
+            errors.append(f"stage {name!r}: prev_hash broke the chain "
+                          f"(expected {prev[:12]}..., got "
+                          f"{str(ckpt.get('prev_hash'))[:12]}...)")
+        want = chain_hash(ckpt.get("prev_hash", ""),
+                          checkpoint_payload(ckpt))
+        if ckpt.get("hash") != want:
+            errors.append(f"stage {name!r}: content hash mismatch "
+                          f"(checkpoint tampered)")
+        prev = ckpt.get("hash", want)
+    head = witness.get("head")
+    if head is not None and witness.get("stages") and head != prev:
+        errors.append("head hash does not match the last stage")
+    return errors

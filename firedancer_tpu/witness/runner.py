@@ -1,0 +1,402 @@
+"""The checkpointed sweep runner: one tunnel window -> one artifact.
+
+Every stage runs as a bounded subprocess (the tunnel's failure mode is
+a HANG, so nothing in this process ever calls into jax) and writes one
+checkpoint JSON — atomically, tmp + rename — the moment it finishes.
+A killed run therefore loses at most the stage that was in flight:
+`fdwitness run` with the same run-id reloads the checkpoints, verifies
+the chain is intact, skips every completed stage, and resumes at the
+first missing/failed one. Because stages execute strictly in plan
+order and failures rerun only from the TAIL, the hash chain stays
+append-only by construction.
+
+Layout of a run directory (<out_dir>/<run_id>/):
+
+    run.json          the immutable run header: plan + provenance +
+                      genesis hash (resume uses THIS plan, not the
+                      CLI's — the plan that finishes is provably the
+                      plan that started)
+    NN_<stage>.json   one chained checkpoint per stage, plan order
+    NN_<stage>.log    the stage's captured stdout+stderr (full)
+
+Finalize merges the checkpoints into `BENCH_r*_witnessed.json` (bare
+bench.py record shape + the `witness` chain block) and renders the
+merged fdgui report (`<artifact>.report.html`) with the provenance
+header panel and every stanza's numbers on the bench-trend page.
+"""
+from __future__ import annotations
+
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+from . import artifact as art
+from . import plan as planmod
+from . import provenance as prov
+
+# stage status taxonomy: ok/skipped are terminal ("completed"),
+# failed/timeout rerun on resume
+DONE_STATUSES = ("ok", "skipped")
+
+
+def _atomic_write(path: str, doc: dict):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+class WitnessRun:
+    """One named, resumable witnessed sweep."""
+
+    def __init__(self, repo_root: str, run_dir: str, run_doc: dict,
+                 log=print):
+        self.repo_root = repo_root
+        self.run_dir = run_dir
+        self.doc = run_doc
+        self.log = log
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(cls, repo_root: str, run_id: str | None = None,
+               cfg: dict | None = None, cpu_smoke: bool = False,
+               stages: list[str] | None = None,
+               out_dir: str | None = None, artifact_path: str | None = None,
+               log=print) -> "WitnessRun":
+        norm = planmod.normalize_witness(cfg)
+        base = out_dir or os.path.join(repo_root, norm["out_dir"])
+        stage_plan = planmod.build_plan(cfg, repo_root,
+                                        cpu_smoke=cpu_smoke,
+                                        stages=stages)
+
+        def _resume(rid: str) -> "WitnessRun":
+            run = cls.load(repo_root, os.path.join(base, rid), log=log)
+            # the PLAN is the immutable run.json record (what resumes
+            # is provably what started) — but mutable EXECUTION knobs
+            # follow this invocation, so `run --keep-going` on a
+            # parked run actually keeps going
+            if "keep_going" in (cfg or {}):
+                run.doc["keep_going"] = norm["keep_going"]
+            if artifact_path:
+                run.doc["artifact"] = artifact_path
+            return run
+
+        if run_id is not None and \
+                os.path.exists(os.path.join(base, run_id, "run.json")):
+            return _resume(run_id)
+        if run_id is None:
+            # resume-friendly default: the newest unfinalized run
+            # whose stored plan MATCHES this invocation continues (a
+            # leftover full-size run must not hijack a --cpu-smoke
+            # drill, or vice versa); none compatible -> a fresh
+            # wall-clock-stamped run starts
+            for cand in cls._unfinished(base):
+                stored = cls.load(repo_root, os.path.join(base, cand),
+                                  log=lambda *_: None).doc
+                if bool(stored.get("cpu_smoke")) == bool(cpu_smoke) \
+                        and [s["name"] for s in stored["plan"]] \
+                        == [s["name"] for s in stage_plan]:
+                    return _resume(cand)
+                log(f"fdwitness: unfinished run {cand!r} has a "
+                    f"different plan — skipping it")
+            run_id = time.strftime("run-%Y%m%d-%H%M%S", time.gmtime())
+        rnd = norm["round"] or art.next_round(repo_root)
+        header = prov.provenance_block(repo_root)
+        run_dir = os.path.join(base, run_id)
+        if artifact_path is None:
+            # a cpu-smoke drill must never claim (or clobber) the
+            # repo-root witnessed slot a real chip run owns — its
+            # artifact stays inside the run directory unless the
+            # operator points elsewhere explicitly
+            art_dir = run_dir if cpu_smoke else repo_root
+            artifact_path = os.path.join(
+                art_dir, f"BENCH_r{rnd:02d}_witnessed.json")
+        run_doc = {
+            "v": 1,
+            "run_id": run_id,
+            "cpu_smoke": bool(cpu_smoke),
+            "round": rnd,
+            "keep_going": norm["keep_going"],
+            "report": norm["report"],
+            "artifact": artifact_path,
+            "plan": stage_plan,
+            "header": header,
+            "genesis": prov.chain_hash("", header),
+        }
+        os.makedirs(run_dir, exist_ok=True)
+        _atomic_write(os.path.join(run_dir, "run.json"), run_doc)
+        return cls(repo_root, run_dir, run_doc, log=log)
+
+    @classmethod
+    def load(cls, repo_root: str, run_dir: str, log=print) -> "WitnessRun":
+        with open(os.path.join(run_dir, "run.json")) as f:
+            return cls(repo_root, run_dir, json.load(f), log=log)
+
+    @staticmethod
+    def _unfinished(base: str) -> list[str]:
+        """Unfinalized run ids under base, newest first."""
+        try:
+            runs = sorted(d for d in os.listdir(base)
+                          if os.path.exists(
+                              os.path.join(base, d, "run.json")))
+        except OSError:
+            return []
+        return [rid for rid in reversed(runs)
+                if not os.path.exists(os.path.join(base, rid,
+                                                   "final.json"))]
+
+    # -- checkpoints -------------------------------------------------------
+
+    def _ckpt_path(self, idx: int, name: str) -> str:
+        return os.path.join(self.run_dir, f"{idx:02d}_{name}.json")
+
+    def checkpoints(self) -> list[dict]:
+        """Stage checkpoints in plan order, stopping at the first gap
+        (stages run strictly in order — a gap means nothing after it
+        ever ran)."""
+        out = []
+        for i, spec in enumerate(self.doc["plan"]):
+            path = self._ckpt_path(i, spec["name"])
+            if not os.path.exists(path):
+                break
+            try:
+                with open(path) as f:
+                    out.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                break
+        return out
+
+    def chain_ok(self, ckpts: list[dict]) -> list[str]:
+        return prov.verify_chain({"header": self.doc["header"],
+                                  "genesis": self.doc["genesis"],
+                                  "stages": ckpts})
+
+    def finalized(self) -> bool:
+        return os.path.exists(os.path.join(self.run_dir, "final.json"))
+
+    # -- execution ---------------------------------------------------------
+
+    def run_stage(self, idx: int, spec: dict, prev_hash: str,
+                  device: dict | None) -> dict:
+        env = dict(os.environ)
+        env.update(spec["env"])
+        stamp = prov.provenance_block(self.repo_root,
+                                      extra_env=spec["env"])
+        if device:
+            stamp["device"] = device
+        self.log(f"fdwitness: stage {spec['name']} "
+                 f"(timeout {spec['timeout_s']:.0f}s)")
+        t0 = time.monotonic()
+        ru0 = resource.getrusage(resource.RUSAGE_CHILDREN)
+        status, rc, out_text = "ok", 0, ""
+        try:
+            r = subprocess.run(spec["cmd"], env=env, cwd=self.repo_root,
+                               capture_output=True, text=True,
+                               timeout=spec["timeout_s"])
+            rc = r.returncode
+            out_text = (r.stdout or "") + "\n--- stderr ---\n" \
+                + (r.stderr or "")
+            result = _last_json_line(r.stdout or "")
+            if result is None:
+                status = "failed"
+                result = {"error": "no JSON result line on stdout"}
+            elif rc != 0:
+                # the stage children (bench.py child modes, the mxu
+                # experiment, the multichip shootout) all exit 0 on
+                # success — a nonzero exit is a failure even when a
+                # JSON line made it out (e.g. multichip's structured
+                # no-mesh error); the parsed result is kept in the
+                # checkpoint for diagnosis, and resume reruns it
+                status = "failed"
+                result.setdefault("stage_rc", rc)
+        except subprocess.TimeoutExpired as e:
+            status, rc = "timeout", -1
+            out_text = ((e.stdout or b"").decode("utf-8", "replace")
+                        if isinstance(e.stdout, bytes)
+                        else (e.stdout or ""))
+            result = {"error":
+                      f"stage deadline {spec['timeout_s']:.0f}s "
+                      f"expired (subprocess killed)"}
+        except OSError as e:
+            status, rc = "failed", -1
+            result = {"error": f"spawn failed: {e!r}"}
+        ru1 = resource.getrusage(resource.RUSAGE_CHILDREN)
+        dur = time.monotonic() - t0
+        log_path = self._ckpt_path(idx, spec["name"])[:-5] + ".log"
+        try:
+            with open(log_path, "w") as f:
+                f.write(out_text)
+        except OSError:
+            pass
+        ckpt = {
+            "stage": spec["name"],
+            "idx": idx,
+            "status": status,
+            "rc": rc,
+            "duration_s": round(dur, 3),
+            "rusage": {
+                "utime_s": round(ru1.ru_utime - ru0.ru_utime, 3),
+                "stime_s": round(ru1.ru_stime - ru0.ru_stime, 3),
+                "maxrss_kb": ru1.ru_maxrss,
+            },
+            "cmd": spec["cmd"],
+            "env": spec["env"],
+            "result": result,
+            "provenance": stamp,
+        }
+        prov.seal(ckpt, prev_hash)
+        _atomic_write(self._ckpt_path(idx, spec["name"]), ckpt)
+        self.log(f"fdwitness: stage {spec['name']} -> {status} "
+                 f"({dur:.1f}s)")
+        return ckpt
+
+    def run(self) -> int:
+        """Resume/run the sweep. Returns 0 when every stage completed
+        and the artifact was finalized; 1 when a stage failed (and
+        keep_going is off); 2 when the existing checkpoint chain is
+        broken (refuse to extend a tampered run)."""
+        ckpts = self.checkpoints()
+        # completed prefix: ok/skipped stages are skipped on resume;
+        # the first failed/timeout checkpoint (and everything after)
+        # reruns — failures are exactly what a tunnel flap leaves
+        done = []
+        for c in ckpts:
+            if c.get("status") in DONE_STATUSES:
+                done.append(c)
+            else:
+                break
+        errors = self.chain_ok(done)
+        if errors:
+            for e in errors:
+                self.log(f"fdwitness: CHAIN BROKEN: {e}")
+            return 2
+        if done:
+            self.log(f"fdwitness: resuming {self.doc['run_id']} — "
+                     f"{len(done)}/{len(self.doc['plan'])} stages "
+                     f"already witnessed")
+        device = None
+        for c in done:
+            if c["stage"] == "device_probe" and \
+                    isinstance(c.get("result"), dict):
+                device = c["result"]
+        prev_hash = done[-1]["hash"] if done else self.doc["genesis"]
+        for idx in range(len(done), len(self.doc["plan"])):
+            spec = self.doc["plan"][idx]
+            ckpt = self.run_stage(idx, spec, prev_hash, device)
+            prev_hash = ckpt["hash"]
+            done.append(ckpt)
+            if ckpt["stage"] == "device_probe" and \
+                    ckpt["status"] == "ok":
+                device = ckpt["result"]
+            if ckpt["status"] not in DONE_STATUSES and \
+                    not self.doc.get("keep_going"):
+                self.log(f"fdwitness: stage {spec['name']} "
+                         f"{ckpt['status']} — parking the sweep "
+                         f"(resume with the same run-id)")
+                return 1
+        self.finalize(done)
+        return 0
+
+    # -- artifact ----------------------------------------------------------
+
+    def finalize(self, ckpts: list[dict] | None = None) -> str:
+        ckpts = ckpts if ckpts is not None else self.checkpoints()
+        doc = art.assemble(self.doc, ckpts)
+        out_path = self.doc["artifact"]
+        # last-line defense (the cpu-smoke default path already avoids
+        # this): a cpu-measured record must never overwrite an
+        # existing chip-witnessed artifact — the chip number is the
+        # irreplaceable one. Divert into the run dir and say so.
+        if str(doc.get("platform", "")).startswith("cpu") and \
+                os.path.exists(out_path):
+            try:
+                with open(out_path) as f:
+                    old_plat = str(json.load(f).get("platform", ""))
+            except (OSError, json.JSONDecodeError):
+                old_plat = ""
+            if old_plat and not old_plat.startswith("cpu"):
+                diverted = os.path.join(self.run_dir,
+                                        os.path.basename(out_path))
+                self.log(f"fdwitness: {out_path} holds a "
+                         f"{old_plat!r}-witnessed record — NOT "
+                         f"overwriting with a cpu run; artifact "
+                         f"diverted to {diverted}")
+                out_path = self.doc["artifact"] = diverted
+        _atomic_write(out_path, doc)
+        _atomic_write(os.path.join(self.run_dir, "final.json"),
+                      {"artifact": out_path,
+                       "head": doc["witness"]["head"]})
+        self.log(f"fdwitness: artifact {out_path} "
+                 f"(head {doc['witness']['head'][:12]}...)")
+        if self.doc.get("report", True):
+            try:
+                rep = self._report(out_path, doc)
+                self.log(f"fdwitness: report {rep}")
+            except Exception as e:  # noqa: BLE001 — the artifact stands
+                self.log(f"fdwitness: report failed: {e!r}")
+        return out_path
+
+    def _report(self, artifact_path: str, doc: dict) -> str:
+        """ONE merged fdgui report next to the artifact: every BENCH
+        round's trend plus this run, the per-stage profile digests as
+        flamegraph data, and the provenance/witness header panel."""
+        import glob as _glob
+        from ..gui.report import report_from_bench
+        rounds = sorted(_glob.glob(
+            os.path.join(self.repo_root, "BENCH_r*.json")))
+        rounds = [r for r in rounds
+                  if "witnessed" not in os.path.basename(r)]
+        flame = {}
+        prof = (doc.get("e2e_profile") or {})
+        for tn, p in prof.items():
+            if isinstance(p, dict) and p.get("top"):
+                flame[tn] = {t["stack"]: {"work": int(t["count"])}
+                             for t in p["top"]}
+        rep_path = os.path.splitext(artifact_path)[0] + ".report.html"
+        return report_from_bench(rounds + [artifact_path], rep_path,
+                                 witness=doc.get("witness"),
+                                 witnessed=doc.get("witnessed"),
+                                 flame=flame)
+
+
+def dry_run(repo_root: str, cfg: dict | None, cpu_smoke: bool,
+            stages: list[str] | None, out=sys.stdout) -> int:
+    """`fdwitness --dry-run`: validate the plan + provenance capture
+    without running any stage or creating a run dir — the CI hook that
+    keeps the sweep one WORKING command while the tunnel is down."""
+    stage_plan = planmod.build_plan(cfg, repo_root, cpu_smoke=cpu_smoke,
+                                    stages=stages)
+    header = prov.provenance_block(repo_root)
+    doc = {
+        "dry_run": True,
+        "round": (planmod.normalize_witness(cfg)["round"]
+                  or art.next_round(repo_root)),
+        "plan": [{"name": s["name"], "cmd": s["cmd"],
+                  "env": s["env"], "timeout_s": s["timeout_s"]}
+                 for s in stage_plan],
+        "header": header,
+        "genesis": prov.chain_hash("", header),
+    }
+    json.dump(doc, out, indent=1, sort_keys=True)
+    out.write("\n")
+    return 0

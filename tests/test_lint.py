@@ -363,6 +363,34 @@ def test_shed_section_is_clean_when_valid():
     assert lint_config(cfg, "<fixture>") == []
 
 
+def test_bad_witness_schema_and_stage_did_you_mean():
+    # typo'd [witness] key: the witness/plan.py schema gate
+    cfg = _cfg(witness={"stagez": ["kernel_vps"]})
+    findings = lint_config(cfg, "<fixture>")
+    fires_once(findings, "bad-witness")
+    assert "did you mean 'stage'" in findings[0].message
+    # unknown stage name with suggestion
+    findings = lint_config(_cfg(witness={"stages": ["kernel_vp"]}),
+                           "<fixture>")
+    fires_once(findings, "bad-witness")
+    assert "did you mean 'kernel_vps'" in findings[0].message
+    # malformed per-stage override
+    fires_once(lint_config(_cfg(witness={
+        "stage": {"kernel_vps": {"cmd": "not an argv list"}}}),
+        "<fixture>"), "bad-witness")
+    # out-of-range park window
+    fires_once(lint_config(_cfg(witness={"park_s": 10.0,
+                                         "park_max_s": 1.0}),
+                           "<fixture>"), "bad-witness")
+
+
+def test_witness_section_is_clean_when_valid():
+    cfg = _cfg(witness={"stages": ["device_probe", "kernel_vps"],
+                        "park_s": 5.0, "park_max_s": 60.0,
+                        "stage": {"kernel_vps": {"timeout_s": 900.0}}})
+    assert lint_config(cfg, "<fixture>") == []
+
+
 def test_lint_topology_programmatic():
     """Programmatic Topology builds get the same pass as TOML."""
     from firedancer_tpu.disco import Topology

@@ -125,6 +125,12 @@ def test_oversize_value_typed_error_not_crash(tmp_path):
         assert c.call(OP_PUT, 2, b"\x06" * 32, b"ok")[0] == ST_OK
         st, val = c.call(OP_GET, 3, b"\x06" * 32)
         assert (st, val) == (ST_OK, b"ok")
+        # the errs counter lands at the tile's next housekeeping flush
+        # — wait for it instead of racing it (1-core CI deflake)
+        deadline = time.monotonic() + 10
+        while runner.metrics("vinyl")["errs"] != 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
         assert runner.metrics("vinyl")["errs"] == 1
     finally:
         runner.halt()

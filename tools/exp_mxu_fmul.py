@@ -160,6 +160,20 @@ def main():
     verdict = "GO" if best != "vpu" and speedup > 2.0 else "NO-GO"
     print(f"best={best} speedup_vs_vpu_control={speedup:.2f}x "
           f"-> {verdict} (decision threshold 2.0x; update PERF.md)")
+    # machine-readable tail (the fdwitness stage contract: the LAST
+    # JSON-object line of stdout is the stage result)
+    import json
+    print(json.dumps({
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "batch": B, "reps": args.reps,
+        "ns_per_fmul_conv_lane": {k: round(v, 2)
+                                  for k, v in results.items()},
+        "mxu_best": best,
+        "mxu_speedup_vs_vpu": round(speedup, 3),
+        "mxu_threshold": 2.0,
+        "mxu_verdict": verdict,
+    }))
 
 
 if __name__ == "__main__":
