@@ -490,14 +490,17 @@ _LEADER_LINKS = ("ingest", "dedup_pack", "pack_bank0", "bank0_poh",
                  "poh_entries", "shreds_mirror")
 
 
-def _leader_hop_snapshot(runner, verify_tiles):
+def _leader_hop_snapshot(runner, verify_tiles, tiles_extra=()):
     """Cumulative per-tile work/wait sums + per-link backpressure —
-    diffed per sweep stanza to attribute the saturating hop."""
+    diffed per sweep stanza to attribute the saturating hop.
+    tiles_extra: additional tile names beyond the canonical leader set
+    (the r16 exec-family loop adds resolv + exec shards)."""
     from firedancer_tpu.disco.metrics import (read_hists,
                                               read_link_metrics)
     tiles = {}
     names = list(_LEADER_TILES) + [f"verify{i}"
-                                   for i in range(verify_tiles)]
+                                   for i in range(verify_tiles)] \
+        + list(tiles_extra)
     for t in names:
         h = read_hists(runner.wksp, runner.plan, t)
         if not h:
@@ -510,7 +513,7 @@ def _leader_hop_snapshot(runner, verify_tiles):
     return {"tiles": tiles, "links": links}
 
 
-def _leader_hop(prev, cur, verify_tiles):
+def _leader_hop(prev, cur, verify_tiles, links_extra=()):
     """(top occupancy tile, first backpressured link) over a stanza
     window, from two cumulative snapshots."""
     occ = {}
@@ -520,7 +523,8 @@ def _leader_hop(prev, cur, verify_tiles):
         occ[t] = dw / (dw + di) if dw + di else 0.0
     top = max(occ, key=occ.get) if occ else None
     link_order = ["ingest"] + [f"vd{i}" for i in range(verify_tiles)] \
-        + [ln for ln in _LEADER_LINKS if ln != "ingest"]
+        + [ln for ln in _LEADER_LINKS if ln != "ingest"] \
+        + list(links_extra)
     bp = next((ln for ln in link_order
                if cur["links"].get(ln, 0)
                - prev["links"].get(ln, 0) > 0), None)
@@ -528,17 +532,23 @@ def _leader_hop(prev, cur, verify_tiles):
 
 
 def _leader_wait_drained(runner, count, verify_tiles,
-                         timeout_s=600.0):
+                         timeout_s=600.0, resolv=False):
     """Block until every synth txn reached a TERMINAL outcome
     (executed by the bank, or dropped at a named hop — conservation
     accounting, so a still-chewing pipeline is never mistaken for a
-    drained one) and pack has retired every outstanding microblock."""
+    drained one) and pack has retired every outstanding microblock.
+    resolv=True adds the r16 resolv tile's drop counters to the
+    conservation sum (the exec-family loop runs it ahead of pack)."""
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         runner.check_failures()
         p = runner.metrics("pack")
         b = runner.metrics("bank0")
         dropped = runner.metrics("dedup")["dup"] + p["parse_fail"]
+        if resolv:
+            r = runner.metrics("resolv")
+            dropped += r["parse_fail"] + r["alut_fail"] \
+                + r["fee_fail"] + r["oversz"]
         for i in range(verify_tiles):
             v = runner.metrics(f"verify{i}")
             dropped += v["parse_fail"] + v["dedup_drop"] \
@@ -661,6 +671,156 @@ def _leader_bench():
                 "first_backpressured_link":
                     at["first_backpressured_link"],
             }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+def _exec_leader_topology(count, unique, batch, verify_tiles,
+                          exec_cnt, rate_tps, tcache_depth=None):
+    """The r16 leader loop: the _leader_topology shape with execution
+    scaled OUT of the bank — a resolv tile ahead of pack (RESOLVED
+    frames: account sets + cost precomputed) and `exec_cnt` exec tiles
+    pulling conflict-free waves over rings, all sharing the shm funk
+    store. The bank keeps wave scheduling / commit ordering / PoH
+    handoff. pack and bank consume their feedback links unreliably —
+    the exec fan-out adds a bank0->exec->bank0 leg that would
+    otherwise close a reliable-consumption cycle."""
+    from firedancer_tpu.disco import Topology
+    if tcache_depth is None:
+        tcache_depth = max(16, 1 << (max(64, int(unique)).bit_length()
+                                     - 4))
+    cpus = os.cpu_count() or 1
+    cpu0 = 1 if cpus >= verify_tiles + exec_cnt + 7 else None
+    vd = [f"vd{i}" for i in range(verify_tiles)]
+    disp = [f"exec_disp{i}" for i in range(exec_cnt)]
+    done = [f"exec_done{i}" for i in range(exec_cnt)]
+    topo = (
+        Topology(f"exl{os.getpid()}", wksp_size=1 << 27,
+                 funk={"backend": "shm", "heap_mb": 16})
+        .link("ingest", depth=4096, mtu=1280)
+        .link("dedup_resolv", depth=4096, mtu=1280)
+        .link("resolv_pack", depth=4096, mtu=2048)
+        .link("pack_bank0", depth=256, mtu=16384)
+        .link("bank0_done", depth=256, mtu=64)
+        .link("bank0_poh", depth=256, mtu=16448)
+        .link("poh_entries", depth=512, mtu=16640)
+        .link("poh_slots", depth=64, mtu=64)
+        .link("shreds_mirror", depth=4096, mtu=1280)
+        .link("shred_req", depth=32, mtu=1280)
+        .link("sign_resp", depth=32, mtu=128)
+        .tcache("dedup_tc", depth=tcache_depth)
+        .tile("synth", "synth", outs=["ingest"], count=count,
+              unique=unique, burst=512, seed=17, rate_tps=rate_tps)
+        .tile("dedup", "dedup", ins=vd, outs=["dedup_resolv"],
+              tcache="dedup_tc", batch=1024)
+        .tile("resolv", "resolv", ins=["dedup_resolv"],
+              outs=["resolv_pack"], batch=256, fee_payer_check=False)
+        .tile("pack", "pack",
+              ins=["resolv_pack", ("bank0_done", False),
+                   ("poh_slots", False)],
+              outs=["pack_bank0"], txn_in="resolv_pack",
+              resolved_in=True, bank_links=["pack_bank0"],
+              done_links=["bank0_done"], slot_in="poh_slots",
+              max_txn_per_microblock=31, wave=4, batch=256)
+        .tile("bank0", "bank",
+              ins=["pack_bank0"] + [(ln, False) for ln in done],
+              outs=["bank0_done", "bank0_poh"] + disp,
+              exec="svm", wave=8, poh_link="bank0_poh",
+              forward_payloads=True, genesis_synth=unique,
+              exec_links=disp, exec_done=done)
+        .tile("poh", "poh", ins=["bank0_poh"],
+              outs=["poh_entries", "poh_slots"],
+              slot_link="poh_slots", hashes_per_tick=64,
+              ticks_per_slot=8)
+        .tile("shred", "shred", mode="leader",
+              ins=["poh_entries", ("sign_resp", False)],
+              outs=["shred_req", "shreds_mirror"], req="shred_req",
+              resp="sign_resp", shreds_link="shreds_mirror",
+              identity_hex="03a107bff3ce10be1d70dd18e74bc09967e4d63"
+                           "09ba50d5f1ddc8664125531b8",
+              cluster=[{"pubkey_hex": "55" * 32, "stake": 100,
+                        "addr": "127.0.0.1:9"}])
+        .tile("sign", "sign", ins=[("shred_req", False)],
+              outs=["sign_resp"],
+              seed="000102030405060708090a0b0c0d0e0f10111213141516"
+                   "1718191a1b1c1d1e1f",
+              clients=[{"role": "leader", "req": "shred_req",
+                        "resp": "sign_resp"}])
+        .tile("shredsink", "sink", ins=["shreds_mirror"]))
+    for ln in disp:
+        topo.link(ln, depth=64, mtu=4096)
+    for ln in done:
+        topo.link(ln, depth=64, mtu=64)
+    for i in range(verify_tiles):
+        topo.link(vd[i], depth=4096, mtu=1280)
+        topo.tcache(f"vtc{i}", depth=tcache_depth)
+    topo.sharded_tile(
+        "verify", "verify", verify_tiles, ins=["ingest"], outs=vd,
+        batch=batch, coalesce_us=500, cpu0=cpu0,
+        tcache=[f"vtc{i}" for i in range(verify_tiles)])
+    topo.sharded_tile("exec", "exec", exec_cnt, ins=[disp], outs=done,
+                      batch=8)
+    return topo
+
+
+def _exec_scale_bench():
+    """Execution scale-out stage (r16): one unpaced capacity boot of
+    the exec-family leader loop per exec_tile_cnt — the measurement
+    behind "execution scales with tile count". Per count: bank-executed
+    txns per wall second (RUN -> drained) and the run's saturating-hop
+    attribution (top-occupancy tile + first backpressured link) so the
+    record shows WHO the bottleneck is once the bank stops executing.
+
+    Prints one JSON line with exec_scale_tps {cnt: tps},
+    exec_scale_hop {cnt: hop}, flat exec_scale_tps_N gate metrics, and
+    exec_scale_leader_hop — the post-refactor leader-loop hop at 2
+    exec tiles. The parent process must not touch jax."""
+    sys.path.insert(0, HERE)
+    from firedancer_tpu.disco import TopologyRunner
+    count = int(os.environ.get("FDTPU_BENCH_EXEC_COUNT", "4096"))
+    unique = int(os.environ.get("FDTPU_BENCH_EXEC_UNIQUE", "768"))
+    batch = int(os.environ.get("FDTPU_BENCH_EXEC_BATCH", "32"))
+    vtiles = int(os.environ.get("FDTPU_BENCH_EXEC_VERIFY_TILES", "2"))
+    cnts = [int(c) for c in os.environ.get(
+        "FDTPU_BENCH_EXEC_SCALE_CNTS", "1,2,4").split(",")
+        if c.strip()]
+    out = {"exec_scale_tps": {}, "exec_scale_hop": {},
+           "exec_scale_count": count}
+    for cnt in cnts:
+        tiles_extra = ["resolv"] + [f"exec{i}" for i in range(cnt)]
+        links_extra = ["dedup_resolv", "resolv_pack"] \
+            + [f"exec_disp{i}" for i in range(cnt)] \
+            + [f"exec_done{i}" for i in range(cnt)]
+        runner = TopologyRunner(
+            _exec_leader_topology(count, unique, batch, vtiles, cnt,
+                                  rate_tps=0.0).build()).start()
+        try:
+            runner.wait_running(timeout_s=840)
+            snap0 = _leader_hop_snapshot(runner, vtiles, tiles_extra)
+            t0 = time.perf_counter()
+            runner.wait_idle("synth", "tx", count, timeout_s=600)
+            _leader_wait_drained(runner, count, vtiles, resolv=True)
+            wall = time.perf_counter() - t0
+            snap1 = _leader_hop_snapshot(runner, vtiles, tiles_extra)
+            txns = runner.metrics("bank0")["txns"]
+            top, bp = _leader_hop(snap0, snap1, vtiles, links_extra)
+            tps = round(txns / wall, 1) if wall else 0.0
+            out["exec_scale_tps"][str(cnt)] = tps
+            out[f"exec_scale_tps_{cnt}"] = tps
+            out["exec_scale_hop"][str(cnt)] = {
+                "top_occupancy_tile": top,
+                "first_backpressured_link": bp,
+            }
+        finally:
+            runner.halt()
+            runner.close()
+    tps = out["exec_scale_tps"]
+    if "1" in tps and "2" in tps:
+        out["exec_scale_monotonic_1_2"] = tps["2"] >= tps["1"]
+    hop_cnt = "2" if "2" in out["exec_scale_hop"] \
+        else (str(cnts[-1]) if cnts else None)
+    if hop_cnt:
+        out["exec_scale_leader_hop"] = out["exec_scale_hop"][hop_cnt]
     print(json.dumps(out))
     sys.stdout.flush()
 
@@ -1096,6 +1256,9 @@ def main():
     if os.environ.get("FDTPU_BENCH_FLOOD_CHILD") == "1":
         _flood_bench()
         return
+    if os.environ.get("FDTPU_BENCH_EXEC_SCALE_CHILD") == "1":
+        _exec_scale_bench()
+        return
     if os.environ.get("FDTPU_BENCH_CHILD") == "1":
         _child_bench()
         return
@@ -1198,6 +1361,28 @@ def main():
                     result[k] = v
         except Exception as e5:  # noqa: BLE001
             result["flood_error"] = f"{e5!r}"[:300]
+
+    # execution scale-out (r16): the shm-funk leader loop with the
+    # resolv + exec tile family, one capacity boot per exec_tile_cnt —
+    # the proof that execution scales with tile count, plus the
+    # post-refactor leader-hop attribution. CPU-measured by design
+    # (the exec hops are host code). Failures annotate, never break.
+    if os.environ.get("FDTPU_BENCH_SKIP_EXEC_SCALE") != "1":
+        try:
+            env = {"FDTPU_BENCH_EXEC_SCALE_CHILD": "1"}
+            if result.get("platform", "").startswith("cpu"):
+                env["FDTPU_JAX_PLATFORM"] = "cpu"
+                env["JAX_PLATFORMS"] = "cpu"
+            ex = _run_child(
+                env,
+                float(os.environ.get("FDTPU_BENCH_EXEC_SCALE_TIMEOUT",
+                                     "1800")),
+                require_key="exec_scale_tps")
+            for k, v in ex.items():
+                if k.startswith("exec_scale"):
+                    result[k] = v
+        except Exception as e6:  # noqa: BLE001
+            result["exec_scale_error"] = f"{e6!r}"[:300]
 
     # multichip layout stanza (ROADMAP 1b): the same machine-readable
     # candidate-layout record dryrun_multichip prints into the

@@ -132,7 +132,7 @@ except ModuleNotFoundError:          # py<3.11
                 "install 'tomli'") from e
 
 _TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo",
-                 "prof", "shed", "witness"}
+                 "prof", "shed", "witness", "funk"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -182,7 +182,7 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
         for key in ("topology", "trace", "slo", "prof", "shed",
-                    "witness"):
+                    "witness", "funk"):
             if key in layer:
                 merged = _deep_merge(cfg.get(key, {}), layer[key])
                 if key == "slo" and "target" in layer[key]:
@@ -256,10 +256,16 @@ def build_topology(cfg: dict, name: str | None = None):
     wit_cfg = cfg.get("witness")
     if wit_cfg is not None:
         normalize_witness(wit_cfg)
+    # [funk] account store — same gate (funk/shmfunk.py is the one
+    # validator; backend "shm" makes topo.build carve the store region)
+    from ..funk.shmfunk import normalize_funk
+    funk_cfg = cfg.get("funk")
+    if funk_cfg is not None:
+        normalize_funk(funk_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
                     wksp_size=int(top.get("wksp_size", 1 << 26)),
                     trace=trace_cfg, slo=slo_cfg, prof=prof_cfg,
-                    shed=shed_cfg)
+                    shed=shed_cfg, funk=funk_cfg)
     for ln in cfg.get("link", []):
         topo.link(ln["name"], depth=int(ln.get("depth", 128)),
                   mtu=int(ln.get("mtu", 1280)))

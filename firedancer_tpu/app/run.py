@@ -29,6 +29,10 @@ def main(argv=None) -> int:
     ap.add_argument("--name", default=None, help="topology name override")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="monitor refresh seconds")
+    ap.add_argument("--boot-timeout", type=float, default=600.0,
+                    help="seconds to wait for every tile to reach RUN "
+                         "(first-compile warmup on a cold or shared "
+                         "box can exceed the default)")
     args = ap.parse_args(argv)
 
     cfg = load_config(*args.config)
@@ -36,7 +40,7 @@ def main(argv=None) -> int:
     plan = topo.build()
     runner = TopologyRunner(plan).start()
     try:
-        runner.wait_running()
+        runner.wait_running(timeout_s=args.boot_timeout)
         t0 = time.monotonic()   # duration clock starts once tiles RUN
         next_print = 0.0
         while not args.duration \

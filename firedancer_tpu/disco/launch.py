@@ -124,6 +124,16 @@ class TopologyRunner:
             # joins its in rings at the producers' CURRENT seq
             plan = json.loads(json.dumps(self.plan))
             plan["tiles"][tn]["rejoin_at_tail"] = True
+            # a chaos drill simulates ONE fault per boot: the
+            # replacement process comes up clean, or a crash/wedge
+            # event re-arms every incarnation and breaker-loops the
+            # tile instead of exercising recovery. Plans that WANT
+            # the fault to survive respawn (crash-loop drills that
+            # drive the breaker open on purpose) opt in with
+            # {"rearm": true}.
+            ch = plan["tiles"][tn]["args"].get("chaos")
+            if not (isinstance(ch, dict) and ch.get("rearm")):
+                plan["tiles"][tn]["args"].pop("chaos", None)
         p = self._mp.Process(target=tile_main, args=(plan, tn),
                              name=f"tile:{tn}", daemon=True)
         p.start()

@@ -524,8 +524,15 @@ class PackAdapter:
 
     def __init__(self, ctx, args):
         from ..pack import PackScheduler, PackLimits
-        from ..pack.scheduler import meta_from_payload
+        from ..pack.scheduler import (meta_from_payload,
+                                      meta_from_resolved)
         self._meta_from_payload = meta_from_payload
+        # resolved_in: txn_in carries RESOLVED frames from a resolv
+        # tile (account sets + cost precomputed upstream, the
+        # reference's resolv->pack seam); bundles stay raw payloads
+        self._meta_txn_in = (meta_from_resolved
+                            if args.get("resolved_in")
+                            else meta_from_payload)
         self.ctx = ctx
         self.txn_in = args["txn_in"]
         self.bank_links = list(args["bank_links"])
@@ -589,7 +596,7 @@ class PackAdapter:
         for i in range(n):
             try:
                 self.sched.insert(
-                    self._meta_from_payload(bytes(buf[i, :sizes[i]])))
+                    self._meta_txn_in(bytes(buf[i, :sizes[i]])))
                 self.m["inserted"] += 1
             except Exception:
                 self.m["parse_fail"] += 1
@@ -694,6 +701,43 @@ class PackAdapter:
         return dict(self.m)
 
 
+# exec-family wire (r16): bank -> exec dispatch frame is
+# u64 wave_seq | u64 xid | u16 txn_cnt, then txn_cnt x
+# (32B src | 32B dst | u64 amount | u64 fee); exec -> bank completion
+# frag is u64 wave_seq | u32 ok | u32 fail
+_EXEC_HDR = struct.Struct("<QQH")
+_EXEC_TXN = struct.Struct("<QQ")
+_EXEC_TXN_SZ = 64 + _EXEC_TXN.size
+_EXEC_DONE = struct.Struct("<QII")
+
+
+def _conflict_groups(txns):
+    """Union-find partition of a wave's transfers into account-disjoint
+    conflict groups, each group in original txn order. Groups can run
+    concurrently on different exec tiles without breaking the serial
+    fiction; txns INSIDE a group must execute in order on one tile
+    (pack only prevents conflicts against OTHER banks' outstanding
+    microblocks — same-bank microblocks may conflict pairwise)."""
+    parent = {}
+
+    def find(k):
+        while parent[k] != k:
+            parent[k] = parent[parent[k]]
+            k = parent[k]
+        return k
+
+    for t in txns:
+        parent.setdefault(t.src, t.src)
+        parent.setdefault(t.dst, t.dst)
+        ra, rb = find(t.src), find(t.dst)
+        if ra != rb:
+            parent[ra] = rb
+    groups = {}
+    for t in txns:
+        groups.setdefault(find(t.src), []).append(t)
+    return list(groups.values())
+
+
 @register("bank")
 class BankAdapter:
     """Execution stage (ref: src/discoh/bank/fd_bank_tile.c shape:
@@ -729,34 +773,69 @@ class BankAdapter:
     balances are read only after the prior wave's commit, and the
     conflict DAG orders intra-wave dependencies.
 
+    Exec tile fan-out (r16): with `exec_links`/`exec_done` the bank
+    keeps only wave scheduling, commit ordering and the PoH handoff —
+    execution moves to the exec tile family over the shm funk store
+    (plan["funk"], backend "shm"). The gathered wave's transfers are
+    partitioned into CONFLICT GROUPS (union-find over account keys —
+    pack only guarantees non-conflict against OTHER banks' outstanding
+    microblocks, so same-bank waves may conflict internally and rely
+    on ordered execution); each group ships intact, in order, to one
+    exec tile as dispatch frames under ONE funk fork the bank
+    prepared. Groups are account-disjoint across tiles, so concurrent
+    execution preserves the serial fiction. The bank publishes the
+    fork only after every dispatch frame completed; a wave that
+    doesn't complete within `redispatch_s` (an exec tile died
+    mid-wave and its ring rejoin skipped the frames) is CANCELLED —
+    dropping every partial commit — and re-dispatched whole under a
+    fresh fork, so a supervised exec restart never wedges the leader
+    loop or leaves the store half-written.
+
+    Dispatch frame wire: u64 wave_seq | u64 xid | u16 txn_cnt |
+    txn_cnt x (32B src | 32B dst | u64 amount | u64 fee).
+    Completion frag: u64 wave_seq | u32 ok | u32 fail.
+
     args: exec, wave (microblocks per device wave), poh_link (optional
-    out link name), done link = the remaining out link."""
+    out link name), exec_links/exec_done (ordered per-exec-shard
+    dispatch/completion links), redispatch_s, done link = the
+    remaining out link."""
 
     METRICS = ["microblocks", "txns", "transfers", "exec_skip",
                "exec_fail", "overruns", "rpc_port", "ws_port",
-               "rewards_paid"]
+               "rewards_paid", "exec_waves", "exec_redispatch"]
     GAUGES = ["rpc_port", "ws_port"]
 
     def __init__(self, ctx, args):
         self.ctx = ctx
-        if len(ctx.in_rings) != 1:
+        self.exec_links = list(args.get("exec_links") or [])
+        self.exec_done = list(args.get("exec_done") or [])
+        if len(self.exec_links) != len(self.exec_done):
+            raise ValueError(
+                f"bank {ctx.tile_name}: exec_links/exec_done must "
+                f"pair up, got {self.exec_links} / {self.exec_done}")
+        non_done = [ln for ln in ctx.in_rings
+                    if ln not in self.exec_done]
+        if len(non_done) != 1:
             raise ValueError(f"bank tile {ctx.tile_name}: one in link")
-        self.in_link = next(iter(ctx.in_rings))
+        self.in_link = non_done[0]
         self.ring = ctx.in_rings[self.in_link]
         self.exec_mode = args.get("exec", "stub")
         self.poh_link = args.get("poh_link")
         if self.poh_link:
             self.poh_out = ctx.out_rings[self.poh_link]
             self.poh_fseqs = ctx.out_fseqs[self.poh_link]
-            done = [ln for ln in ctx.out_rings if ln != self.poh_link]
+            done = [ln for ln in ctx.out_rings
+                    if ln != self.poh_link and ln not in self.exec_links]
             assert len(done) == 1, done
             self.out = ctx.out_rings[done[0]]
             self.out_fseqs = ctx.out_fseqs[done[0]]
         else:
             self.poh_out = None
-            self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
-            self.out_fseqs = _single(ctx.out_fseqs, "out link",
-                                     ctx.tile_name)
+            done = [ln for ln in ctx.out_rings
+                    if ln not in self.exec_links]
+            assert len(done) == 1, done
+            self.out = ctx.out_rings[done[0]]
+            self.out_fseqs = ctx.out_fseqs[done[0]]
         self.m = {k: 0 for k in self.METRICS}
         self.slot = 0                  # highest slot seen in microblocks
         self._rewards_epoch = None     # lazily read from the marker
@@ -782,7 +861,23 @@ class BankAdapter:
             # genesis checkpoint: restore the WHOLE boot state (funded
             # users + vote/stake accounts from app/genesis.py) — the
             # dev command's wiring; production restores from snapshot
-            if args.get("genesis_ckpt"):
+            if self.exec_links:
+                if self.exec_mode != "svm":
+                    raise ValueError(
+                        f"bank {ctx.tile_name}: exec_links need "
+                        f"exec=\"svm\"")
+                if args.get("genesis_ckpt"):
+                    raise ValueError(
+                        f"bank {ctx.tile_name}: genesis_ckpt is "
+                        f"process-funk only, not exec fan-out")
+                fk = ctx.plan.get("funk") or {}
+                if fk.get("backend") != "shm" or "off" not in fk:
+                    raise ValueError(
+                        f"bank {ctx.tile_name}: exec_links need "
+                        f"[funk] backend=\"shm\"")
+                from ..funk.shmfunk import WireFunk
+                self.funk = WireFunk.from_plan(ctx.wksp, fk)
+            elif args.get("genesis_ckpt"):
                 from ..utils.checkpt import funk_restore
                 with open(args["genesis_ckpt"], "rb") as gf:
                     self.funk = funk_restore(Funk, gf)
@@ -835,6 +930,26 @@ class BankAdapter:
                 self.m["ws_port"] = self.ws.port
         self.seq = ctx.in_seq0.get(self.in_link, 0)
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+        self._ef = None                # exec-family: in-flight wave
+        if self.exec_links:
+            self.redispatch_s = float(args.get("redispatch_s", 2.0))
+            self._exec_out = [(ctx.out_rings[ln], ctx.out_fseqs[ln])
+                              for ln in self.exec_links]
+            self._done_rings = [ctx.in_rings[ln]
+                                for ln in self.exec_done]
+            self._done_seq = {ln: ctx.in_seq0.get(ln, 0)
+                              for ln in self.exec_done}
+            self._exec_cap = []
+            for ln in self.exec_links:
+                cap = (ctx.plan["links"][ln]["mtu"] - _EXEC_HDR.size) \
+                    // _EXEC_TXN_SZ
+                if cap < 1:
+                    raise ValueError(
+                        f"bank {ctx.tile_name}: exec link {ln} mtu "
+                        f"{ctx.plan['links'][ln]['mtu']} can't carry "
+                        f"one dispatch txn "
+                        f"({_EXEC_HDR.size + _EXEC_TXN_SZ}B)")
+                self._exec_cap.append(cap)
 
     def _parse_payloads(self, frame, txn_cnt):
         """THE microblock frame walker (header 20, u16-framed
@@ -933,6 +1048,8 @@ class BankAdapter:
         return txns, mixin
 
     def poll_once(self) -> int:
+        if self.exec_links:
+            return self._poll_exec_family()
         n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
             self.seq, self.wave, self.mtu)
         self.m["overruns"] += ovr
@@ -1046,6 +1163,139 @@ class BankAdapter:
                      + mixin + blob))
         self._flush_wave(poh_frames, [r[2] for r in recs])
 
+    def _poll_exec_family(self) -> int:
+        """Exec fan-out scheduler loop: drain completion frags, then —
+        only with NO wave outstanding — gather the next wave and
+        dispatch it. One wave outstanding keeps waves serial, so
+        cross-wave conflicts need no tracking at all."""
+        work = self._ef_drain_completions()
+        if self._ef is not None:
+            return work
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, self.wave, self.mtu)
+        self.m["overruns"] += ovr
+        if not n:
+            return work
+        import hashlib
+        recs, txns, slots_seen = [], [], []
+        for i in range(n):
+            frame = bytes(buf[i, :sizes[i]])
+            _bank, txn_cnt, mb_id, slot = struct.unpack_from(
+                "<HHQQ", frame, 0)
+            self.slot = max(self.slot, slot)
+            slots_seen.append(slot)
+            self.m["txns"] += txn_cnt
+            self.m["microblocks"] += 1
+            if txn_cnt:
+                t, mixin = self._parse_transfers(frame, txn_cnt)
+            else:
+                t, mixin = [], hashlib.sha256(b"").digest()
+            recs.append((frame, txn_cnt, mb_id, mixin))
+            txns.extend(t)
+        if self.ws is not None:
+            for s in sorted({s for s in slots_seen
+                             if s > self._ws_last_slot}):
+                self._ws_last_slot = s
+                self.ws.publish_slot(s)
+        self._ef = {"recs": recs, "txns": txns, "xid": None,
+                    "wave_seq": None, "remaining": 0, "ok": 0,
+                    "fail": 0, "deadline": None}
+        self._ef_send()
+        return work + n
+
+    def _ef_send(self):
+        """(Re-)dispatch the in-flight wave under a FRESH fork:
+        conflict groups round-robin across the exec tiles, each group
+        intact and in order on ONE tile (a group bigger than a link
+        frame splits into consecutive frames on the SAME ring, which
+        the exec tile executes in order at the fork layer)."""
+        import time
+        ef = self._ef
+        if not ef["txns"]:
+            self._ef_finish()
+            return
+        xid = self._next_xid
+        self._next_xid += 1
+        self.funk.txn_prepare(None, xid)
+        per_tile = [[] for _ in self.exec_links]
+        for gi, g in enumerate(_conflict_groups(ef["txns"])):
+            per_tile[gi % len(per_tile)].extend(g)
+        cnc = getattr(self.ctx, "cnc", None)
+        sent = 0
+        for ti, tl in enumerate(per_tile):
+            if not tl:
+                continue
+            out, fseqs = self._exec_out[ti]
+            cap = self._exec_cap[ti]
+            frames = []
+            for i in range(0, len(tl), cap):
+                chunk = tl[i:i + cap]
+                body = b"".join(
+                    t.src + t.dst + _EXEC_TXN.pack(t.amount, t.fee)
+                    for t in chunk)
+                frames.append(
+                    (xid, _EXEC_HDR.pack(xid, xid, len(chunk)) + body))
+            publish_wave(out, fseqs, frames, cnc=cnc)
+            sent += len(frames)
+        # wave_seq == xid: one monotonic counter identifies both the
+        # fork and the attempt, so a cancelled attempt's late
+        # completions can never alias the retry's
+        ef.update(xid=xid, wave_seq=xid, remaining=sent, ok=0, fail=0,
+                  deadline=time.monotonic() + self.redispatch_s)
+        self.m["exec_waves"] += 1
+
+    def _ef_drain_completions(self, allow_redispatch=True) -> int:
+        import time
+        total = 0
+        for ln, ring in zip(self.exec_done, self._done_rings):
+            n, self._done_seq[ln], buf, sizes, _sigs, ovr = \
+                ring.gather(self._done_seq[ln], 64, 64)
+            self.m["overruns"] += ovr
+            total += n
+            for i in range(n):
+                ws, ok, fail = _EXEC_DONE.unpack_from(
+                    bytes(buf[i, :sizes[i]]), 0)
+                ef = self._ef
+                if ef is None or ws != ef["wave_seq"]:
+                    continue       # a cancelled attempt's leftovers
+                ef["remaining"] -= 1
+                ef["ok"] += ok
+                ef["fail"] += fail
+        ef = self._ef
+        if ef is not None and ef["wave_seq"] is not None:
+            if ef["remaining"] <= 0:
+                self.funk.txn_publish(ef["xid"])
+                self.m["transfers"] += ef["ok"]
+                self.m["exec_fail"] += ef["fail"]
+                self._ef_finish()
+            elif allow_redispatch \
+                    and time.monotonic() > ef["deadline"]:
+                # an exec tile died mid-wave (its ring rejoin skipped
+                # our frames): cancel the fork — dropping every
+                # partial commit — and re-dispatch whole under a
+                # fresh one; store stays consistent, loop never wedges
+                self.m["exec_redispatch"] += 1
+                self.funk.txn_cancel(ef["xid"])
+                self._ef_send()
+        return total
+
+    def _ef_finish(self):
+        """Wave complete: poh mixin frames + completion frags flush in
+        the original microblock order (commit ordering stays with the
+        bank, exactly the in-process paths' contract)."""
+        recs = self._ef["recs"]
+        self._ef = None
+        poh_frames = []
+        if self.poh_out is not None:
+            for frame, txn_cnt, mb_id, mixin in recs:
+                if not txn_cnt:
+                    continue
+                blob = frame[20:] if self.fwd_payloads else b""
+                poh_frames.append(
+                    (mb_id, struct.pack("<QH", mb_id, txn_cnt)
+                     + mixin + blob))
+        self._flush_wave(poh_frames, [r[2] for r in recs])
+
     def _wave_general(self, frames):
         """The FULL host SVM per microblock (inherently host-serial
         per txn), with the wave's poh frames + completions flushed as
@@ -1145,6 +1395,241 @@ class BankAdapter:
         # completions (the verify tile's flush contract)
         if self._pending is not None:
             self._finalize_wave()
+        if self._ef is not None:
+            # bounded drain — exec tiles are halting too, so after the
+            # window give up and cancel the fork rather than wedge the
+            # halt (no poh frame is emitted for a wave that never
+            # completed; the store holds no partial commits)
+            import time
+            t0 = time.monotonic()
+            while self._ef is not None \
+                    and time.monotonic() - t0 < self.redispatch_s:
+                self._ef_drain_completions(allow_redispatch=False)
+                if self._ef is not None:
+                    time.sleep(0.001)
+            if self._ef is not None:
+                if self._ef["xid"] is not None:
+                    self.funk.txn_cancel(self._ef["xid"])
+                self._ef = None
+
+    def in_seqs(self):
+        s = {self.in_link: self.seq}
+        if self.exec_links:
+            s.update(self._done_seq)
+        return s
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("exec")
+class ExecAdapter:
+    """Exec tile (r16, ref: src/discof/exec/fd_exec_tile.c): one shard
+    of the bank's execution fan-out. Consumes the bank's
+    conflict-group dispatch frames, executes them through the
+    WaveExecutor against the shm funk store AT THE FORK THE BANK
+    PREPARED — dispatch reads balances at the frame's xid itself, so
+    a split group's later frames see the earlier frames' commits
+    (WireFunk's txn_prepare is idempotent, which is what lets the
+    WaveExecutor's stage->dispatch->finalize seam run here unchanged)
+    — and publishes one completion frag per frame. A frame whose fork
+    the bank already cancelled (timeout redispatch) is abandoned with
+    NO completion: the retry under the fresh fork supersedes it.
+
+    args: batch (dispatch frames gathered per poll)."""
+
+    METRICS = ["frames", "txns", "ok", "fail", "stale_xid",
+               "overruns", "backpressure"]
+
+    def __init__(self, ctx, args):
+        self.ctx = ctx
+        if len(ctx.in_rings) != 1:
+            raise ValueError(f"exec tile {ctx.tile_name}: one in link")
+        self.in_link = next(iter(ctx.in_rings))
+        self.ring = ctx.in_rings[self.in_link]
+        self.out = _single(ctx.out_rings, "out link", ctx.tile_name)
+        self.out_fseqs = _single(ctx.out_fseqs, "out link",
+                                 ctx.tile_name)
+        self.batch = max(1, int(args.get("batch", 8)))
+        fk = ctx.plan.get("funk") or {}
+        if fk.get("backend") != "shm" or "off" not in fk:
+            raise ValueError(
+                f"exec {ctx.tile_name}: needs [funk] backend=\"shm\"")
+        _setup_jax()
+        from ..funk.shmfunk import WireFunk
+        from ..svm.executor import WaveExecutor
+        self.funk = WireFunk.from_plan(ctx.wksp, fk)
+        self._wx = WaveExecutor()
+        self.m = {k: 0 for k in self.METRICS}
+        self.seq = ctx.in_seq0.get(self.in_link, 0)
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, self.batch, self.mtu)
+        self.m["overruns"] += ovr
+        if not n:
+            return 0
+        from ..funk import FunkTxnError
+        from ..svm.executor import STATUS_OK, SystemTxn
+        comps = []
+        for i in range(n):
+            frame = bytes(buf[i, :sizes[i]])
+            wave_seq, xid, cnt = _EXEC_HDR.unpack_from(frame, 0)
+            off = _EXEC_HDR.size
+            txns = []
+            for _ in range(cnt):
+                amt, fee = _EXEC_TXN.unpack_from(frame, off + 64)
+                txns.append(SystemTxn(
+                    src=frame[off:off + 32],
+                    dst=frame[off + 32:off + 64],
+                    amount=amt, fee=fee))
+                off += _EXEC_TXN_SZ
+            self.m["frames"] += 1
+            self.m["txns"] += cnt
+            try:
+                staged = self._wx.stage(txns)
+                disp = self._wx.dispatch(self.funk, xid, xid, staged)
+                st = self._wx.finalize(self.funk, disp)
+            except (FunkTxnError, KeyError, MemoryError):
+                self.m["stale_xid"] += 1
+                continue
+            ok = sum(1 for s in st if s == STATUS_OK)
+            self.m["ok"] += ok
+            self.m["fail"] += len(st) - ok
+            comps.append((wave_seq,
+                          _EXEC_DONE.pack(wave_seq, ok, len(st) - ok)))
+        if comps:
+            publish_wave(self.out, self.out_fseqs, comps,
+                         cnc=getattr(self.ctx, "cnc", None))
+        return n
+
+    def in_seqs(self):
+        return {self.in_link: self.seq}
+
+    def metrics_items(self):
+        return dict(self.m)
+
+
+@register("resolv")
+class ResolvAdapter:
+    """Resolution stage ahead of pack (r16, ref: src/discof/resolv/
+    fd_resolv_tile.c): parse each txn once, resolve v0 address-table
+    loads against the shm account store, drop txns whose fee payer
+    can't cover the signature fee, and ship RESOLVED frames so pack
+    never re-parses and never needs account-db access (pack side:
+    resolved_in + pack/scheduler.py meta_from_resolved).
+
+    Without a shm [funk] section the tile still runs — legacy txns
+    resolve statically from their own account keys; v0 txns with
+    table loads are refused (alut_fail), exactly meta_from_payload's
+    rule — and the fee-payer gate is off (no store to read).
+
+    args: batch, fee_payer_check (default on when the store is
+    present)."""
+
+    METRICS = ["rx", "resolved", "parse_fail", "alut_fail",
+               "fee_fail", "oversz", "overruns", "backpressure"]
+
+    def __init__(self, ctx, args):
+        self.ctx = ctx
+        if len(ctx.in_rings) != 1:
+            raise ValueError(
+                f"resolv tile {ctx.tile_name}: one in link")
+        self.in_link = next(iter(ctx.in_rings))
+        self.ring = ctx.in_rings[self.in_link]
+        out = [ln for ln in ctx.out_rings]
+        if len(out) != 1:
+            raise ValueError(
+                f"resolv tile {ctx.tile_name}: one out link")
+        self.out_link = out[0]
+        self.out = ctx.out_rings[self.out_link]
+        self.out_fseqs = ctx.out_fseqs[self.out_link]
+        self.batch = max(1, int(args.get("batch", 64)))
+        self.db = None
+        fk = ctx.plan.get("funk") or {}
+        if fk.get("backend") == "shm" and "off" in fk:
+            from ..funk.shmfunk import WireFunk
+            from ..svm.accdb import AccDb
+            self.db = AccDb(WireFunk.from_plan(ctx.wksp, fk))
+        self.fee_check = bool(args.get("fee_payer_check",
+                                       self.db is not None))
+        if self.fee_check and self.db is None:
+            raise ValueError(
+                f"resolv {ctx.tile_name}: fee_payer_check needs "
+                f"[funk] backend=\"shm\"")
+        self.m = {k: 0 for k in self.METRICS}
+        self.seq = ctx.in_seq0.get(self.in_link, 0)
+        self.mtu = ctx.plan["links"][self.in_link]["mtu"]
+        self.out_mtu = ctx.plan["links"][self.out_link]["mtu"]
+
+    def _resolve(self, payload):
+        """payload -> RESOLVED frame bytes, or None (counted drop).
+        The meta_from_payload cost/reward model with the v0 refusal
+        replaced by REAL table resolution against the store."""
+        from ..pack.cost import CostError
+        from ..pack.scheduler import (FEE_PER_SIGNATURE, TxnMeta,
+                                      serialize_resolved,
+                                      txn_cost_and_reward)
+        from ..protocol.txn import parse_txn
+        from ..svm.alut import AlutResolveError, resolve_loaded_keys
+        try:
+            t = parse_txn(payload)
+        except Exception:
+            self.m["parse_fail"] += 1
+            return None
+        keys = t.account_keys(payload)
+        flags = [t.is_writable(i) for i in range(t.acct_cnt)]
+        if t.version == 0 and t.aluts:
+            if self.db is None:
+                self.m["alut_fail"] += 1
+                return None
+            try:
+                lk, lw = resolve_loaded_keys(self.db, None, t,
+                                             slot=0)
+            except AlutResolveError:
+                self.m["alut_fail"] += 1
+                return None
+            keys, flags = keys + lk, flags + list(lw)
+        try:
+            cost, reward, vote = txn_cost_and_reward(t, payload)
+        except CostError:
+            self.m["parse_fail"] += 1
+            return None
+        if self.fee_check:
+            payer = self.db.peek(None, keys[0])
+            fee = FEE_PER_SIGNATURE * t.sig_cnt
+            if payer is None or payer.lamports < fee:
+                self.m["fee_fail"] += 1
+                return None
+        meta = TxnMeta(
+            payload, t, reward, cost,
+            tuple(k for k, w in zip(keys, flags) if w),
+            tuple(k for k, w in zip(keys, flags) if not w),
+            is_vote=vote)
+        return serialize_resolved(meta)
+
+    def poll_once(self) -> int:
+        n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
+            self.seq, self.batch, self.mtu)
+        self.m["overruns"] += ovr
+        if not n:
+            return 0
+        frames = []
+        for i in range(n):
+            self.m["rx"] += 1
+            out = self._resolve(bytes(buf[i, :sizes[i]]))
+            if out is None:
+                continue
+            if len(out) > self.out_mtu:
+                self.m["oversz"] += 1
+                continue
+            self.m["resolved"] += 1
+            frames.append((int(sigs[i]), out))
+        if frames:
+            publish_wave(self.out, self.out_fseqs, frames,
+                         cnc=getattr(self.ctx, "cnc", None))
+        return n
 
     def in_seqs(self):
         return {self.in_link: self.seq}
@@ -1723,12 +2208,15 @@ class RepairAdapter:
     args: identity_hex, port (0 = ephemeral, published as metric),
     bind_addr, peers = [{pubkey_hex, addr "host:port"}], root_slot,
     req/resp = keyguard links; shred in link = the remaining in link;
-    out link toward the shred tile (optional for pure servers)."""
+    out link toward the shred tile (optional for pure servers); shed
+    (per-tile policing override — disco/shed.py, merged over the
+    topology [shed] section: the repair port is internet-facing)."""
 
     METRICS = ["shreds_seen", "reqs_sent", "sign_fail", "reqs_served",
                "reqs_refused", "resps_in", "cache_slots", "incomplete",
-               "overruns", "port"]
-    GAUGES = ["cache_slots", "incomplete", "port"]
+               "overruns", "port", "shed", "shed_unstaked", "peers",
+               "overload"]
+    GAUGES = ["cache_slots", "incomplete", "port", "peers", "overload"]
 
     def __init__(self, ctx, args):
         import socket
@@ -1773,7 +2261,8 @@ class RepairAdapter:
             peers=peers,
             root_slot=(int(args["root_slot"])
                        if "root_slot" in args else None),
-            out_ring=out_ring, out_fseqs=out_fseqs)
+            out_ring=out_ring, out_fseqs=out_fseqs,
+            shed=_shed_for(ctx, args))
         self.seq = ctx.in_seq0.get(self.in_link, 0)
         self._ovr = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
@@ -1789,6 +2278,7 @@ class RepairAdapter:
     def housekeeping(self):
         if self._kg is not None:
             self.core.plan_and_send()
+        _shed_slo_poll(self.ctx, self.core.shed)
 
     def in_seqs(self):
         seqs = {self.in_link: self.seq}
@@ -1799,7 +2289,10 @@ class RepairAdapter:
         return seqs
 
     def metrics_items(self):
-        return {**self.core.metrics, "overruns": self._ovr,
+        gate = (self.core.shed.counters() if self.core.shed is not None
+                else {"shed": 0, "shed_unstaked": 0, "peers": 0,
+                      "overload": 0})
+        return {**self.core.metrics, **gate, "overruns": self._ovr,
                 "port": self.port}
 
 

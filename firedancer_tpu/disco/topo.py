@@ -84,7 +84,8 @@ class Topology:
 
     def __init__(self, name: str, wksp_size: int = 1 << 26,
                  trace: dict | None = None, slo: dict | None = None,
-                 prof: dict | None = None, shed: dict | None = None):
+                 prof: dict | None = None, shed: dict | None = None,
+                 funk: dict | None = None):
         self.name = name
         self.wksp_size = wksp_size
         self.links: dict[str, LinkSpec] = {}
@@ -103,6 +104,10 @@ class Topology:
         # ingest tiles resolve their effective gate from this + their
         # own `shed` override at adapter construction
         self.shed = shed
+        # [funk] account-store config (funk/shmfunk.py schema); backend
+        # "shm" makes build() carve the record/txn store into the wksp
+        # so bank + the resolv/exec tile family share one fork tree
+        self.funk = funk
 
     def link(self, name: str, depth: int = 128, mtu: int = 1280,
              external: bool = False):
@@ -143,9 +148,20 @@ class Topology:
         (sched_setaffinity via the launcher's cpu_idx, clamped to the
         online set — a no-op gain on single-core hosts). A
         list-valued `tcache` of length cnt distributes one ha-dedup
-        tcache per shard (they are per-tile by design); every other
-        arg is shared verbatim — list args like `cluster` mean the
-        same list for every shard, never a distribution."""
+        tcache per shard (they are per-tile by design), and cnt-length
+        lists of `chaos`/`supervise` distribute per shard too (None =
+        not on this shard — how a drill targets ONE shard); every
+        other arg is shared verbatim — list args like `cluster` mean
+        the same list for every shard, never a distribution.
+
+        Per-shard in links (the exec tile family, r16): an `ins` entry
+        that is itself a list of cnt link names distributes one link
+        per shard — shard i consumes entry[i] instead of the shared
+        link. This is how an upstream ROUTING producer (the bank's
+        conflict-group dispatch) addresses a specific shard: rr
+        seq-ownership can't express content-based routing, a dedicated
+        SPSC link per shard can. The (link, reliable) tuple form stays
+        shared — a distribution entry is all-strings of length cnt."""
         cnt = int(cnt)
         if cnt < 1:
             raise ValueError(f"sharded tile {name}: cnt {cnt} < 1")
@@ -154,19 +170,40 @@ class Topology:
             raise ValueError(
                 f"sharded tile {name}: need one out link per shard "
                 f"({cnt}), got {outs}")
+
+        def _shard_ins(i):
+            out = []
+            for e in ins:
+                if isinstance(e, (list, tuple)) and len(e) > 0 \
+                        and all(isinstance(x, str) for x in e):
+                    if len(e) != cnt:
+                        raise ValueError(
+                            f"sharded tile {name}: per-shard ins "
+                            f"entry needs one link per shard ({cnt}),"
+                            f" got {list(e)}")
+                    out.append(e[i])
+                else:
+                    out.append(e)
+            return out
+
         for i in range(cnt):
             a = {}
             for k, v in args.items():
                 if isinstance(v, (list, tuple)) and len(v) == cnt \
-                        and k in ("tcache",):
-                    a[k] = v[i]
+                        and k in ("tcache", "chaos", "supervise"):
+                    # per-shard distribution (chaos/supervise take
+                    # dicts, so a cnt-length list is unambiguous; a
+                    # None entry means 'not on this shard')
+                    if v[i] is not None:
+                        a[k] = v[i]
                 else:
                     a[k] = v
             a["rr_cnt"] = cnt
             a["rr_idx"] = i
             if cpu0 is not None:
                 a["cpu_idx"] = int(cpu0) + i
-            self.tile(f"{name}{i}", kind, ins=ins, outs=[outs[i]], **a)
+            self.tile(f"{name}{i}", kind, ins=_shard_ins(i),
+                      outs=[outs[i]], **a)
         return self
 
     def _validate(self):
@@ -257,6 +294,20 @@ class Topology:
             from .shed import normalize_shed as _norm_shed
             plan["shed"] = _norm_shed(self.shed) \
                 if self.shed is not None else None
+            # [funk] shm account store: carve the record/txn store the
+            # way metric/trace/prof regions are carved — offsets on the
+            # plan are the ABI; bank creates the facade, resolv/exec
+            # tiles join read/write through runtime.Store at plan off
+            from ..funk.shmfunk import normalize_funk as _norm_funk
+            funk_cfg = _norm_funk(self.funk)
+            plan["funk"] = dict(funk_cfg)
+            if funk_cfg["backend"] == "shm":
+                from ..runtime import Store
+                heap_sz = funk_cfg["heap_mb"] << 20
+                st = Store(w, rec_max=funk_cfg["rec_max"],
+                           txn_max=funk_cfg["txn_max"], heap_sz=heap_sz)
+                plan["funk"]["off"] = st.off
+                plan["funk"]["heap_sz"] = heap_sz
             for tn, t in self.tiles.items():
                 if "shed" in t.args:
                     _norm_shed(t.args["shed"], per_tile=True)

@@ -5,3 +5,6 @@ Re-expression of the reference's funk database
 transaction tree; src/funk/fd_funk_txn.h — fork management APIs).
 """
 from .funk import Funk, FunkTxnError  # noqa: F401
+from .shmfunk import (  # noqa: F401
+    FUNK_DEFAULTS, ShmFunk, WireFunk, make_funk, normalize_funk,
+)

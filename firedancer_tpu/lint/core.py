@@ -114,6 +114,12 @@ RULES: dict[str, tuple[str, str, str]] = {
         "non-positive timeout/park values, malformed per-stage "
         "cmd/env override) — the fdwitness sweep plan must validate "
         "at review, not at 3am when the tunnel finally comes up"),
+    "bad-funk": (
+        "graph", "error",
+        "[funk] section rejected by the funk/shmfunk.py schema "
+        "(unknown key with did-you-mean, unknown backend, rec_max/"
+        "txn_max < 16, heap_mb < 1) — the account-store carve must "
+        "validate at review, not when topo.build sizes the workspace"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

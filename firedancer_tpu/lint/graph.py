@@ -50,6 +50,13 @@ def _norm_ins(ins) -> list[tuple[str, bool]]:
             out.append((i, True))
         elif isinstance(i, dict):
             out.append((i["link"], bool(i.get("reliable", True))))
+        elif isinstance(i, (list, tuple)) and i and \
+                all(isinstance(e, str) for e in i):
+            # per-shard distribution entry (sharded_tile / tile_cnt in
+            # config): shard k consumes i[k]. The un-expanded model
+            # consumes them all — folding to i[0] would orphan the
+            # other shards' links into dead-link false positives.
+            out.extend((e, True) for e in i)
         else:
             out.append((i[0], bool(i[1])))
     return out
@@ -77,7 +84,7 @@ def model_from_config(cfg: dict) -> dict:
     return {"links": links, "tcaches": tcaches, "tiles": tiles,
             "trace": cfg.get("trace"), "slo": cfg.get("slo"),
             "prof": cfg.get("prof"), "shed": cfg.get("shed"),
-            "witness": cfg.get("witness")}
+            "witness": cfg.get("witness"), "funk": cfg.get("funk")}
 
 
 def model_from_topology(topo) -> dict:
@@ -94,7 +101,8 @@ def model_from_topology(topo) -> dict:
             "slo": getattr(topo, "slo", None),
             "prof": getattr(topo, "prof", None),
             "shed": getattr(topo, "shed", None),
-            "witness": getattr(topo, "witness", None)}
+            "witness": getattr(topo, "witness", None),
+            "funk": getattr(topo, "funk", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -241,6 +249,7 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_gui(model, lines))
     out.extend(_check_shed(model, path, lines))
     out.extend(_check_witness(model, path))
+    out.extend(_check_funk(model, path))
     return out
 
 
@@ -261,10 +270,26 @@ def _check_witness(model, path) -> list[Finding]:
     return out
 
 
+def _check_funk(model, path) -> list[Finding]:
+    """[funk] section: the funk/shmfunk.py schema gate (one validator,
+    same as config load and topo.build's store carve) — unknown keys,
+    unknown backend, out-of-range rec_max/txn_max/heap_mb all land as
+    review-time findings with a did-you-mean."""
+    from ..funk.shmfunk import normalize_funk
+    out: list[Finding] = []
+    spec = model.get("funk")
+    if spec is not None:
+        try:
+            normalize_funk(spec)
+        except Exception as e:
+            out.append(finding("bad-funk", path, 0, f"[funk]: {e}"))
+    return out
+
+
 # tile kinds with an ingest door the shed gate can police (the only
 # readers of an effective shed table — shed on anything else is dead
 # config, flagged so a topo that THINKS it is protected actually is)
-SHED_KINDS = {"sock", "quic", "gossip"}
+SHED_KINDS = {"sock", "quic", "gossip", "repair"}
 
 
 def _check_shed(model, path, lines) -> list[Finding]:

@@ -70,6 +70,13 @@ SHED_SECTION_KEYS = ("enable", "rate_pps", "burst", "max_peers",
                      "min_stake", "overload_hold_s", "stakes")
 TILE_SHED_KEYS = SHED_SECTION_KEYS
 
+# [funk] topology-section keys (mirror of funk/shmfunk.py
+# FUNK_DEFAULTS — tests/test_exec_tile.py keeps the mirror honest).
+# Validated by normalize_funk at config load, topo.build (which carves
+# the shm store for backend="shm"), and the graph analyzer's bad-funk
+# rule.
+FUNK_SECTION_KEYS = ("backend", "rec_max", "txn_max", "heap_mb")
+
 # [witness] topology-section keys (mirror of witness/plan.py
 # WITNESS_DEFAULTS / WITNESS_STAGE_KEYS — tests/test_witness.py keeps
 # the mirror honest). Stage names in `stages` / [witness.stage.<name>]
@@ -102,11 +109,21 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
     "pack": {"txn_in": IN, "bank_links": OUT_LIST, "done_links": IN_LIST,
              "slot_in": IN, "bundle_in": IN, "slot_ms": None,
              "batch": None, "max_txn_per_microblock": None,
-             "wave": None},
+             "wave": None,
+             # resolved_in: txn_in carries RESOLVED frames from a
+             # resolv tile (account sets + cost precomputed upstream —
+             # pack/scheduler.py meta_from_resolved), the reference's
+             # resolv->pack seam (src/discof/resolv/)
+             "resolved_in": None},
     "bank": {"exec": None, "poh_link": OUT, "forward_payloads": None,
              "slots_per_epoch": None, "genesis_ckpt": None,
              "genesis": None, "genesis_synth": None, "rpc_port": None,
-             "ws_port": None, "wave": None},
+             "ws_port": None, "wave": None,
+             # exec tile fan-out (r16): one dispatch out link + one
+             # completion in link per exec shard; the bank keeps wave
+             # scheduling/commit ordering/poh handoff, execution runs
+             # in the exec tile family over the shm funk store
+             "exec_links": OUT_LIST, "exec_done": IN_LIST},
     "sock": {"port": None, "bind_addr": None, "batch": None, "mtu": None},
     "quic": {"port": None, "bind_addr": None, "batch": None, "mtu": None},
     "poh": {"hashes_per_tick": None, "ticks_per_slot": None,
@@ -151,6 +168,17 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
             "ws_sndbuf": None, "bench_glob": None,
             "report_on_halt": None},
     "cswtch": {},
+    # exec tile family (r16, ref: src/discof/exec/fd_exec_tile.c):
+    # consumes the bank's conflict-group dispatch frames, executes via
+    # the WaveExecutor against the shm funk store, publishes
+    # completion frags; declared via tile_cnt (sharded_tile) with a
+    # per-shard ins distribution
+    "exec": {"batch": None, "rr_cnt": None, "rr_idx": None,
+             "tile_cnt": None, "cpu0": None},
+    # resolv tile (r16, ref: src/discof/resolv/): ahead of pack —
+    # parses txns, resolves v0 ALUT loads + checks the fee payer
+    # against the shm store, emits RESOLVED frames
+    "resolv": {"batch": None, "fee_payer_check": None},
     "ipecho": {"shred_version": None, "port": None, "bind_addr": None},
     "pcap": {"path": None, "realtime": None, "loop": None},
     "sink": {"batch": None},

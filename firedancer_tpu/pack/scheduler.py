@@ -29,6 +29,7 @@ scheduler.
 from __future__ import annotations
 
 import heapq
+import struct
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -106,6 +107,48 @@ def meta_from_payload(payload: bytes, seq: int = 0,
     return TxnMeta(payload, t, reward if reward is not None else r,
                    cost if cost is not None else c, writes, reads,
                    is_vote=vote, seq=seq)
+
+
+# RESOLVED frame (the resolv->pack wire, ref: src/discof/resolv/ —
+# account sets, cost and reward travel WITH the payload so pack never
+# re-parses and never needs account-db access for v0 txns):
+#   u16 n_writes | u16 n_reads | u32 cost | u64 reward | u8 flags
+#   | u16 payload_len | n_writes*32 writes | n_reads*32 reads | payload
+RESOLVED_HDR = struct.Struct("<HHIQBH")
+RESOLVED_FLAG_VOTE = 1
+
+
+def serialize_resolved(meta: TxnMeta) -> bytes:
+    """TxnMeta -> RESOLVED frame (the resolv tile's egress)."""
+    flags = RESOLVED_FLAG_VOTE if meta.is_vote else 0
+    return (RESOLVED_HDR.pack(len(meta.writes), len(meta.reads),
+                              meta.cost, meta.reward, flags,
+                              len(meta.payload))
+            + b"".join(meta.writes) + b"".join(meta.reads)
+            + meta.payload)
+
+
+def meta_from_resolved(frame: bytes, seq: int = 0) -> TxnMeta:
+    """RESOLVED frame -> TxnMeta. Account sets, cost and reward come
+    off the wire verbatim — including ALUT-loaded keys a re-parse of
+    the payload could NOT reproduce without db access, which is the
+    whole point of the resolv tile. txn stays None: nothing downstream
+    of insert reads it (microblock serialization uses the payload)."""
+    nw, nr, cost, reward, flags, plen = RESOLVED_HDR.unpack_from(
+        frame, 0)
+    off = RESOLVED_HDR.size
+    need = off + 32 * (nw + nr) + plen
+    if len(frame) < need:
+        raise CostError(f"short RESOLVED frame ({len(frame)} < {need})")
+    writes = tuple(bytes(frame[off + 32 * i:off + 32 * (i + 1)])
+                   for i in range(nw))
+    off += 32 * nw
+    reads = tuple(bytes(frame[off + 32 * i:off + 32 * (i + 1)])
+                  for i in range(nr))
+    off += 32 * nr
+    payload = bytes(frame[off:off + plen])
+    return TxnMeta(payload, None, reward, cost, writes, reads,
+                   is_vote=bool(flags & RESOLVED_FLAG_VOTE), seq=seq)
 
 
 class _AcctBits:

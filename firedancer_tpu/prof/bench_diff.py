@@ -45,6 +45,9 @@ GATE_METRICS = (
     ("rlc_bulk_vps", "rlc bulk vps"),
     ("rlc_prefilter_vps", "rlc prefilter vps"),
     ("flood_goodput_tps", "flood goodput tps"),
+    # execution scale-out (r16): the exec-family leader loop's
+    # capacity at 2 exec tiles — the tile-count scaling contract
+    ("exec_scale_tps_2", "exec scale tps (2 tiles)"),
 )
 
 # the knee subset: what bench.py's implicit previous-round gate

@@ -88,6 +88,24 @@ def _load():
         "fdtpu_tcache_insert_batch": (ct.c_int, [vp, u64, ct.POINTER(u64),
                                                  ct.POINTER(ct.c_uint8), i64,
                                                  ct.POINTER(ct.c_uint8)]),
+        "fdtpu_store_footprint": (u64, [u64, u64, u64]),
+        "fdtpu_store_init": (ct.c_int, [vp, u64, u64, u64, u64]),
+        "fdtpu_store_txn_prepare": (ct.c_int, [vp, u64, u64, u64]),
+        "fdtpu_store_txn_cancel": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_store_txn_publish": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_store_txn_exists": (ct.c_int, [vp, u64, u64]),
+        "fdtpu_store_txn_parent": (i64, [vp, u64, u64]),
+        "fdtpu_store_txn_children": (i64, [vp, u64, u64,
+                                           ct.POINTER(u64), i64]),
+        "fdtpu_store_put": (ct.c_int, [vp, u64, u64, cp, cp, u64,
+                                       ct.c_int]),
+        "fdtpu_store_get": (i64, [vp, u64, u64, cp,
+                                  ct.POINTER(ct.c_uint8), u64]),
+        "fdtpu_store_iter": (i64, [vp, u64, u64, ct.POINTER(u64),
+                                   ct.POINTER(ct.c_uint8),
+                                   ct.POINTER(ct.c_uint8), u64,
+                                   ct.POINTER(ct.c_int32)]),
+        "fdtpu_store_rec_cnt": (u64, [vp, u64]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -514,3 +532,124 @@ class Tcache:
             tags.ctypes.data_as(ct.POINTER(ct.c_uint64)), mp, len(tags),
             dup.ctypes.data_as(ct.POINTER(ct.c_uint8)))
         return dup
+
+
+class Store:
+    """Raw view of a carved funk store region (native/fdtpu.cc store —
+    the fork-aware shm record tree). This layer speaks the native ABI
+    verbatim: u64 xids (0 = published root), 32-byte keys, bytes values,
+    negative error codes. The Python funk semantics (hashable xids,
+    typed values, FunkTxnError) live in funk/shmfunk.py; tiles attaching
+    cross-process use this class directly with wire-interned xids."""
+
+    def __init__(self, wksp: Workspace, off: int | None = None,
+                 rec_max: int = 4096, txn_max: int = 256,
+                 heap_sz: int = 1 << 24):
+        self.wksp = wksp
+        if off is None:
+            off = wksp.alloc(lib.fdtpu_store_footprint(
+                rec_max, txn_max, heap_sz))
+            rc = lib.fdtpu_store_init(wksp.base, off, rec_max, txn_max,
+                                      heap_sz)
+            if rc != 0:
+                raise ValueError(f"store init failed: {rc}")
+        self.off = off
+        # reusable value buffer, grown on demand (get() reports the true
+        # size so a too-small read retries once)
+        self._buf = (ct.c_uint8 * 4096)()
+
+    @staticmethod
+    def footprint(rec_max: int, txn_max: int, heap_sz: int) -> int:
+        return int(lib.fdtpu_store_footprint(rec_max, txn_max, heap_sz))
+
+    def _grow(self, n: int):
+        cap = len(self._buf)
+        while cap < n:
+            cap *= 2
+        self._buf = (ct.c_uint8 * cap)()
+
+    # -- txn tree (raw u64 xids) -------------------------------------------
+
+    def txn_prepare(self, parent_xid: int, xid: int) -> int:
+        return lib.fdtpu_store_txn_prepare(self.wksp.base, self.off,
+                                           parent_xid, xid)
+
+    def txn_cancel(self, xid: int) -> int:
+        return lib.fdtpu_store_txn_cancel(self.wksp.base, self.off, xid)
+
+    def txn_publish(self, xid: int) -> int:
+        return lib.fdtpu_store_txn_publish(self.wksp.base, self.off, xid)
+
+    def txn_exists(self, xid: int) -> bool:
+        return bool(lib.fdtpu_store_txn_exists(self.wksp.base, self.off,
+                                               xid))
+
+    def txn_parent(self, xid: int) -> int:
+        """Parent xid (0 = root child), -2 when xid is unknown."""
+        return int(lib.fdtpu_store_txn_parent(self.wksp.base, self.off,
+                                              xid))
+
+    def txn_children(self, xid: int) -> list[int]:
+        cap = 64
+        while True:
+            out = (ct.c_uint64 * cap)()
+            n = lib.fdtpu_store_txn_children(self.wksp.base, self.off,
+                                             xid, out, cap)
+            if n == -2:
+                raise KeyError(f"unknown txn {xid}")
+            if n <= cap:
+                return [int(out[i]) for i in range(n)]
+            cap = n
+
+    # -- records ------------------------------------------------------------
+
+    def put(self, xid: int, key: bytes, val: bytes | None) -> int:
+        """val=None writes a tombstone (root: deletes the record)."""
+        if val is None:
+            return lib.fdtpu_store_put(self.wksp.base, self.off, xid,
+                                       key, None, 0, 1)
+        return lib.fdtpu_store_put(self.wksp.base, self.off, xid, key,
+                                   val, len(val), 0)
+
+    def get(self, xid: int, key: bytes) -> bytes | None:
+        """Fork-visibility query; None when absent/tombstoned. Raises on
+        unknown xid (matches funk's contract)."""
+        n = lib.fdtpu_store_get(self.wksp.base, self.off, xid, key,
+                                self._buf, len(self._buf))
+        if n == -1:
+            return None
+        if n == -2:
+            raise KeyError(f"unknown txn {xid}")
+        if n > len(self._buf):
+            self._grow(n)
+            n = lib.fdtpu_store_get(self.wksp.base, self.off, xid, key,
+                                    self._buf, len(self._buf))
+        return bytes(self._buf[:n])
+
+    def iter_layer(self, xid: int):
+        """Yield (key, val_bytes | None) for ONE layer's own records
+        (None = tombstone). xid 0 iterates the published root."""
+        cursor = ct.c_uint64(0)
+        key = (ct.c_uint8 * 32)()
+        tomb = ct.c_int32(0)
+        while True:
+            n = lib.fdtpu_store_iter(self.wksp.base, self.off, xid,
+                                     ct.byref(cursor), key, self._buf,
+                                     len(self._buf), ct.byref(tomb))
+            if n == -1:
+                return
+            if n == -2:
+                raise KeyError(f"unknown txn {xid}")
+            if n > len(self._buf):
+                # re-read this record with a grown buffer: back the
+                # cursor up by restarting is wrong (list may be long), so
+                # grow and re-fetch via get() on the captured key
+                self._grow(n)
+                k = bytes(key)
+                v = None if tomb.value else self.get(xid, k)
+                yield k, v
+                continue
+            yield bytes(key), (None if tomb.value else bytes(self._buf[:n]))
+
+    def rec_cnt(self) -> int:
+        return int(lib.fdtpu_store_rec_cnt(self.wksp.base, self.off))

@@ -36,16 +36,25 @@ class RepairCore:
                  peers: list[tuple[bytes, tuple]] = (),
                  root_slot: int | None = None, out_ring=None,
                  out_fseqs=None, serve_slots: int = 512,
-                 max_requests: int = 32):
+                 max_requests: int = 32, shed: dict | None = None):
         """peers: [(pubkey, (host, port))]. sign_fn(payload)->sig|None
         (keyguard REPAIR role). out_ring: repaired shred wires toward
         the FEC resolver. root_slot=None anchors the forest at the
         FIRST observed shred's parent — a node attaching mid-stream
         must not walk repair backward to genesis (the reference anchors
-        at the snapshot slot)."""
+        at the snapshot slot). shed: effective policing table
+        (disco/shed.py) — the repair port is an internet-facing door
+        too: every datagram (request or response) pays one admission
+        before the signature verify / shred parse runs, and out-ring
+        backpressure trips stake-weighted overload shedding."""
         self.identity = identity
         self.sign_fn = sign_fn
         self.sock = sock
+        if shed is not None:
+            from ..disco.shed import PeerGate
+            self.shed = PeerGate(shed)
+        else:
+            self.shed = None
         self.forest = Forest(root_slot if root_slot is not None else 0)
         self._auto_anchor = root_slot is None
         self.policy = RepairPolicy(identity)
@@ -111,13 +120,23 @@ class RepairCore:
 
     def on_datagram(self, data: bytes, addr) -> int:
         """One datagram off the repair socket: either a peer's signed
-        request (serve it) or a repair response (forward the shred)."""
+        request (serve it) or a repair response (forward the shred).
+        The shed gate polices FIRST — the cheapest reject runs before
+        the ed25519 verify / shred parse an attacker would love us to
+        pay per flood packet."""
+        if self.shed is not None and not self.shed.admit(addr):
+            return 0
         if len(data) == REQ_LEN + 64:
             return self._serve(data, addr)
         if fmt.SHRED_MIN_SZ <= len(data) <= fmt.SHRED_MAX_SZ:
             self.metrics["resps_in"] += 1
             self.on_shred(data)              # fills our own gap tracking
             if self.out_ring is not None:
+                if self.shed is not None and self.out_fseqs and \
+                        self.out_ring.credits(self.out_fseqs) <= 0:
+                    # downstream pressure: latch overload so unstaked
+                    # repair traffic degrades first at the door
+                    self.shed.trip_overload()
                 while self.out_fseqs and \
                         self.out_ring.credits(self.out_fseqs) <= 0:
                     time.sleep(20e-6)

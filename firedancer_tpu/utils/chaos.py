@@ -23,7 +23,12 @@ Plan schema (JSON-able; everything optional except `events`):
 Triggers: `at_iter` counts stem loop iterations, `at_rx` counts frags
 consumed (deterministic relative to traffic). A two-element list is a
 seeded-uniform pick in [lo, hi] — same seed, same plan, same firing
-point. Each event fires at most once.
+point. Each event fires at most once per process. When the restart
+policy respawns a tile, its chaos plan is STRIPPED from the respawn
+args (a drill simulates one fault per boot; the replacement must come
+up clean) — unless the plan sets top-level `"rearm": true`, in which
+case the fault survives respawn (the crash-loop drill that drives the
+circuit breaker open on purpose).
 
 Actions understood by the stem (disco/stem.py):
 

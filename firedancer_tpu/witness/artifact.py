@@ -71,6 +71,7 @@ _MERGE_RULES = {
                    ()),
     "e2e_feed": ((), ("e2e_",)),
     "leader_knee": ((), ("e2e_leader",)),
+    "exec_scale": ((), ("exec_scale",)),
     "flood_soak": (("rlc_prefilter_vps",), ("flood_",)),
 }
 

@@ -23,6 +23,10 @@ Stage catalog (plan order — the hash chain follows it):
                    knee (the r10 >=4x target)
     leader_knee    bench.py leader stage: full pack->bank->poh->shred
                    knee + saturating hop (r13)
+    exec_scale     bench.py exec_scale stage: leader loop over the shm
+                   funk store with resolv + an exec tile family —
+                   measured tps per exec_tile_cnt and the hop snapshot
+                   proving the knee moved off the bank (r16)
     flood_soak     bench.py flood stage: front-door survival goodput +
                    `rlc_prefilter_vps` at chip rate (r14)
     multichip      witness/multichip.py: the shard_map layout shootout
@@ -42,7 +46,7 @@ import sys
 
 # ordered: the sweep runs (and the hash chain links) in this order
 STAGES = ("device_probe", "kernel_vps", "mxu_fmul", "e2e_feed",
-          "leader_knee", "flood_soak", "multichip")
+          "leader_knee", "exec_scale", "flood_soak", "multichip")
 
 # [witness] section keys (lint/registry.py WITNESS_SECTION_KEYS is the
 # static mirror — tests/test_witness.py keeps it honest)
@@ -185,6 +189,11 @@ _CPU_SMOKE_STAGE_ENV = {
                     "FDTPU_BENCH_LEADER_TILES": "1",
                     "FDTPU_BENCH_LEADER_SWEEP": "0.8",
                     "FDTPU_BENCH_LEADER_STANZA_S": "2.0"},
+    "exec_scale": {"FDTPU_BENCH_EXEC_COUNT": "1024",
+                   "FDTPU_BENCH_EXEC_UNIQUE": "256",
+                   "FDTPU_BENCH_EXEC_BATCH": "16",
+                   "FDTPU_BENCH_EXEC_VERIFY_TILES": "1",
+                   "FDTPU_BENCH_EXEC_SCALE_CNTS": "1,2"},
     "flood_soak": {"FDTPU_BENCH_FLOOD_S": "4",
                    "FDTPU_BENCH_FLOOD_PROBE_PPS": "40",
                    "FDTPU_BENCH_FLOOD_SYBILS": "8",
@@ -209,6 +218,7 @@ def default_stage_cmds(repo_root: str,
         "mxu_fmul": mxu,
         "e2e_feed": [py, bench],
         "leader_knee": [py, bench],
+        "exec_scale": [py, bench],
         "flood_soak": [py, bench],
         "multichip": multi,
     }
@@ -219,6 +229,7 @@ _STAGE_CHILD_ENV = {
     "kernel_vps": {"FDTPU_BENCH_CHILD": "1"},
     "e2e_feed": {"FDTPU_BENCH_E2E_CHILD": "1"},
     "leader_knee": {"FDTPU_BENCH_LEADER_CHILD": "1"},
+    "exec_scale": {"FDTPU_BENCH_EXEC_SCALE_CHILD": "1"},
     "flood_soak": {"FDTPU_BENCH_FLOOD_CHILD": "1"},
 }
 

@@ -9,6 +9,7 @@ from firedancer_tpu.flamenco.bank_hash import (
     BankHasher, accounts_lthash, lthash_of_root,
 )
 from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.funk.shmfunk import ShmFunk
 from firedancer_tpu.svm.accdb import Account
 
 
@@ -16,8 +17,26 @@ def k(n):
     return bytes([n]) * 32
 
 
-def test_delta_matches_full_recompute():
-    funk = Funk()
+@pytest.fixture(params=["process", "shm"])
+def mk_funk(request):
+    """Both funk backends feed the lattice: the bank-hash suite is the
+    second half of the shm store's byte-compat oracle (a store that
+    round-trips accounts differently diverges here immediately)."""
+    made = []
+
+    def mk():
+        f = Funk() if request.param == "process" else ShmFunk()
+        made.append(f)
+        return f
+
+    yield mk
+    for f in made:
+        if isinstance(f, ShmFunk):
+            f.close(unlink=True)
+
+
+def test_delta_matches_full_recompute(mk_funk):
+    funk = mk_funk()
     rng = np.random.default_rng(5)
     h = BankHasher()
     for step in range(6):
@@ -36,8 +55,8 @@ def test_delta_matches_full_recompute():
         assert np.array_equal(h.acc, full), f"diverged at step {step}"
 
 
-def test_deletion_subtracts():
-    funk = Funk()
+def test_deletion_subtracts(mk_funk):
+    funk = mk_funk()
     h = BankHasher()
     a = Account(lamports=100, data=b"abc", owner=k(2))
     funk.rec_write(None, k(1), a)

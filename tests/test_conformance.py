@@ -21,7 +21,27 @@ import struct
 import pytest
 
 from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.funk.shmfunk import ShmFunk
 from firedancer_tpu.protocol.txn import build_message, build_txn
+
+
+@pytest.fixture(params=["process", "shm"])
+def mk_funk(request):
+    """Both funk backends run every vector: the in-process dict tree and
+    the shm-resident store (native/fdtpu.cc) behind the same Funk API —
+    the conformance table IS the byte-compat oracle for the shm
+    re-expression."""
+    made = []
+
+    def mk():
+        f = Funk() if request.param == "process" else ShmFunk()
+        made.append(f)
+        return f
+
+    yield mk
+    for f in made:
+        if isinstance(f, ShmFunk):
+            f.close(unlink=True)
 from firedancer_tpu.svm import AccDb, Account, TxnExecutor
 from firedancer_tpu.svm.accdb import SYSTEM_PROGRAM_ID
 from firedancer_tpu.svm.stake import (
@@ -312,8 +332,8 @@ def _mk_account(spec):
 
 
 @pytest.mark.parametrize("vec", VECTORS, ids=lambda v: v["name"])
-def test_conformance(vec):
-    funk = Funk()
+def test_conformance(vec, mk_funk):
+    funk = mk_funk()
     db = AccDb(funk)
     pre_balances = {}
     for key, spec in vec["pre"].items():
@@ -385,9 +405,9 @@ def test_fixture_corpus_size():
 
 @pytest.mark.parametrize(
     "fx", _FIXTURES, ids=[f["name"] for f in _FIXTURES])
-def test_fixture(fx):
+def test_fixture(fx, mk_funk):
     ctx = fx["context"]
-    funk = Funk()
+    funk = mk_funk()
     db = AccDb(funk)
     for spec in ctx["accounts"]:
         funk.rec_write(None, bytes.fromhex(spec["address"]), Account(

@@ -482,6 +482,96 @@ def test_crds_spam_bounded_table_and_overload_shed(wksp):
     tile.close()
 
 
+def test_repair_door_polices_requests_and_responses():
+    """The repair port is internet-facing too (r16): every datagram —
+    signed request or shred response — pays one PeerGate admission
+    BEFORE the ed25519 verify / shred parse, so a flood dies at the
+    cheapest layer; out-ring backpressure trips stake-weighted
+    overload and a staked repair peer still lands through the
+    overloaded door."""
+    from firedancer_tpu.repair.policy import REQ_LEN
+    from firedancer_tpu.shred import format as fmt
+    from firedancer_tpu.tiles.repair import RepairCore
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.setblocking(False)
+    core = RepairCore(
+        b"\x01" * 32, lambda p: None, sock,
+        shed={"rate_pps": 1000.0, "burst": 8, "max_peers": 8,
+              "min_stake": 1, "overload_hold_s": 30.0,
+              "stakes": {"127.0.0.1:65001": 500}})
+    junk_req = hashlib.sha256(b"junk").digest() * 3  # 96B payload..
+    junk_req = (junk_req + junk_req)[:REQ_LEN + 64]  # ..+ garbage sig
+    # peacetime Sybil flood: admitted per-peer, dies in sigverify as
+    # reqs_refused, and the table never exceeds max_peers
+    for i in range(64):
+        core.on_datagram(junk_req, (f"203.0.113.{i % 32 + 1}", 4000 + i))
+    assert core.metrics["reqs_refused"] == 64
+    assert core.shed.counters()["peers"] <= 8
+    # pressure trips: the same flood now sheds AT THE DOOR — the
+    # refused counter freezes because no signature verify ever runs
+    core.shed.trip_overload()
+    refused0 = core.metrics["reqs_refused"]
+    shed0 = core.shed.shed_total
+    for i in range(64):
+        core.on_datagram(junk_req, (f"198.51.100.{i % 32 + 1}", 7000 + i))
+    assert core.metrics["reqs_refused"] == refused0
+    assert core.shed.shed_total >= shed0 + 64
+    # shred-sized response spam from an unstaked peer: shed the same
+    # way, never counted as a response
+    resp = b"\x00" * fmt.SHRED_MIN_SZ
+    assert core.on_datagram(resp, ("9.9.9.9", 1)) == 0
+    assert core.metrics["resps_in"] == 0
+    # the staked repair peer's response still lands
+    assert core.on_datagram(resp, ("127.0.0.1", 65001)) == 1
+    assert core.metrics["resps_in"] == 1
+    sock.close()
+
+
+def test_repair_backpressure_trips_overload():
+    """A stalled FEC-resolver consumer (zero out-ring credits) must
+    latch the repair door into overload — the same pressure->shed
+    coupling the sock door has, on the response-forward path."""
+    from firedancer_tpu.shred import format as fmt
+    from firedancer_tpu.tiles.repair import RepairCore
+
+    class _StubRing:
+        def __init__(self):
+            self.calls = 0
+            self.pub = []
+
+        def credits(self, fseqs):
+            self.calls += 1
+            return 0 if self.calls == 1 else 1   # stalled, then drained
+
+        def publish(self, data, sig=0):
+            self.pub.append(bytes(data))
+
+    ring = _StubRing()
+    core = RepairCore(
+        b"\x02" * 32, lambda p: None, sock=None,
+        out_ring=ring, out_fseqs=[object()],
+        shed={"rate_pps": 1000.0, "burst": 64, "max_peers": 8,
+              "min_stake": 1, "overload_hold_s": 30.0})
+    assert not core.shed.overloaded()
+    resp = b"\x00" * fmt.SHRED_MIN_SZ
+    assert core.on_datagram(resp, ("10.0.0.7", 9)) == 1
+    assert core.shed.overloaded()        # pressure latched the door
+    assert len(ring.pub) == 1            # ...but the response still went
+
+
+def test_repair_adapter_declares_shed_slots_and_lint_allows():
+    """The adapter exports the shed counters as metric slots (the
+    prometheus renderer + flood bench judge off them) and fdlint's
+    dead-config check knows repair has an ingest door to police."""
+    from firedancer_tpu.disco.tiles import RepairAdapter
+    from firedancer_tpu.lint.graph import SHED_KINDS
+    assert {"shed", "shed_unstaked", "peers",
+            "overload"} <= set(RepairAdapter.METRICS)
+    assert {"peers", "overload"} <= set(RepairAdapter.GAUGES)
+    assert "repair" in SHED_KINDS
+
+
 # -- gossvf bulk mode -------------------------------------------------------
 
 def test_gossvf_bulk_wiring_matches_individual(monkeypatch):

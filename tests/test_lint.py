@@ -391,6 +391,40 @@ def test_witness_section_is_clean_when_valid():
     assert lint_config(cfg, "<fixture>") == []
 
 
+def test_bad_funk_schema_and_did_you_mean():
+    # typo'd [funk] key: the funk/shmfunk.py schema gate
+    findings = lint_config(_cfg(funk={"bakend": "shm"}), "<fixture>")
+    fires_once(findings, "bad-funk")
+    assert "did you mean 'backend'" in findings[0].message
+    # unknown backend with suggestion
+    findings = lint_config(_cfg(funk={"backend": "sm"}), "<fixture>")
+    fires_once(findings, "bad-funk")
+    assert "did you mean 'shm'" in findings[0].message
+    # out-of-range heap
+    fires_once(lint_config(_cfg(funk={"backend": "shm", "heap_mb": 0}),
+                           "<fixture>"), "bad-funk")
+
+
+def test_funk_section_is_clean_when_valid():
+    cfg = _cfg(funk={"backend": "shm", "heap_mb": 4, "rec_max": 1024})
+    assert lint_config(cfg, "<fixture>") == []
+
+
+def test_per_shard_ins_entry_expands_not_folds():
+    """A sharded-tile per-shard ins entry (all-str list: shard k
+    consumes entry[k]) must count every listed link as consumed — the
+    old pair-folding read it as ('first', True) and orphaned the other
+    shards' links into dead-link false positives."""
+    cfg = _cfg(
+        links=[{"name": "a_b0", "depth": 64, "mtu": 1280},
+               {"name": "a_b1", "depth": 64, "mtu": 1280}],
+        tiles=[{"name": "src", "kind": "synth",
+                "outs": ["a_b0", "a_b1"]},
+               {"name": "dst", "kind": "sink",
+                "ins": [["a_b0", "a_b1"]]}])
+    assert lint_config(cfg, "<fixture>") == []
+
+
 def test_lint_topology_programmatic():
     """Programmatic Topology builds get the same pass as TOML."""
     from firedancer_tpu.disco import Topology

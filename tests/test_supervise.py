@@ -159,7 +159,9 @@ def test_watchdog_trips_on_frozen_heartbeat():
 def test_circuit_breaker_halts_crash_loop_cleanly():
     """A tile that dies immediately on every boot exhausts its restart
     budget; the breaker opens, the topology is HALTED (not wedged, not
-    respawning forever) and the failure surfaces as CircuitOpen."""
+    respawning forever) and the failure surfaces as CircuitOpen. The
+    chaos plan sets rearm=true so the crash survives respawn (default
+    drills fire once per boot and the replacement comes up clean)."""
     topo = (
         Topology(f"sb{os.getpid()}", wksp_size=1 << 22)
         .link("a_b", depth=32, mtu=256)
@@ -168,7 +170,8 @@ def test_circuit_breaker_halts_crash_loop_cleanly():
         .tile("b", "sink", ins=["a_b"],
               supervise={"policy": "restart", "backoff_s": 0.05,
                          "max_restarts": 1, "window_s": 60.0},
-              chaos={"events": [{"action": "crash", "at_iter": 1}]})
+              chaos={"rearm": True,
+                     "events": [{"action": "crash", "at_iter": 1}]})
     )
     runner = TopologyRunner(topo.build()).start()
     try:

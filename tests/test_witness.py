@@ -54,6 +54,11 @@ def _scripted_cfg(extra=None, stages=None):
         "leader_knee": {"cmd": _ok_cmd(
             {"platform": "cpu", "e2e_leader_tps": 1234.0,
              "e2e_leader_knee_tps": 1200.0})},
+        "exec_scale": {"cmd": _ok_cmd(
+            {"platform": "cpu", "exec_scale_count": 1024,
+             "exec_scale_tps": {"1": 900.0, "2": 1400.0},
+             "exec_scale_tps_1": 900.0, "exec_scale_tps_2": 1400.0,
+             "exec_scale_monotonic_1_2": True})},
         "flood_soak": {"cmd": _ok_cmd(
             {"platform": "tpu", "flood_goodput_tps": 900.0,
              "flood_pass": True, "rlc_prefilter_vps": 480000.0})},
