@@ -825,6 +825,159 @@ def _exec_scale_bench():
     sys.stdout.flush()
 
 
+def _catchup_fixture(tmp, count, unique, n_slots, snap_slot):
+    """Leader-side oracle: replay `n_slots` slots of signed transfers
+    in-process, write the shm-format snapshot at `snap_slot` (atomic
+    v2 checkpoint with slot + bank hash meta), archive the tail slices
+    for playback, and return the per-slot bank hashes the follower
+    must reproduce."""
+    import struct as _struct
+    from firedancer_tpu.disco.tiles import _synth_genesis
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.tiles.replay import InlineFanout, ReplayCore
+    from firedancer_tpu.tiles.shred import pack_slice
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    from firedancer_tpu.utils.checkpt import (CheckptWriter,
+                                              snapshot_write_atomic)
+    gen = _synth_genesis(unique)
+    funk = Funk()
+    oracle = ReplayCore(genesis=gen, verify_poh=False, funk=funk,
+                        fanout=InlineFanout(funk))
+    txns = make_signed_txns(count, seed=23)
+    per = max(1, count // n_slots)
+    slices = {}
+    for s in range(1, n_slots + 1):
+        batch = b""
+        chunk = txns[(s - 1) * per:s * per]
+        tip = hashlib.sha256(b"cu-tip-%d" % s).digest()
+        batch += _struct.pack("<I", 1) + tip \
+            + _struct.pack("<I", len(chunk))
+        for t in chunk:
+            batch += _struct.pack("<H", len(t)) + t
+        slices[s] = pack_slice(s, 0, True, batch)
+    snap_path = os.path.join(tmp, "snap.ckpt")
+    for s in range(1, n_slots + 1):
+        oracle.on_slice(slices[s])
+        if s == snap_slot:
+            snapshot_write_atomic(
+                snap_path, oracle.funk, slot=s,
+                bank_hash=oracle.bank_hash_of[s])
+    tail_path = os.path.join(tmp, "tail.arch")
+    with open(tail_path, "wb") as fp:
+        w = CheckptWriter(fp, compress=True)
+        for i, s in enumerate(range(snap_slot + 1, n_slots + 1)):
+            payload = slices[s]
+            w.frame(_struct.pack("<QQHI", i, s, 0, len(payload))
+                    + payload)
+        w.fini()
+    expected = {str(s): oracle.bank_hash_of[s].hex()
+                for s in range(snap_slot + 1, n_slots + 1)}
+    return snap_path, tail_path, expected, oracle
+
+
+def _follower_topology(snap_path, tail_path, expected, snap_slot,
+                       exec_cnt):
+    """The catch-up race under measurement: snapld->snapin restoring
+    the shm store while playback floods the slice tail at full speed —
+    the replay tile buffers behind the restore gate, then catches up
+    over `exec_cnt` exec shards with the leader's bank hashes pinned."""
+    from firedancer_tpu.disco import Topology
+    disp = [f"exec_disp{i}" for i in range(exec_cnt)]
+    done = [f"exec_done{i}" for i in range(exec_cnt)]
+    topo = (
+        Topology(f"cu{os.getpid()}", wksp_size=1 << 26,
+                 funk={"backend": "shm", "heap_mb": 16},
+                 snapshot={"path": snap_path, "min_slot": snap_slot,
+                           "chunk": 4096})
+        .link("snap_stream", depth=256, mtu=1 << 16)
+        .link("shred_slices", depth=256, mtu=1 << 16)
+        .link("replay_tower", depth=128, mtu=128)
+        .tile("snapld", "snapld", outs=["snap_stream"])
+        .tile("snapin", "snapin", ins=["snap_stream"])
+        .tile("playback", "playback", outs=["shred_slices"],
+              path=tail_path)
+        .tile("replay", "replay",
+              ins=["shred_slices"] + [(ln, False) for ln in done],
+              outs=["replay_tower"] + disp,
+              exec_links=disp, exec_done=done, wait_restore=True,
+              expected=expected, verify_poh=False)
+        .tile("towersink", "sink", ins=["replay_tower"]))
+    for ln in disp:
+        topo.link(ln, depth=64, mtu=4096)
+    for ln in done:
+        topo.link(ln, depth=64, mtu=64)
+    topo.sharded_tile("exec", "exec", exec_cnt, ins=[disp], outs=done,
+                      batch=8)
+    return topo
+
+
+def _catchup_bench():
+    """Catch-up stage (r17): cold-start a follower from a ShmFunk
+    snapshot while the slice tail streams in live, replay the tail
+    over the exec tile family, and measure snapshot-load + replay
+    against the in-process oracle's pinned bank hashes.
+
+    Prints one JSON line with replay_tps (gate metric: replayed txns
+    per wall second from boot to caught-up), catchup_s, the restore
+    slot, and the divergence counter (must be 0). The parent process
+    must not touch jax."""
+    import shutil
+
+    import jax
+    sys.path.insert(0, HERE)
+    from firedancer_tpu.disco import TopologyRunner
+
+    # the in-process oracle hashes every slot's account delta; share
+    # the persistent compile cache or each run re-traces lthash cold
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(HERE, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    count = int(os.environ.get("FDTPU_BENCH_CATCHUP_COUNT", "192"))
+    unique = int(os.environ.get("FDTPU_BENCH_CATCHUP_UNIQUE", "16"))
+    n_slots = int(os.environ.get("FDTPU_BENCH_CATCHUP_SLOTS", "12"))
+    snap_slot = int(os.environ.get("FDTPU_BENCH_CATCHUP_SNAP_SLOT",
+                                   "4"))
+    exec_cnt = int(os.environ.get("FDTPU_BENCH_CATCHUP_EXEC_TILES",
+                                  "2"))
+    tmp = tempfile.mkdtemp(prefix="fdtpu_catchup_")
+    snap_path, tail_path, expected, oracle = _catchup_fixture(
+        tmp, count, unique, n_slots, snap_slot)
+    target = n_slots - snap_slot
+    runner = TopologyRunner(_follower_topology(
+        snap_path, tail_path, expected, snap_slot,
+        exec_cnt).build()).start()
+    out = {"catchup_slots": target,
+           "catchup_count": oracle.metrics["txns"]}
+    try:
+        runner.wait_running(timeout_s=840)
+        t0 = time.perf_counter()
+        deadline = t0 + 600
+        m = {}
+        while time.perf_counter() < deadline:
+            m = runner.metrics("replay")
+            if m.get("slots_replayed", 0) >= target:
+                break
+            time.sleep(0.05)
+        wall = time.perf_counter() - t0
+        if m.get("slots_replayed", 0) < target:
+            raise RuntimeError(
+                f"follower never caught up: "
+                f"{m.get('slots_replayed', 0)}/{target} slots in "
+                f"{wall:.1f}s (divergent_slot="
+                f"{m.get('divergent_slot', 0)})")
+        out["catchup_s"] = round(wall, 3)
+        out["replay_tps"] = round(m["txns"] / wall, 1) if wall else 0.0
+        out["catchup_restore_slot"] = m.get("restore_slot", 0)
+        out["catchup_divergent_slot"] = m.get("divergent_slot", 0)
+        out["catchup_exec_waves"] = m.get("exec_waves", 0)
+    finally:
+        runner.halt()
+        runner.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _flood_topology(shed_stakes: dict, slo_floor: float | None,
                     pool: int, rate_pps: float = 300.0):
     """The front-door topology the adversarial soak attacks: a real
@@ -1259,6 +1412,9 @@ def main():
     if os.environ.get("FDTPU_BENCH_EXEC_SCALE_CHILD") == "1":
         _exec_scale_bench()
         return
+    if os.environ.get("FDTPU_BENCH_CATCHUP_CHILD") == "1":
+        _catchup_bench()
+        return
     if os.environ.get("FDTPU_BENCH_CHILD") == "1":
         _child_bench()
         return
@@ -1383,6 +1539,28 @@ def main():
                     result[k] = v
         except Exception as e6:  # noqa: BLE001
             result["exec_scale_error"] = f"{e6!r}"[:300]
+
+    # follower catch-up (r17): cold-start from a ShmFunk snapshot
+    # while the slice tail streams live, replay over the exec family
+    # against the oracle's pinned bank hashes — the "become a
+    # follower" throughput record. CPU-measured by design (restore +
+    # replay hops are host code). Failures annotate, never break.
+    if os.environ.get("FDTPU_BENCH_SKIP_CATCHUP") != "1":
+        try:
+            env = {"FDTPU_BENCH_CATCHUP_CHILD": "1"}
+            if result.get("platform", "").startswith("cpu"):
+                env["FDTPU_JAX_PLATFORM"] = "cpu"
+                env["JAX_PLATFORMS"] = "cpu"
+            cu = _run_child(
+                env,
+                float(os.environ.get("FDTPU_BENCH_CATCHUP_TIMEOUT",
+                                     "1500")),
+                require_key="replay_tps")
+            for k, v in cu.items():
+                if k.startswith("catchup_") or k == "replay_tps":
+                    result[k] = v
+        except Exception as e7:  # noqa: BLE001
+            result["catchup_error"] = f"{e7!r}"[:300]
 
     # multichip layout stanza (ROADMAP 1b): the same machine-readable
     # candidate-layout record dryrun_multichip prints into the

@@ -132,7 +132,8 @@ except ModuleNotFoundError:          # py<3.11
                 "install 'tomli'") from e
 
 _TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo",
-                 "prof", "shed", "witness", "funk"}
+                 "prof", "shed", "witness", "funk", "replay",
+                 "snapshot"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -182,7 +183,7 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
         for key in ("topology", "trace", "slo", "prof", "shed",
-                    "witness", "funk"):
+                    "witness", "funk", "replay", "snapshot"):
             if key in layer:
                 merged = _deep_merge(cfg.get(key, {}), layer[key])
                 if key == "slo" and "target" in layer[key]:
@@ -262,10 +263,21 @@ def build_topology(cfg: dict, name: str | None = None):
     funk_cfg = cfg.get("funk")
     if funk_cfg is not None:
         normalize_funk(funk_cfg)
+    # [replay]/[snapshot] follower surface — same gate (tiles/replay.py
+    # and tiles/snapshot.py are the one validator each)
+    from ..tiles.replay import normalize_replay
+    replay_cfg = cfg.get("replay")
+    if replay_cfg is not None:
+        normalize_replay(replay_cfg)
+    from ..tiles.snapshot import normalize_snapshot
+    snap_cfg = cfg.get("snapshot")
+    if snap_cfg is not None:
+        normalize_snapshot(snap_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
                     wksp_size=int(top.get("wksp_size", 1 << 26)),
                     trace=trace_cfg, slo=slo_cfg, prof=prof_cfg,
-                    shed=shed_cfg, funk=funk_cfg)
+                    shed=shed_cfg, funk=funk_cfg, replay=replay_cfg,
+                    snapshot=snap_cfg)
     for ln in cfg.get("link", []):
         topo.link(ln["name"], depth=int(ln.get("depth", 128)),
                   mtu=int(ln.get("mtu", 1280)))

@@ -738,6 +738,162 @@ def _conflict_groups(txns):
     return list(groups.values())
 
 
+class ExecFanout:
+    """Sharded exec-family wave scheduler — the r16 bank fan-out
+    machinery, factored out in r17 so the replay tile catches up over
+    the SAME engine the leader executes with. Owns the per-shard
+    dispatch/completion rings, the one-fork-per-attempt discipline
+    (wave_seq == xid: one monotonic counter identifies both the fork
+    and the attempt, so a cancelled attempt's late completions can
+    never alias the retry's), the conflict-group round-robin across
+    shards (groups are account-disjoint across tiles; a group bigger
+    than a link frame splits into consecutive frames on the SAME ring,
+    executed in order at the fork layer), and timeout cancel +
+    whole-wave redispatch when a shard dies mid-wave — exactly-once
+    application, no wedged producer.
+
+    The OWNER supplies on_commit(tag, xid, ok, fail), called when a
+    wave fully completes: the bank publishes the fork immediately and
+    flushes its poh/done frames; replay folds the fork's delta into
+    the bank-hash lattice FIRST, then publishes. xid is None when the
+    wave carried no transfers (no fork was prepared). `m` is the
+    owner's metrics dict (needs exec_waves/exec_redispatch/overruns)."""
+
+    def __init__(self, ctx, funk, exec_links, exec_done, m,
+                 on_commit=None, redispatch_s=2.0):
+        self.ctx = ctx
+        self.funk = funk
+        self.m = m
+        self.on_commit = on_commit
+        self.redispatch_s = float(redispatch_s)
+        self.exec_links = list(exec_links)
+        self.exec_done = list(exec_done)
+        if len(self.exec_links) != len(self.exec_done):
+            raise ValueError(
+                f"{ctx.tile_name}: exec_links/exec_done must pair up, "
+                f"got {self.exec_links} / {self.exec_done}")
+        self._exec_out = [(ctx.out_rings[ln], ctx.out_fseqs[ln])
+                          for ln in self.exec_links]
+        self._done_rings = [ctx.in_rings[ln] for ln in self.exec_done]
+        self.done_seq = {ln: ctx.in_seq0.get(ln, 0)
+                         for ln in self.exec_done}
+        self._exec_cap = []
+        for ln in self.exec_links:
+            cap = (ctx.plan["links"][ln]["mtu"] - _EXEC_HDR.size) \
+                // _EXEC_TXN_SZ
+            if cap < 1:
+                raise ValueError(
+                    f"{ctx.tile_name}: exec link {ln} mtu "
+                    f"{ctx.plan['links'][ln]['mtu']} can't carry one "
+                    f"dispatch txn ({_EXEC_HDR.size + _EXEC_TXN_SZ}B)")
+            self._exec_cap.append(cap)
+        self._next_xid = 1
+        self.wave = None               # in-flight wave state
+
+    @property
+    def busy(self) -> bool:
+        return self.wave is not None
+
+    def dispatch(self, txns, tag=None):
+        """Start a wave (exactly ONE outstanding — waves stay serial,
+        so cross-wave conflicts need no tracking at all). `tag` rides
+        the wave untouched and comes back in on_commit."""
+        assert self.wave is None, "one wave outstanding"
+        self.wave = {"tag": tag, "txns": list(txns), "xid": None,
+                     "wave_seq": None, "remaining": 0, "ok": 0,
+                     "fail": 0, "deadline": None}
+        self._send()
+
+    def _send(self):
+        """(Re-)dispatch the in-flight wave under a FRESH fork:
+        conflict groups round-robin across the exec tiles, each group
+        intact and in order on ONE tile."""
+        w = self.wave
+        if not w["txns"]:
+            self._commit()
+            return
+        xid = self._next_xid
+        self._next_xid += 1
+        self.funk.txn_prepare(None, xid)
+        per_tile = [[] for _ in self.exec_links]
+        for gi, g in enumerate(_conflict_groups(w["txns"])):
+            per_tile[gi % len(per_tile)].extend(g)
+        cnc = getattr(self.ctx, "cnc", None)
+        sent = 0
+        for ti, tl in enumerate(per_tile):
+            if not tl:
+                continue
+            out, fseqs = self._exec_out[ti]
+            cap = self._exec_cap[ti]
+            frames = []
+            for i in range(0, len(tl), cap):
+                chunk = tl[i:i + cap]
+                body = b"".join(
+                    t.src + t.dst + _EXEC_TXN.pack(t.amount, t.fee)
+                    for t in chunk)
+                frames.append(
+                    (xid, _EXEC_HDR.pack(xid, xid, len(chunk)) + body))
+            publish_wave(out, fseqs, frames, cnc=cnc)
+            sent += len(frames)
+        w.update(xid=xid, wave_seq=xid, remaining=sent, ok=0, fail=0,
+                 deadline=time.monotonic() + self.redispatch_s)
+        self.m["exec_waves"] += 1
+
+    def poll(self, allow_redispatch=True) -> int:
+        """Drain completion frags; commit the wave when every dispatch
+        frame completed, cancel + re-dispatch whole under a fresh fork
+        on deadline (an exec tile died mid-wave and its ring rejoin
+        skipped the frames) — the store stays consistent, the owner
+        never wedges."""
+        total = 0
+        for ln, ring in zip(self.exec_done, self._done_rings):
+            n, self.done_seq[ln], buf, sizes, _sigs, ovr = \
+                ring.gather(self.done_seq[ln], 64, 64)
+            self.m["overruns"] += ovr
+            total += n
+            for i in range(n):
+                ws, ok, fail = _EXEC_DONE.unpack_from(
+                    bytes(buf[i, :sizes[i]]), 0)
+                w = self.wave
+                if w is None or ws != w["wave_seq"]:
+                    continue       # a cancelled attempt's leftovers
+                w["remaining"] -= 1
+                w["ok"] += ok
+                w["fail"] += fail
+        w = self.wave
+        if w is not None and w["wave_seq"] is not None:
+            if w["remaining"] <= 0:
+                self._commit()
+            elif allow_redispatch \
+                    and time.monotonic() > w["deadline"]:
+                self.m["exec_redispatch"] += 1
+                self.funk.txn_cancel(w["xid"])
+                self._send()
+        return total
+
+    def _commit(self):
+        w = self.wave
+        self.wave = None
+        if self.on_commit is not None:
+            self.on_commit(w["tag"], w["xid"], w["ok"], w["fail"])
+
+    def halt(self):
+        """Bounded drain, then cancel: a wave already dispatched gets
+        redispatch_s to complete (exec tiles are halting too); after
+        the window the fork is cancelled — no partial commits in the
+        store, no on_commit for a wave that never finished."""
+        t0 = time.monotonic()
+        while self.wave is not None \
+                and time.monotonic() - t0 < self.redispatch_s:
+            self.poll(allow_redispatch=False)
+            if self.wave is not None:
+                time.sleep(0.001)
+        if self.wave is not None:
+            if self.wave["xid"] is not None:
+                self.funk.txn_cancel(self.wave["xid"])
+            self.wave = None
+
+
 @register("bank")
 class BankAdapter:
     """Execution stage (ref: src/discoh/bank/fd_bank_tile.c shape:
@@ -930,26 +1086,12 @@ class BankAdapter:
                 self.m["ws_port"] = self.ws.port
         self.seq = ctx.in_seq0.get(self.in_link, 0)
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
-        self._ef = None                # exec-family: in-flight wave
+        self.fanout = None             # exec-family wave scheduler
         if self.exec_links:
-            self.redispatch_s = float(args.get("redispatch_s", 2.0))
-            self._exec_out = [(ctx.out_rings[ln], ctx.out_fseqs[ln])
-                              for ln in self.exec_links]
-            self._done_rings = [ctx.in_rings[ln]
-                                for ln in self.exec_done]
-            self._done_seq = {ln: ctx.in_seq0.get(ln, 0)
-                              for ln in self.exec_done}
-            self._exec_cap = []
-            for ln in self.exec_links:
-                cap = (ctx.plan["links"][ln]["mtu"] - _EXEC_HDR.size) \
-                    // _EXEC_TXN_SZ
-                if cap < 1:
-                    raise ValueError(
-                        f"bank {ctx.tile_name}: exec link {ln} mtu "
-                        f"{ctx.plan['links'][ln]['mtu']} can't carry "
-                        f"one dispatch txn "
-                        f"({_EXEC_HDR.size + _EXEC_TXN_SZ}B)")
-                self._exec_cap.append(cap)
+            self.fanout = ExecFanout(
+                ctx, self.funk, self.exec_links, self.exec_done,
+                self.m, on_commit=self._ef_commit,
+                redispatch_s=float(args.get("redispatch_s", 2.0)))
 
     def _parse_payloads(self, frame, txn_cnt):
         """THE microblock frame walker (header 20, u16-framed
@@ -1166,10 +1308,10 @@ class BankAdapter:
     def _poll_exec_family(self) -> int:
         """Exec fan-out scheduler loop: drain completion frags, then —
         only with NO wave outstanding — gather the next wave and
-        dispatch it. One wave outstanding keeps waves serial, so
-        cross-wave conflicts need no tracking at all."""
-        work = self._ef_drain_completions()
-        if self._ef is not None:
+        dispatch it (ExecFanout keeps waves serial, so cross-wave
+        conflicts need no tracking at all)."""
+        work = self.fanout.poll()
+        if self.fanout.busy:
             return work
         n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
             self.seq, self.wave, self.mtu)
@@ -1197,94 +1339,18 @@ class BankAdapter:
                              if s > self._ws_last_slot}):
                 self._ws_last_slot = s
                 self.ws.publish_slot(s)
-        self._ef = {"recs": recs, "txns": txns, "xid": None,
-                    "wave_seq": None, "remaining": 0, "ok": 0,
-                    "fail": 0, "deadline": None}
-        self._ef_send()
+        self.fanout.dispatch(txns, tag=recs)
         return work + n
 
-    def _ef_send(self):
-        """(Re-)dispatch the in-flight wave under a FRESH fork:
-        conflict groups round-robin across the exec tiles, each group
-        intact and in order on ONE tile (a group bigger than a link
-        frame splits into consecutive frames on the SAME ring, which
-        the exec tile executes in order at the fork layer)."""
-        import time
-        ef = self._ef
-        if not ef["txns"]:
-            self._ef_finish()
-            return
-        xid = self._next_xid
-        self._next_xid += 1
-        self.funk.txn_prepare(None, xid)
-        per_tile = [[] for _ in self.exec_links]
-        for gi, g in enumerate(_conflict_groups(ef["txns"])):
-            per_tile[gi % len(per_tile)].extend(g)
-        cnc = getattr(self.ctx, "cnc", None)
-        sent = 0
-        for ti, tl in enumerate(per_tile):
-            if not tl:
-                continue
-            out, fseqs = self._exec_out[ti]
-            cap = self._exec_cap[ti]
-            frames = []
-            for i in range(0, len(tl), cap):
-                chunk = tl[i:i + cap]
-                body = b"".join(
-                    t.src + t.dst + _EXEC_TXN.pack(t.amount, t.fee)
-                    for t in chunk)
-                frames.append(
-                    (xid, _EXEC_HDR.pack(xid, xid, len(chunk)) + body))
-            publish_wave(out, fseqs, frames, cnc=cnc)
-            sent += len(frames)
-        # wave_seq == xid: one monotonic counter identifies both the
-        # fork and the attempt, so a cancelled attempt's late
-        # completions can never alias the retry's
-        ef.update(xid=xid, wave_seq=xid, remaining=sent, ok=0, fail=0,
-                  deadline=time.monotonic() + self.redispatch_s)
-        self.m["exec_waves"] += 1
-
-    def _ef_drain_completions(self, allow_redispatch=True) -> int:
-        import time
-        total = 0
-        for ln, ring in zip(self.exec_done, self._done_rings):
-            n, self._done_seq[ln], buf, sizes, _sigs, ovr = \
-                ring.gather(self._done_seq[ln], 64, 64)
-            self.m["overruns"] += ovr
-            total += n
-            for i in range(n):
-                ws, ok, fail = _EXEC_DONE.unpack_from(
-                    bytes(buf[i, :sizes[i]]), 0)
-                ef = self._ef
-                if ef is None or ws != ef["wave_seq"]:
-                    continue       # a cancelled attempt's leftovers
-                ef["remaining"] -= 1
-                ef["ok"] += ok
-                ef["fail"] += fail
-        ef = self._ef
-        if ef is not None and ef["wave_seq"] is not None:
-            if ef["remaining"] <= 0:
-                self.funk.txn_publish(ef["xid"])
-                self.m["transfers"] += ef["ok"]
-                self.m["exec_fail"] += ef["fail"]
-                self._ef_finish()
-            elif allow_redispatch \
-                    and time.monotonic() > ef["deadline"]:
-                # an exec tile died mid-wave (its ring rejoin skipped
-                # our frames): cancel the fork — dropping every
-                # partial commit — and re-dispatch whole under a
-                # fresh one; store stays consistent, loop never wedges
-                self.m["exec_redispatch"] += 1
-                self.funk.txn_cancel(ef["xid"])
-                self._ef_send()
-        return total
-
-    def _ef_finish(self):
-        """Wave complete: poh mixin frames + completion frags flush in
-        the original microblock order (commit ordering stays with the
-        bank, exactly the in-process paths' contract)."""
-        recs = self._ef["recs"]
-        self._ef = None
+    def _ef_commit(self, recs, xid, ok, fail):
+        """Fan-out wave complete: publish the fork, then flush the poh
+        mixin frames + completion frags in the original microblock
+        order (commit ordering stays with the bank, exactly the
+        in-process paths' contract)."""
+        if xid is not None:
+            self.funk.txn_publish(xid)
+            self.m["transfers"] += ok
+            self.m["exec_fail"] += fail
         poh_frames = []
         if self.poh_out is not None:
             for frame, txn_cnt, mb_id, mixin in recs:
@@ -1395,27 +1461,17 @@ class BankAdapter:
         # completions (the verify tile's flush contract)
         if self._pending is not None:
             self._finalize_wave()
-        if self._ef is not None:
-            # bounded drain — exec tiles are halting too, so after the
-            # window give up and cancel the fork rather than wedge the
-            # halt (no poh frame is emitted for a wave that never
-            # completed; the store holds no partial commits)
-            import time
-            t0 = time.monotonic()
-            while self._ef is not None \
-                    and time.monotonic() - t0 < self.redispatch_s:
-                self._ef_drain_completions(allow_redispatch=False)
-                if self._ef is not None:
-                    time.sleep(0.001)
-            if self._ef is not None:
-                if self._ef["xid"] is not None:
-                    self.funk.txn_cancel(self._ef["xid"])
-                self._ef = None
+        if self.fanout is not None and self.fanout.busy:
+            # bounded drain then cancel (ExecFanout.halt): exec tiles
+            # are halting too, so after the window the fork is dropped
+            # rather than wedging the halt — no poh frame for a wave
+            # that never completed, no partial commits in the store
+            self.fanout.halt()
 
     def in_seqs(self):
         s = {self.in_link: self.seq}
-        if self.exec_links:
-            s.update(self._done_seq)
+        if self.fanout is not None:
+            s.update(self.fanout.done_seq)
         return s
 
     def metrics_items(self):
@@ -2303,40 +2359,103 @@ class ReplayAdapter:
     kernel, stages txns through the conflict DAG, executes via the SVM
     host path, and notifies tower per completed block.
 
-    args: genesis ({pubkey_hex: lamports}), hashes_per_tick,
-    verify_poh (default true)."""
+    Follower mode (r17): with `exec_links`/`exec_done` the slot's
+    transfers execute over the exec tile family against the shm funk
+    store — the SAME ExecFanout engine the leader bank uses, so
+    `exec_tile_cnt` shards replay a slot in parallel with exactly-once
+    commits across an exec-shard crash. `wait_restore` gates replay on
+    snapin's restore marker (cold-start from snapshot, then catch up);
+    `expected` pins the leader's per-slot bank hashes — a mismatch is
+    a divergence VERDICT (metric + loud tile FAIL), never a silent
+    wrong state. [snapshot] every_slots/path make this tile a periodic
+    crash-safe snapshot writer. Chaos: diverge_block perturbs the next
+    slot's lattice (the verdict must trip); crash_mid_snapshot kills
+    the next snapshot write between rows (the previous file must
+    survive the atomic-rename discipline).
+
+    args: genesis ({pubkey_hex: lamports}), genesis_synth,
+    hashes_per_tick, verify_poh (default true), slots_per_epoch,
+    exec_links/exec_done (ordered per-shard dispatch/completion
+    links), redispatch_s, expected ({slot: bank_hash_hex}),
+    wait_restore, snapshot_path/snapshot_every/snapshot_compress
+    (default from the plan's [snapshot] section)."""
 
     METRICS = ["slices", "slots_replayed", "entries", "txns", "exec_ok",
                "exec_fail", "poh_fail", "buffered", "waves",
-               "parse_fail", "overruns"]
-    GAUGES = ["buffered"]
+               "parse_fail", "exec_skip", "exec_waves",
+               "exec_redispatch", "divergent_slot", "snapshots",
+               "restore_slot", "behind", "overruns"]
+    GAUGES = ["buffered", "behind", "divergent_slot", "restore_slot"]
 
     def __init__(self, ctx, args):
         _setup_jax()
         from ..tiles.replay import ReplayCore
         self.ctx = ctx
-        if len(ctx.in_rings) != 1:
+        self.exec_links = list(args.get("exec_links") or [])
+        self.exec_done = list(args.get("exec_done") or [])
+        non_done = [ln for ln in ctx.in_rings
+                    if ln not in self.exec_done]
+        if len(non_done) != 1:
             raise ValueError(
-                f"replay tile {ctx.tile_name}: exactly one in link, "
-                f"got {list(ctx.in_rings)}")
-        self.in_link = next(iter(ctx.in_rings))
+                f"replay tile {ctx.tile_name}: exactly one slice in "
+                f"link, got {non_done}")
+        self.in_link = non_done[0]
         self.ring = ctx.in_rings[self.in_link]
         genesis = {bytes.fromhex(k): int(v)
                    for k, v in args.get("genesis", {}).items()}
         if args.get("genesis_synth"):
             genesis.update(_synth_genesis(int(args["genesis_synth"])))
+        outs = {ln: r for ln, r in ctx.out_rings.items()
+                if ln not in self.exec_links}
+        out_fseqs = {ln: f for ln, f in ctx.out_fseqs.items()
+                     if ln not in self.exec_links}
+        rp = ctx.plan.get("replay") or {}
+        sp = ctx.plan.get("snapshot") or {}
+        funk = fanout = None
+        if self.exec_links:
+            fk = ctx.plan.get("funk") or {}
+            if fk.get("backend") != "shm" or "off" not in fk:
+                raise ValueError(
+                    f"replay {ctx.tile_name}: exec_links need "
+                    f"[funk] backend=\"shm\"")
+            from ..funk.shmfunk import WireFunk
+            funk = WireFunk.from_plan(ctx.wksp, fk)
+            fanout = ExecFanout(
+                ctx, funk, self.exec_links, self.exec_done, m={},
+                redispatch_s=float(args.get(
+                    "redispatch_s", rp.get("redispatch_s", 2.0))))
+        expected = {int(s): bytes.fromhex(h)
+                    for s, h in (args.get("expected") or {}).items()}
         self.core = ReplayCore(
-            out_ring=_single(ctx.out_rings, "out link", ctx.tile_name),
-            out_fseqs=_single(ctx.out_fseqs, "out link", ctx.tile_name),
+            out_ring=_single(outs, "tower out link", ctx.tile_name),
+            out_fseqs=_single(out_fseqs, "tower out link",
+                              ctx.tile_name),
             genesis=genesis,
-            hashes_per_tick=int(args.get("hashes_per_tick", 16)),
-            verify_poh=bool(args.get("verify_poh", True)),
-            slots_per_epoch=int(args.get("slots_per_epoch", 432_000)))
+            hashes_per_tick=int(args.get(
+                "hashes_per_tick", rp.get("hashes_per_tick", 16))),
+            verify_poh=bool(args.get(
+                "verify_poh", rp.get("verify_poh", True))),
+            slots_per_epoch=int(args.get("slots_per_epoch", 432_000)),
+            funk=funk, fanout=fanout, expected=expected,
+            wait_restore=bool(args.get("wait_restore", False)),
+            snapshot_path=str(args.get("snapshot_path",
+                                       sp.get("path", ""))),
+            snapshot_every=int(args.get("snapshot_every",
+                                        sp.get("every_slots", 0))),
+            snapshot_compress=bool(args.get(
+                "snapshot_compress", sp.get("compress", True))),
+            cnc=getattr(ctx, "cnc", None))
+        if fanout is not None:
+            fanout.m = self.core.metrics   # shared counters, one dict
         self.seq = ctx.in_seq0.get(self.in_link, 0)
         self._ovr = 0
         self.mtu = ctx.plan["links"][self.in_link]["mtu"]
 
     def poll_once(self) -> int:
+        if self.core.waiting:
+            # cold-start: keep polling for snapin's restore marker;
+            # slices gathered below buffer until it lands
+            self.core.check_restore()
         n, self.seq, buf, sizes, sigs, ovr = self.ring.gather(
             self.seq, 8, self.mtu)
         self._ovr += ovr
@@ -2344,11 +2463,26 @@ class ReplayAdapter:
             self.core.on_slice(bytes(buf[i, :sizes[i]]))
         return n
 
+    def on_chaos(self, ev: dict):
+        if ev["action"] == "diverge_block":
+            self.core._diverge_seed = int(ev.get("seed", 1))
+        elif ev["action"] == "crash_mid_snapshot":
+            self.core._crash_snap = True
+
+    def on_halt(self):
+        if self.core.fanout is not None and self.core.fanout.busy:
+            self.core.fanout.halt()
+
     def in_seqs(self):
-        return {self.in_link: self.seq}
+        s = {self.in_link: self.seq}
+        if self.core.fanout is not None:
+            s.update(self.core.fanout.done_seq)
+        return s
 
     def metrics_items(self):
-        return {**self.core.metrics, "overruns": self._ovr}
+        m = dict(self.core.metrics)
+        m["overruns"] += self._ovr
+        return m
 
 
 @register("send")
@@ -2537,21 +2671,40 @@ class GossipAdapter:
 class SnapLdAdapter:
     """Snapshot loader tile (ref: src/discof/restore/fd_snapct_tile.c
     download/read orchestration, simplified to local file streaming).
-    args: path, chunk."""
 
-    METRICS = ["bytes", "frags", "done"]
-    GAUGES = ["done"]
+    Chaos seams (r17): corrupt_checkpt_frame flips one seeded byte in
+    the next fragment (downstream verify MUST reject the stream);
+    crash_mid_snapshot hard-kills this process mid-file (restart
+    re-streams from byte 0 — the snapshot protocol is resumable by
+    restart, not by offset); stale_snapshot_offer re-offers
+    `stale_path`, whose older slot the inserter's min_slot gate must
+    refuse. args: path, chunk, stale_path."""
+
+    METRICS = ["bytes", "frags", "done", "total_bytes", "corrupted",
+               "offers"]
+    GAUGES = ["done", "total_bytes"]
 
     def __init__(self, ctx, args):
         from ..tiles.snapshot import SnapLoader
+        sp = ctx.plan.get("snapshot") or {}
+        self.stale_path = args.get("stale_path", "")
         self.tile = SnapLoader(
-            args["path"],
+            args.get("path") or sp.get("path"),
             _single(ctx.out_rings, "out link", ctx.tile_name),
             _single(ctx.out_fseqs, "out link", ctx.tile_name),
-            chunk=int(args.get("chunk", 1024)))
+            chunk=int(args.get("chunk", sp.get("chunk", 1024))))
 
     def poll_once(self) -> int:
         return self.tile.poll_once()
+
+    def on_chaos(self, ev: dict):
+        if ev["action"] == "corrupt_checkpt_frame":
+            self.tile._corrupt_seed = int(ev.get("seed", 1))
+        elif ev["action"] == "crash_mid_snapshot":
+            # die halfway through the file, between publishes
+            self.tile._crash_at = max(1, self.tile.size // 2)
+        elif ev["action"] == "stale_snapshot_offer" and self.stale_path:
+            self.tile.offer(self.stale_path)
 
     def metrics_items(self):
         return dict(self.tile.metrics)
@@ -2592,7 +2745,15 @@ class SnapInAdapter:
     framework's own checkpoint frames (integrity trailer inside the
     reader). format="archive": the real tar+AppendVec layout, fed
     DECOMPRESSED bytes by an upstream snapdc tile, lattice checksum
-    verified at EOM."""
+    verified at EOM.
+
+    Follower mode (r17): when the plan carves a shm funk store
+    ([funk] backend="shm"), format="checkpt" restores INTO that
+    shared store (install-after-verify: every row validated before
+    any lands) and then writes the restore marker the replay tile
+    gates on — snapshot boot handoff without a control channel.
+    min_slot (arg, or [snapshot] min_slot) refuses stale snapshots
+    loudly instead of silently rolling the state back."""
 
     METRICS = ["frags", "bytes", "accounts", "restored", "fingerprint",
                "slot", "lattice_ok", "stream_err"]
@@ -2602,9 +2763,19 @@ class SnapInAdapter:
         from ..tiles.snapshot import ArchiveInserter, SnapInserter
         self.ctx = ctx
         self.in_link = next(iter(ctx.in_rings))
-        cls = ArchiveInserter if args.get("format") == "archive" \
-            else SnapInserter
-        self.tile = cls(ctx.in_rings[self.in_link])
+        if args.get("format") == "archive":
+            self.tile = ArchiveInserter(ctx.in_rings[self.in_link])
+        else:
+            sp = ctx.plan.get("snapshot") or {}
+            funk = None
+            fk = ctx.plan.get("funk") or {}
+            if fk.get("backend") == "shm" and "off" in fk:
+                from ..funk.shmfunk import WireFunk
+                funk = WireFunk.from_plan(ctx.wksp, fk)
+            self.tile = SnapInserter(
+                ctx.in_rings[self.in_link], funk=funk,
+                min_slot=int(args.get("min_slot",
+                                      sp.get("min_slot", 0))))
 
     def poll_once(self) -> int:
         return self.tile.poll_once()

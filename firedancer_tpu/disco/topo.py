@@ -85,7 +85,8 @@ class Topology:
     def __init__(self, name: str, wksp_size: int = 1 << 26,
                  trace: dict | None = None, slo: dict | None = None,
                  prof: dict | None = None, shed: dict | None = None,
-                 funk: dict | None = None):
+                 funk: dict | None = None, replay: dict | None = None,
+                 snapshot: dict | None = None):
         self.name = name
         self.wksp_size = wksp_size
         self.links: dict[str, LinkSpec] = {}
@@ -108,6 +109,12 @@ class Topology:
         # "shm" makes build() carve the record/txn store into the wksp
         # so bank + the resolv/exec tile family share one fork tree
         self.funk = funk
+        # [replay]/[snapshot] follower surface (tiles/replay.py and
+        # tiles/snapshot.py schemas): replay fan-out defaults and the
+        # snapshot path/cadence/min_slot the snapld/snapin/replay
+        # adapters read off the plan
+        self.replay = replay
+        self.snapshot = snapshot
 
     def link(self, name: str, depth: int = 128, mtu: int = 1280,
              external: bool = False):
@@ -308,6 +315,17 @@ class Topology:
                            txn_max=funk_cfg["txn_max"], heap_sz=heap_sz)
                 plan["funk"]["off"] = st.off
                 plan["funk"]["heap_sz"] = heap_sz
+            # [replay]/[snapshot]: validated here (fail before launch)
+            # and carried on the plan — the replay/snapld/snapin
+            # adapters take their defaults from these sections, tile
+            # args win per key
+            from ..tiles.replay import normalize_replay as _norm_replay
+            plan["replay"] = _norm_replay(self.replay) \
+                if self.replay is not None else None
+            from ..tiles.snapshot import normalize_snapshot \
+                as _norm_snap
+            plan["snapshot"] = _norm_snap(self.snapshot) \
+                if self.snapshot is not None else None
             for tn, t in self.tiles.items():
                 if "shed" in t.args:
                     _norm_shed(t.args["shed"], per_tile=True)

@@ -40,23 +40,50 @@ def serialize_account(pubkey: bytes, acct) -> bytes:
     return head + data + tail
 
 
+def _lthash_on_host() -> bool:
+    """The batched jnp kernel pays ~15k eager op dispatches per call —
+    a net loss on the CPU backend (~2 s/call warm) where the host
+    oracle does the same work in ~6 ms/message. On accelerators the
+    batch IS the win, so keep the device path there."""
+    import jax
+    return jax.default_backend() == "cpu"
+
+
 def accounts_lthash(items) -> np.ndarray:
     """[(pubkey, Account)] -> summed lattice element (1024 u16), all
-    lanes in one batched device call. Zero-lamport accounts skip."""
-    from ..ops.blake3 import lthash_batch
-    msgs, lens = [], []
+    lanes in one batched device call (host oracle on the CPU backend).
+    Zero-lamport accounts skip."""
+    raws = []
     for pk, a in items:
         if a is None or a.lamports == 0:
             continue
-        m = serialize_account(pk, a)
+        raws.append(serialize_account(pk, a))
+    if not raws:
+        return np.zeros(1024, np.uint16)
+    if _lthash_on_host():
+        from ..utils.blake3_ref import lthash
+        acc = np.zeros(1024, np.uint32)
+        for m in raws:
+            acc += np.frombuffer(lthash(m), np.uint16)
+        return acc.astype(np.uint16)
+    from ..ops.blake3 import lthash_batch
+    msgs, lens = [], []
+    for m in raws:
         buf = np.zeros(LT_MSG_MAX, np.uint8)
         buf[:len(m)] = np.frombuffer(m, np.uint8)
         msgs.append(buf)
         lens.append(len(m))
-    if not msgs:
-        return np.zeros(1024, np.uint16)
+    # pad the lane count to the next power of two: the kernel compiles
+    # per batch shape, and per-slot deltas would otherwise trace a
+    # fresh XLA graph for every distinct modified-account count (~12s
+    # each on a cold cpu cache); padded lanes are sliced off before
+    # the sum so the lattice value is unchanged
+    n = len(msgs)
+    while len(msgs) < (1 << (n - 1).bit_length()):
+        msgs.append(np.zeros(LT_MSG_MAX, np.uint8))
+        lens.append(0)
     lt = np.asarray(lthash_batch(np.stack(msgs),
-                                 np.asarray(lens, np.int32)))
+                                 np.asarray(lens, np.int32)))[:n]
     return lt.astype(np.uint32).sum(axis=0).astype(np.uint16)
 
 

@@ -391,6 +391,17 @@ class WireFunk:
         return {k: decode_value(v)
                 for k, v in self.raw.iter_layer(0) if v is not None}
 
+    def txn_recs(self, xid) -> dict:
+        """The fork layer's own records (deletes as None) — what the
+        bank-hash delta scan (flamenco/bank_hash.apply_txn_delta) walks
+        before publish; the replay scheduler hashes every slot through
+        this exact seam."""
+        u = self._u(xid)
+        if u == 0 or not self.raw.txn_exists(u):
+            raise FunkTxnError(f"unknown txn {xid!r}")
+        return {k: (None if v is None else decode_value(v))
+                for k, v in self.raw.iter_layer(u)}
+
 
 def make_funk(cfg: dict | None = None, wksp=None, off: int | None = None):
     """[funk] config -> a funk instance of the configured backend. The

@@ -70,6 +70,8 @@ border-radius:3px;padding:0 6px;margin:1px 4px 1px 0;font-size:11px}
 <div class="kpi"><div class="kv" id="tps">-</div><div class="kl">TPS</div></div>
 <div class="kpi"><div class="kv" id="kbreach">0</div><div class="kl">SLO breached now</div></div>
 <div class="kpi"><div class="kv" id="ktiles">-</div><div class="kl">tiles up</div></div>
+<div class="kpi" id="kcatch" hidden><div class="kv" id="kbehind">-</div>
+<div class="kl">slots behind <span id="kcdetail"></span></div></div>
 </div>
 <div id="prov" hidden></div>
 <nav>
@@ -232,6 +234,17 @@ function applyDelta(d){
   tr.querySelector(".ms").innerHTML="<small>"+
    Object.entries(row.metrics||{}).filter(([k,v])=>v)
    .map(([k,v])=>k+"="+fmt(v)).join(" ")+"</small>";}
+ /* catch-up panel (follower topologies only: d.catchup != null) */
+ const cu=d.catchup;
+ $("kcatch").hidden=!cu;
+ if(cu){
+  $("kbehind").textContent=fmt(cu.behind||0);
+  $("kbehind").classList.toggle("bad",!!cu.divergent_slot);
+  let det="replay "+fmt(cu.replay_tps||0)+" tps";
+  if(cu.restore_pct!=null&&cu.restore_pct<100)
+   det="restore "+cu.restore_pct+"%";
+  if(cu.divergent_slot)det="DIVERGED @ slot "+cu.divergent_slot;
+  $("kcdetail").textContent="· "+det;}
  /* slo tab */
  if(d.slo){$("sbr").textContent=d.slo.breach||0;
   $("sbs").textContent=d.slo.breaches||0;

@@ -161,6 +161,15 @@ class DeltaSource:
         self._metric_tile = next(
             (tn for tn, s in plan["tiles"].items()
              if s["kind"] == "metric"), None)
+        # catch-up surface (r17): replay + snapld tiles, if the
+        # topology has them (follower mode)
+        self._replay_tile = next(
+            (tn for tn, s in plan["tiles"].items()
+             if s["kind"] == "replay"), None)
+        self._snapld_tile = next(
+            (tn for tn, s in plan["tiles"].items()
+             if s["kind"] == "snapld"), None)
+        self._replay_win: deque = deque()    # (ns, txns) samples
 
     # -- TPS (satellite fix: tempo.monotonic_ns, THE topology clock —
     # the rate must agree with trace/prof timelines, not drift on a
@@ -236,6 +245,49 @@ class DeltaSource:
         out["events"] = slo_breach_events(self.plan, self.wksp)
         return out
 
+    # -- catch-up progress (r17 follower surface) ---------------------------
+
+    def _tile_metrics(self, tn: str) -> dict:
+        from ..disco.topo import read_metrics
+        spec = self.plan["tiles"].get(tn) or {}
+        names = spec.get("metrics_names", [])
+        vals = read_metrics(self.wksp, self.plan, tn)
+        return {n: int(vals[i]) for i, n in enumerate(names)}
+
+    def _catchup(self, now_ns: int) -> dict | None:
+        """Follower catch-up panel: slots behind the live tip, the
+        rolling replayed-txn rate, restore stream progress. None on a
+        topology with no replay tile (the common leader case — the
+        delta stays lean)."""
+        if self._replay_tile is None:
+            return None
+        rm = self._tile_metrics(self._replay_tile)
+        self._replay_win.append((now_ns, rm.get("txns", 0)))
+        lo = now_ns - int(self.tps_window_s * 1e9)
+        while len(self._replay_win) > 1 \
+                and self._replay_win[1][0] <= lo:
+            self._replay_win.popleft()
+        t0, c0 = self._replay_win[0]
+        rate = 0.0
+        if now_ns > t0:
+            rate = max(0.0, (self._replay_win[-1][1] - c0)
+                       / ((now_ns - t0) / 1e9))
+        out = {
+            "behind": rm.get("behind", 0),
+            "replay_tps": round(rate, 1),
+            "slots_replayed": rm.get("slots_replayed", 0),
+            "restore_slot": rm.get("restore_slot", 0),
+            "divergent_slot": rm.get("divergent_slot", 0),
+            "restore_pct": None,
+        }
+        if self._snapld_tile is not None:
+            sm = self._tile_metrics(self._snapld_tile)
+            total = sm.get("total_bytes", 0)
+            if total:
+                out["restore_pct"] = round(
+                    100.0 * min(sm.get("bytes", 0), total) / total, 1)
+        return out
+
     def delta(self) -> dict:
         """One protocol delta. Raises on a torn/halting topology —
         callers own the 503/skip policy (the gui tile's summary route
@@ -254,4 +306,5 @@ class DeltaSource:
             "links": links_table(
                 read_link_metrics(self.wksp, self.plan)),
             "slo": self._slo(),
+            "catchup": self._catchup(now),
         }

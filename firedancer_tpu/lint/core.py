@@ -120,6 +120,20 @@ RULES: dict[str, tuple[str, str, str]] = {
         "(unknown key with did-you-mean, unknown backend, rec_max/"
         "txn_max < 16, heap_mb < 1) — the account-store carve must "
         "validate at review, not when topo.build sizes the workspace"),
+    "bad-replay": (
+        "graph", "error",
+        "[replay] section rejected by the tiles/replay.py schema "
+        "(unknown key with did-you-mean, exec_tile_cnt < 0, "
+        "redispatch_s <= 0, hashes_per_tick < 1) — the follower "
+        "fan-out defaults must validate at review, not when the "
+        "catch-up node boots"),
+    "bad-snapshot": (
+        "graph", "error",
+        "[snapshot] section rejected by the tiles/snapshot.py schema "
+        "(unknown key with did-you-mean, negative every_slots/"
+        "min_slot, chunk < 64) — the snapshot path/cadence the "
+        "snapld/snapin/replay tiles share must validate at review, "
+        "not mid-restore"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

@@ -84,7 +84,9 @@ def model_from_config(cfg: dict) -> dict:
     return {"links": links, "tcaches": tcaches, "tiles": tiles,
             "trace": cfg.get("trace"), "slo": cfg.get("slo"),
             "prof": cfg.get("prof"), "shed": cfg.get("shed"),
-            "witness": cfg.get("witness"), "funk": cfg.get("funk")}
+            "witness": cfg.get("witness"), "funk": cfg.get("funk"),
+            "replay": cfg.get("replay"),
+            "snapshot": cfg.get("snapshot")}
 
 
 def model_from_topology(topo) -> dict:
@@ -102,7 +104,9 @@ def model_from_topology(topo) -> dict:
             "prof": getattr(topo, "prof", None),
             "shed": getattr(topo, "shed", None),
             "witness": getattr(topo, "witness", None),
-            "funk": getattr(topo, "funk", None)}
+            "funk": getattr(topo, "funk", None),
+            "replay": getattr(topo, "replay", None),
+            "snapshot": getattr(topo, "snapshot", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -250,6 +254,8 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_shed(model, path, lines))
     out.extend(_check_witness(model, path))
     out.extend(_check_funk(model, path))
+    out.extend(_check_replay(model, path))
+    out.extend(_check_snapshot(model, path))
     return out
 
 
@@ -283,6 +289,39 @@ def _check_funk(model, path) -> list[Finding]:
             normalize_funk(spec)
         except Exception as e:
             out.append(finding("bad-funk", path, 0, f"[funk]: {e}"))
+    return out
+
+
+def _check_replay(model, path) -> list[Finding]:
+    """[replay] section: the tiles/replay.py schema gate (one
+    validator, same as config load and topo.build) — unknown keys,
+    negative exec_tile_cnt, non-positive redispatch_s all land as
+    review-time findings with a did-you-mean."""
+    from ..tiles.replay import normalize_replay
+    out: list[Finding] = []
+    spec = model.get("replay")
+    if spec is not None:
+        try:
+            normalize_replay(spec)
+        except Exception as e:
+            out.append(finding("bad-replay", path, 0, f"[replay]: {e}"))
+    return out
+
+
+def _check_snapshot(model, path) -> list[Finding]:
+    """[snapshot] section: the tiles/snapshot.py schema gate (one
+    validator, same as config load and topo.build) — unknown keys,
+    negative every_slots/min_slot, undersized chunk all land as
+    review-time findings with a did-you-mean."""
+    from ..tiles.snapshot import normalize_snapshot
+    out: list[Finding] = []
+    spec = model.get("snapshot")
+    if spec is not None:
+        try:
+            normalize_snapshot(spec)
+        except Exception as e:
+            out.append(finding("bad-snapshot", path, 0,
+                               f"[snapshot]: {e}"))
     return out
 
 

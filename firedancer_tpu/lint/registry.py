@@ -77,6 +77,20 @@ TILE_SHED_KEYS = SHED_SECTION_KEYS
 # rule.
 FUNK_SECTION_KEYS = ("backend", "rec_max", "txn_max", "heap_mb")
 
+# [replay] topology-section keys (mirror of tiles/replay.py
+# REPLAY_DEFAULTS — tests/test_follower.py keeps the mirror honest).
+# Validated by normalize_replay at config load, topo.build, and the
+# graph analyzer's bad-replay rule.
+REPLAY_SECTION_KEYS = ("exec_tile_cnt", "redispatch_s", "verify_poh",
+                       "hashes_per_tick")
+
+# [snapshot] topology-section keys (mirror of tiles/snapshot.py
+# SNAPSHOT_DEFAULTS — tests/test_follower.py keeps the mirror honest).
+# Validated by normalize_snapshot at config load, topo.build, and the
+# graph analyzer's bad-snapshot rule.
+SNAPSHOT_SECTION_KEYS = ("path", "every_slots", "min_slot", "compress",
+                         "chunk")
+
 # [witness] topology-section keys (mirror of witness/plan.py
 # WITNESS_DEFAULTS / WITNESS_STAGE_KEYS — tests/test_witness.py keeps
 # the mirror honest). Stage names in `stages` / [witness.stage.<name>]
@@ -140,7 +154,15 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
                "root_slot": None},
     "replay": {"genesis": None, "genesis_synth": None,
                "hashes_per_tick": None, "verify_poh": None,
-               "slots_per_epoch": None},
+               "slots_per_epoch": None,
+               # follower fan-out (r17): same shape as the bank's exec
+               # family seam, plus the catch-up surface — leader
+               # bank-hash pins, snapshot-gated cold start, periodic
+               # snapshot writing (defaults from [replay]/[snapshot])
+               "exec_links": OUT_LIST, "exec_done": IN_LIST,
+               "redispatch_s": None, "expected": None,
+               "wait_restore": None, "snapshot_path": None,
+               "snapshot_every": None, "snapshot_compress": None},
     "send": {"req": OUT, "resp": IN, "identity_hex": None,
              "vote_account_hex": None, "dest": None},
     "archiver": {"path": None},
@@ -152,9 +174,9 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
                # device sigcheck with the RLC batch kernel
                # (gossip/gossvf.py mode="bulk")
                "gossvf_bulk": None},
-    "snapld": {"path": None, "chunk": None},
+    "snapld": {"path": None, "chunk": None, "stale_path": None},
     "snapdc": {},
-    "snapin": {"format": None},
+    "snapin": {"format": None, "min_slot": None},
     "metric": {"port": None, "bind_addr": None, "healthz_stale_s": None},
     "bundle": {"engine": None, "path": None, "authority": None},
     "plugin": {"sock_path": None, "data_hex_max": None},

@@ -48,6 +48,9 @@ GATE_METRICS = (
     # execution scale-out (r16): the exec-family leader loop's
     # capacity at 2 exec tiles — the tile-count scaling contract
     ("exec_scale_tps_2", "exec scale tps (2 tiles)"),
+    # follower catch-up (r17): snapshot-restore + tail replay over the
+    # exec family — the "become a follower" throughput contract
+    ("replay_tps", "catch-up replay tps"),
 )
 
 # the knee subset: what bench.py's implicit previous-round gate

@@ -603,8 +603,19 @@ class Store:
 
     # -- records ------------------------------------------------------------
 
+    @staticmethod
+    def _key32(key: bytes) -> bytes:
+        """The native ABI reads EXACTLY 32 key bytes — a shorter python
+        buffer would make C hash whatever trails it in memory, which
+        differs per process (a record written by one tile becomes
+        unfindable from another)."""
+        if len(key) != 32:
+            raise ValueError(f"store keys are 32 bytes, got {len(key)}")
+        return key
+
     def put(self, xid: int, key: bytes, val: bytes | None) -> int:
         """val=None writes a tombstone (root: deletes the record)."""
+        key = self._key32(key)
         if val is None:
             return lib.fdtpu_store_put(self.wksp.base, self.off, xid,
                                        key, None, 0, 1)
@@ -614,6 +625,7 @@ class Store:
     def get(self, xid: int, key: bytes) -> bytes | None:
         """Fork-visibility query; None when absent/tombstoned. Raises on
         unknown xid (matches funk's contract)."""
+        key = self._key32(key)
         n = lib.fdtpu_store_get(self.wksp.base, self.off, xid, key,
                                 self._buf, len(self._buf))
         if n == -1:

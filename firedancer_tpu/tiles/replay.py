@@ -16,6 +16,23 @@ emit tower block frames keyed by the slot's final PoH hash.
 Out-of-order slots (repair back-fill) buffer until their parent
 replays: slices are per-slot complete, but execution must follow the
 chain, so a repaired hole releases its buffered descendants in order.
+
+Follower mode (r17): with `fanout` (disco/tiles.ExecFanout) the slot's
+transfers execute over the SAME sharded exec tile family the leader
+bank uses — conflict-group partition across `exec_tile_cnt` shards,
+one fork per attempt, timeout cancel + whole-wave redispatch on an
+exec-shard crash (exactly-once commits) — against the shm funk store.
+With `wait_restore` the core buffers slices until snapin's restore
+marker appears in the store root, then seeds the bank-hash lattice
+from the restored state and replays the tail from the snapshot slot.
+Every replayed slot's bank hash is checked against `expected` (the
+leader's per-slot hashes): a mismatch is a DIVERGENCE VERDICT — the
+divergent slot lands in the metrics (black-box material) and the tile
+raises, so the supervisor flips CNC_FAIL rather than the node running
+on silently with wrong state. `snapshot_every`/`snapshot_path` make
+the follower a snapshot WRITER too (utils/checkpt.snapshot_write_atomic
+— tmp + fsync + rename, a writer crash leaves the previous file
+intact).
 """
 from __future__ import annotations
 
@@ -32,18 +49,78 @@ from ..protocol.txn import parse_txn
 from .shred import parse_entry_batch, parse_slice
 from .tower import pack_block
 
+# [replay] config section (the load/build/lint triple: this validator,
+# the lint/registry.py mirror, lint/graph.py bad-replay)
+REPLAY_DEFAULTS = {
+    "exec_tile_cnt": 0,     # fan-out shards (0 = in-process execution)
+    "redispatch_s": 2.0,    # fan-out wave timeout -> cancel + retry
+    "verify_poh": True,
+    "hashes_per_tick": 16,
+}
+
+
+def _suggest(key, candidates):
+    from ..lint.registry import suggest
+    return suggest(str(key), candidates)
+
+
+def normalize_replay(spec) -> dict:
+    """Validate + default-fill a [replay] table. Same
+    fail-before-launch stance as [funk]: raises ValueError with a
+    did-you-mean."""
+    out = dict(REPLAY_DEFAULTS)
+    if spec is None:
+        return out
+    if not isinstance(spec, dict):
+        raise ValueError(f"replay spec must be a table, got {spec!r}")
+    unknown = set(spec) - set(REPLAY_DEFAULTS)
+    if unknown:
+        key = sorted(unknown)[0]
+        raise ValueError(f"unknown replay key(s) {sorted(unknown)}"
+                         + _suggest(key, REPLAY_DEFAULTS))
+    out.update(spec)
+    out["exec_tile_cnt"] = int(out["exec_tile_cnt"])
+    if out["exec_tile_cnt"] < 0:
+        raise ValueError(f"replay.exec_tile_cnt must be >= 0, got "
+                         f"{out['exec_tile_cnt']}")
+    out["redispatch_s"] = float(out["redispatch_s"])
+    if out["redispatch_s"] <= 0:
+        raise ValueError(f"replay.redispatch_s must be > 0, got "
+                         f"{out['redispatch_s']}")
+    out["verify_poh"] = bool(out["verify_poh"])
+    out["hashes_per_tick"] = int(out["hashes_per_tick"])
+    if out["hashes_per_tick"] < 1:
+        raise ValueError(f"replay.hashes_per_tick must be >= 1, got "
+                         f"{out['hashes_per_tick']}")
+    return out
+
 
 class ReplayCore:
     def __init__(self, out_ring=None, out_fseqs=None,
                  genesis: dict[bytes, int] | None = None,
                  hashes_per_tick: int = 16, verify_poh: bool = True,
-                 slots_per_epoch: int = 432_000):
-        self.funk = Funk()
+                 slots_per_epoch: int = 432_000, funk=None,
+                 fanout=None, expected: dict[int, bytes] | None = None,
+                 wait_restore: bool = False, snapshot_path: str = "",
+                 snapshot_every: int = 0, snapshot_compress: bool = True,
+                 cnc=None):
+        self.funk = funk if funk is not None else Funk()
+        self.fanout = fanout
+        if fanout is not None:
+            fanout.on_commit = self._fanout_commit
+        self.cnc = cnc
+        self.expected = dict(expected or {})
+        self.wait_restore = bool(wait_restore)
+        self.snapshot_path = snapshot_path
+        self.snapshot_every = int(snapshot_every)
+        self.snapshot_compress = bool(snapshot_compress)
         self.db = AccDb(self.funk)
         for key, bal in (genesis or {}).items():
             self.funk.rec_write(None, key,
                                 Account(lamports=int(bal)))
-        self.executor = TxnExecutor(self.db)
+        # the host executor drives the in-process path; the fan-out
+        # path ships transfers to the exec shards instead
+        self.executor = TxnExecutor(self.db) if fanout is None else None
         self.out_ring = out_ring
         self.out_fseqs = out_fseqs
         self.hashes_per_tick = hashes_per_tick
@@ -58,13 +135,48 @@ class ReplayCore:
         self.hash_of: dict[int, bytes] = {}   # slot -> final PoH hash
         self.bank_hash_of: dict[int, bytes] = {}
         # seed the accounts lattice from the boot state (the reference
-        # initializes accounts_lt_hash from the snapshot)
+        # initializes accounts_lt_hash from the snapshot); a follower
+        # waiting on restore re-seeds in check_restore instead
         self.hasher = BankHasher(lthash_of_root(self.funk))
         self.anchored = False                 # saw a full prior slot
+        # chaos seams (armed by the adapter's on_chaos)
+        self._diverge_seed: int | None = None
+        self._crash_snap = False
         self.metrics = {"slices": 0, "slots_replayed": 0, "entries": 0,
                         "txns": 0, "exec_ok": 0, "exec_fail": 0,
                         "poh_fail": 0, "buffered": 0, "waves": 0,
-                        "parse_fail": 0}
+                        "parse_fail": 0, "exec_skip": 0,
+                        "exec_waves": 0, "exec_redispatch": 0,
+                        "overruns": 0, "divergent_slot": 0,
+                        "snapshots": 0, "restore_slot": 0, "behind": 0}
+
+    # -- follower cold-start gate -------------------------------------------
+
+    @property
+    def waiting(self) -> bool:
+        return self.wait_restore
+
+    def check_restore(self) -> bool:
+        """Poll the store root for snapin's restore marker; on arrival
+        seed the replay chain from the snapshot (lattice from the
+        restored state, parent bank hash + next slot from the marker)
+        and release any slices buffered while waiting. True once the
+        core is live."""
+        if not self.wait_restore:
+            return True
+        from ..utils.checkpt import RESTORE_MARKER_KEY
+        val = self.funk.rec_query(None, RESTORE_MARKER_KEY)
+        if val is None:
+            return False
+        slot, bank_hash = int(val[0]), bytes(val[1])
+        from ..flamenco.bank_hash import BankHasher, lthash_of_root
+        self.hasher = BankHasher(lthash_of_root(self.funk))
+        self.next_slot = slot + 1
+        self.bank_hash_of[slot] = bank_hash
+        self.metrics["restore_slot"] = slot
+        self.wait_restore = False
+        self._release()
+        return True
 
     # -- slice ingest -------------------------------------------------------
 
@@ -76,8 +188,16 @@ class ReplayCore:
             self.pending[slot] = self.pending.get(slot, b"") + payload
             return 0
         self.pending[slot] = self.pending.get(slot, b"") + payload
+        if self.wait_restore:
+            # cold-start: the tail buffers until the snapshot installs
+            # (check_restore seeds next_slot, then releases)
+            self._gauge_pending()
+            return 0
         if self.next_slot is None:
             self.next_slot = slot
+        return self._release()
+
+    def _release(self) -> int:
         ran = 0
         # release the contiguous chain from next_slot
         while self.next_slot in self.pending:
@@ -87,10 +207,22 @@ class ReplayCore:
             ran += 1
         # slots older than the anchor (late repairs racing the anchor)
         # will never execute — drop them so pending stays bounded
-        self.pending = {s: b for s, b in self.pending.items()
-                        if s >= self.next_slot}
-        self.metrics["buffered"] = len(self.pending)
+        if self.next_slot is not None:
+            self.pending = {s: b for s, b in self.pending.items()
+                            if s >= self.next_slot}
+        self._gauge_pending()
         return ran
+
+    def _gauge_pending(self):
+        self.metrics["buffered"] = len(self.pending)
+        # catch-up distance: how far the live tip has run ahead of the
+        # replay cursor (fdgui's "slots behind" panel)
+        if self.pending:
+            base = self.next_slot if self.next_slot is not None \
+                else min(self.pending)
+            self.metrics["behind"] = max(self.pending) + 1 - base
+        else:
+            self.metrics["behind"] = 0
 
     # -- per-slot replay ----------------------------------------------------
 
@@ -113,9 +245,24 @@ class ReplayCore:
             hashlib.sha256(b"fdtpu-parent" + (slot - 1).to_bytes(
                 8, "little", signed=True)).digest()
         self.bank_hash_of.setdefault(slot - 1, parent_bank)
+        if self._diverge_seed is not None:
+            # diverge_block chaos: fold a rogue account into the
+            # lattice so THIS slot's bank hash is wrong — the verdict
+            # below must trip, never a silent wrong state
+            self.hasher.apply_delta([], [(b"\xfd" * 32, Account(
+                lamports=1 + self._diverge_seed % (1 << 32)))])
+            self._diverge_seed = None
         bank_hash = self.hasher.bank_hash(parent_bank, self._slot_sigs,
                                           tip)
         self.bank_hash_of[slot] = bank_hash
+        exp = self.expected.get(slot)
+        if exp is not None and exp != bank_hash:
+            # DIVERGENCE VERDICT: record the first divergent slot where
+            # the black box will find it, then fail the tile loudly
+            self.metrics["divergent_slot"] = slot
+            raise RuntimeError(
+                f"replay divergence at slot {slot}: replayed bank hash "
+                f"{bank_hash.hex()} != leader {exp.hex()}")
         tip, parent_id = bank_hash, parent_bank
         if self.out_ring is not None:
             import time
@@ -128,6 +275,9 @@ class ReplayCore:
                 pack_block(slot, max(0, slot - 1), tip, parent_id),
                 sig=slot)
         self.metrics["slots_replayed"] += 1
+        if self.snapshot_every and self.snapshot_path \
+                and slot % self.snapshot_every == 0:
+            self.write_snapshot(slot)
         # prune old hashes (tower roots upstream; keep a window)
         if len(self.hash_of) > 1024:
             cut = slot - 512
@@ -135,6 +285,23 @@ class ReplayCore:
                             if s >= cut}
             self.bank_hash_of = {
                 s: h for s, h in self.bank_hash_of.items() if s >= cut}
+
+    def write_snapshot(self, slot: int):
+        """Periodic shm-store snapshot (tmp + fsync + atomic rename —
+        a writer crash mid-checkpoint leaves the previous file
+        intact). The crash_mid_snapshot chaos seam dies between rows,
+        proving exactly that."""
+        from ..utils.checkpt import snapshot_write_atomic
+        hook = None
+        if self._crash_snap:
+            def hook(i):
+                if i >= 1:
+                    __import__("os")._exit(72)
+        snapshot_write_atomic(
+            self.snapshot_path, self.funk, slot=slot,
+            bank_hash=self.bank_hash_of[slot],
+            compress=self.snapshot_compress, _frame_hook=hook)
+        self.metrics["snapshots"] += 1
 
     def _verify_entries(self, prev: bytes, entries) -> bool:
         """Batched device verification of a slice's PoH chain
@@ -162,8 +329,14 @@ class ReplayCore:
     def _execute(self, slot: int, txns: list[bytes]):
         """Stage the slot's txns into the conflict DAG and execute in
         wave order (any wave-internal order preserves the serial
-        fiction; rdisp.waves() is the device-dispatch shape)."""
+        fiction; rdisp.waves() is the device-dispatch shape). With a
+        fanout the transfers ship to the exec shards instead — the
+        conflict-group partition subsumes the DAG's ordering (linked
+        transfers stay on one shard, in order)."""
         if not txns:
+            return
+        if self.fanout is not None:
+            self._execute_fanout(slot, txns)
             return
         from ..svm.alut import AlutResolveError, resolve_loaded_keys
         dag = ConflictDag()
@@ -215,3 +388,110 @@ class ReplayCore:
         # BankHasher.apply_txn_delta — one batched device lthash/side)
         self.hasher.apply_txn_delta(self.funk, xid)
         self.funk.txn_publish(xid)
+
+    # -- exec fan-out (r17 follower path) -----------------------------------
+
+    def _extract_transfers(self, txns: list[bytes]):
+        """Raw signed payloads -> (SystemTxn transfers in txn order,
+        total signature count). The SAME system-program Transfer
+        decode the bank's fan-out uses (discriminant 2 + u64 lamports,
+        fee on each txn's first match only), so leader and follower
+        execute identical work for identical blocks."""
+        from ..pack.cost import SYSTEM_PROGRAM_ID
+        from ..pack.scheduler import FEE_PER_SIGNATURE
+        from ..svm.executor import SystemTxn
+        transfers, sig_cnt = [], 0
+        for t in txns:
+            try:
+                p = parse_txn(t)
+            except Exception:
+                self.metrics["parse_fail"] += 1
+                continue
+            sig_cnt += p.sig_cnt
+            keys = p.account_keys(t)
+            matched = 0
+            for ins in p.instrs:
+                data = t[ins.data_off:ins.data_off + ins.data_sz]
+                if (keys[ins.prog_idx] == SYSTEM_PROGRAM_ID
+                        and len(data) == 12
+                        and data[:4] == b"\x02\x00\x00\x00"
+                        and len(ins.acct_idxs) >= 2):
+                    amt = int.from_bytes(data[4:12], "little")
+                    transfers.append(SystemTxn(
+                        src=keys[ins.acct_idxs[0]],
+                        dst=keys[ins.acct_idxs[1]], amount=amt,
+                        fee=0 if matched
+                        else FEE_PER_SIGNATURE * p.sig_cnt))
+                    matched += 1
+            if not matched:
+                self.metrics["exec_skip"] += 1
+        return transfers, sig_cnt
+
+    def _execute_fanout(self, slot: int, txns: list[bytes]):
+        """Dispatch the slot's transfers as ONE fan-out wave and spin
+        it to completion (the fanout owns timeout cancel + whole-wave
+        redispatch, so an exec-shard crash costs a retry, never a
+        partial commit). The spin keeps heartbeating and aborts on
+        halt — a dying follower must not wedge on a dead shard."""
+        import time
+        transfers, self._slot_sigs = self._extract_transfers(txns)
+        if not transfers:
+            return
+        self.metrics["waves"] += 1
+        self.fanout.dispatch(transfers)
+        from ..runtime import CNC_RUN
+        while self.fanout.busy:
+            self.fanout.poll()
+            if self.cnc is not None:
+                self.cnc.heartbeat()
+                if self.cnc.state != CNC_RUN:
+                    self.fanout.halt()
+                    return
+            time.sleep(20e-6)
+
+    def _fanout_commit(self, tag, xid, ok, fail):
+        """Fan-out wave complete: fold the fork's account delta into
+        the bank-hash lattice BEFORE publishing it (the delta scan
+        reads parent-visible old values, so order matters), then
+        count."""
+        if xid is not None:
+            self.hasher.apply_txn_delta(self.funk, xid)
+            self.funk.txn_publish(xid)
+        self.metrics["txns"] += ok + fail
+        self.metrics["exec_ok"] += ok
+        self.metrics["exec_fail"] += fail
+
+
+class InlineFanout:
+    """Synchronous stand-in for disco/tiles.ExecFanout: the SAME
+    WaveExecutor transfer semantics against a funk fork, zero rings.
+    This is the leader-side ORACLE for the catch-up drills (bench.py's
+    catchup stage and tests/test_follower.py): a ReplayCore driven by
+    it executes transfers through the identical stage/dispatch/finalize
+    engine the exec shards run, which is what makes its per-slot bank
+    hashes a valid `expected` pin for a real fan-out follower."""
+
+    def __init__(self, funk):
+        from ..svm.executor import WaveExecutor
+        self.funk, self._wx = funk, WaveExecutor()
+        self.on_commit = None
+        self.busy = False
+        self._next_xid = 1
+
+    def dispatch(self, txns, tag=None):
+        from ..svm.executor import STATUS_OK
+        xid, ok, fail = None, 0, 0
+        if txns:
+            xid = self._next_xid
+            self._next_xid += 1
+            st = self._wx.finalize(self.funk, self._wx.dispatch(
+                self.funk, None, xid, self._wx.stage(txns)))
+            ok = sum(1 for s in st if s == STATUS_OK)
+            fail = len(st) - ok
+        self.on_commit(tag, xid, ok, fail)
+
+    def poll(self, allow_redispatch=True):
+        return 0
+
+    def halt(self):
+        pass

@@ -19,6 +19,18 @@ riding frag links. Here:
 Decompression and integrity checks happen INSIDE the checkpoint frame
 reader, so a corrupt stream fails loudly (tile FAIL) rather than
 installing bad state.
+
+r17 (follower mode): snapin restores INTO the topology's funk store —
+with [funk] backend="shm" the restored records land heap-direct in the
+shared Store, so the replay/exec tile family sees the cold-start state
+without re-serialization. Restore is install-after-verify
+(utils/checkpt.snapshot_restore_into): the whole stream drains and
+validates (integrity trailer, row framing, record count, min_slot
+staleness gate) BEFORE the first record installs, so a truncated or
+corrupt stream can never leave partial state behind. The loader grew
+the chaos seams for the r17 drills (corrupt_checkpt_frame,
+stale_snapshot_offer, crash_mid_snapshot) and a total_bytes gauge so
+fdgui can show restore progress.
 """
 from __future__ import annotations
 
@@ -28,13 +40,65 @@ import io
 CTL_SOM = 1
 CTL_EOM = 2
 
+# [snapshot] config section (the load/build/lint triple: this
+# validator, the lint/registry.py mirror, lint/graph.py bad-snapshot)
+SNAPSHOT_DEFAULTS = {
+    "path": "",          # snapshot file (loader source / writer target)
+    "every_slots": 0,    # replay tile writes a snapshot every N slots
+    "min_slot": 0,       # snapin refuses snapshots older than this
+    "compress": True,    # zlib-compress writer frames
+    "chunk": 1024,       # snapld frag chunk bytes
+}
+
+
+def _suggest(key, candidates):
+    from ..lint.registry import suggest
+    return suggest(str(key), candidates)
+
+
+def normalize_snapshot(spec) -> dict:
+    """Validate + default-fill a [snapshot] table. Same
+    fail-before-launch stance as [funk]: raises ValueError with a
+    did-you-mean."""
+    out = dict(SNAPSHOT_DEFAULTS)
+    if spec is None:
+        return out
+    if not isinstance(spec, dict):
+        raise ValueError(f"snapshot spec must be a table, got {spec!r}")
+    unknown = set(spec) - set(SNAPSHOT_DEFAULTS)
+    if unknown:
+        key = sorted(unknown)[0]
+        raise ValueError(f"unknown snapshot key(s) {sorted(unknown)}"
+                         + _suggest(key, SNAPSHOT_DEFAULTS))
+    out.update(spec)
+    if not isinstance(out["path"], str):
+        raise ValueError(
+            f"snapshot.path must be a string, got {out['path']!r}")
+    for key in ("every_slots", "min_slot"):
+        out[key] = int(out[key])
+        if out[key] < 0:
+            raise ValueError(
+                f"snapshot.{key} must be >= 0, got {out[key]}")
+    out["compress"] = bool(out["compress"])
+    out["chunk"] = int(out["chunk"])
+    if out["chunk"] < 64:
+        raise ValueError(
+            f"snapshot.chunk must be >= 64, got {out['chunk']}")
+    return out
+
 
 def state_fingerprint(funk) -> int:
     """u64 fingerprint of the published root: sha256 over the
-    DETERMINISTIC uncompressed checkpoint serialization."""
-    from ..utils.checkpt import funk_checkpt
+    DETERMINISTIC uncompressed checkpoint serialization. The restore
+    marker (local runtime state, utils/checkpt.RESTORE_MARKER_KEY) is
+    excluded so a restored store fingerprints identically to its
+    source."""
+    from ..utils.checkpt import RESTORE_MARKER_KEY, funk_checkpt
+    items = {k: v for k, v in funk.root_items().items()
+             if k != RESTORE_MARKER_KEY}
+    shim = type("_Root", (), {"root_items": lambda self: items})()
     buf = io.BytesIO()
-    funk_checkpt(funk, buf, compress=False)
+    funk_checkpt(shim, buf, compress=False)
     return int.from_bytes(
         hashlib.sha256(buf.getvalue()).digest()[:8], "little")
 
@@ -70,8 +134,27 @@ class SnapLoader:
         self.chunk = min(chunk, out_ring.mtu)
         self.off = 0
         self._pending: bytes | None = None
+        # r17 chaos seams (armed by the adapter's on_chaos):
+        self._corrupt_seed: int | None = None   # flip a byte in the
+        self._crash_at: int | None = None       # next chunk / exit at off
         self.metrics = {"bytes": 0, "frags": 0, "done": 0,
-                        "backpressure": 0}
+                        "backpressure": 0, "total_bytes": self.size,
+                        "corrupted": 0, "offers": 1}
+
+    def offer(self, path: str):
+        """Re-stream another snapshot file as a fresh SOM..EOM message
+        (a second offer on the same link — the stale_snapshot_offer
+        drill uses this to re-serve an old file; snapin's min_slot gate
+        must refuse it loudly)."""
+        if not self.fp.closed:
+            self.fp.close()
+        self.fp = open(path, "rb")
+        self.size = __import__("os").fstat(self.fp.fileno()).st_size
+        self.off = 0
+        self._pending = None
+        self.metrics["done"] = 0
+        self.metrics["total_bytes"] = self.size
+        self.metrics["offers"] += 1
 
     def poll_once(self) -> int:
         if self.size == 0:
@@ -104,6 +187,22 @@ class SnapLoader:
                             f"{self.size} bytes")
                     break
                 self._pending = data
+            if self._crash_at is not None and self.off >= self._crash_at:
+                # crash_mid_snapshot: die with the stream half-sent —
+                # snapin must never install the partial message, and
+                # the supervisor sees an abnormal death (EV_CHAOS was
+                # already recorded, so the black box names the drill)
+                __import__("os")._exit(71)
+            if self._corrupt_seed is not None:
+                # corrupt_checkpt_frame: flip ONE seeded byte in the
+                # next chunk — the checkpt reader's integrity trailer
+                # (or frame framing) must refuse the whole stream
+                data = bytearray(self._pending)
+                if data:
+                    data[self._corrupt_seed % len(data)] ^= 0x40
+                    self._pending = bytes(data)
+                    self.metrics["corrupted"] += 1
+                self._corrupt_seed = None
             if self.fseqs and self.out.credits(self.fseqs) <= 0:
                 # yield to the stem: heartbeat/halt stay responsive
                 self.metrics["backpressure"] += 1
@@ -125,18 +224,28 @@ class SnapLoader:
 
 
 class SnapInserter:
-    """snapin core: multi-frag reassembly -> funk restore."""
+    """snapin core: multi-frag reassembly -> funk restore.
 
-    def __init__(self, in_ring, funk_cls=None):
+    `funk` (r17): a pre-joined funk to restore INTO (the topology's
+    shm store facade) so the exec/replay family sees the cold-start
+    state; without it each message restores into a fresh private
+    `funk_cls()`. Either way the restore is install-after-verify
+    (utils/checkpt.snapshot_restore_into) and a snapshot older than
+    `min_slot` is REFUSED loudly (stale_snapshot_offer drill)."""
+
+    def __init__(self, in_ring, funk_cls=None, funk=None, min_slot=0):
         from ..funk.funk import Funk
         self.ring = in_ring
         self.funk_cls = funk_cls or Funk
-        self.funk = None
+        self.funk = funk
+        self._target = funk
+        self.min_slot = int(min_slot)
         self.seq = 0
         self._buf = bytearray()
         self._in_msg = False
         self.metrics = {"frags": 0, "bytes": 0, "accounts": 0,
-                        "restored": 0, "fingerprint": 0, "stream_err": 0}
+                        "restored": 0, "fingerprint": 0,
+                        "stream_err": 0, "slot": 0}
 
     def poll_once(self) -> int:
         got = 0
@@ -168,13 +277,29 @@ class SnapInserter:
                 self._in_msg = False
 
     def _restore(self):
-        from ..utils.checkpt import funk_restore
-        self.funk = funk_restore(self.funk_cls,
-                                 io.BytesIO(bytes(self._buf)))
+        from ..utils.checkpt import snapshot_restore_into
+        target = self._target if self._target is not None \
+            else self.funk_cls()
+        min_slot = self.min_slot or None
+        slot, _bank_hash, _cnt = snapshot_restore_into(
+            target, io.BytesIO(bytes(self._buf)), min_slot=min_slot)
+        # install succeeded: only now does the restored funk become
+        # visible (a raise above leaves self.funk and the store as
+        # they were — no partial state, the install-after-verify
+        # contract)
+        self.funk = target
         self._buf.clear()
-        self.metrics["accounts"] = len(self.funk.root_items())
+        self.metrics["accounts"] = _cnt
         self.metrics["fingerprint"] = state_fingerprint(self.funk)
+        self.metrics["slot"] = slot
         self.metrics["restored"] += 1
+        if self._target is not None:
+            # shared-store restore: install the marker the replay
+            # tile's cold-start gate polls for (AFTER the fingerprint,
+            # so the fingerprint metric reflects the snapshot alone)
+            from ..utils.checkpt import RESTORE_MARKER_KEY
+            self.funk.rec_write(None, RESTORE_MARKER_KEY,
+                                (slot, _bank_hash))
 
 
 class SnapDecompress:

@@ -75,6 +75,10 @@ CHAOS_ACTION_IDS = {
     # black-box dump names the attack even when the tile died mid-flood
     "flood_forged": 6, "flood_torsion": 7, "flood_dup": 8,
     "flood_malformed_quic": 9, "flood_crds_spam": 10,
+    # snapshot/replay robustness drills (r17): the catch-up surface's
+    # seeded faults — adapter-routed, recorded before the fault fires
+    "crash_mid_snapshot": 11, "corrupt_checkpt_frame": 12,
+    "stale_snapshot_offer": 13, "diverge_block": 14,
 }
 CHAOS_ACTION_NAMES = {v: k for k, v in CHAOS_ACTION_IDS.items()}
 

@@ -67,6 +67,28 @@ event takes the shared triggers plus `frames` (how many to inject):
                         from many throwaway (unstaked) origins — the
                         Sybil flood the bounded peer table must absorb
 
+Snapshot/replay fault plans (r17): the catch-up surface's seeded
+faults, routed (like traffic plans) through the stem to the owning
+tile adapter's `on_chaos` hook after the EV_CHAOS record lands:
+
+  crash_mid_snapshot    snapld: exit the process once half the stream
+                        has been published (a loader dying mid-offer);
+                        replay: the NEXT periodic snapshot write
+                        crashes between record rows — the atomic-
+                        rename discipline must leave the previous
+                        snapshot file intact and the half-written
+                        .tmp refused
+  corrupt_checkpt_frame snapld: flip one seeded byte in the next
+                        streamed chunk — snapin's integrity trailer
+                        must refuse the restore loudly (CNC_FAIL),
+                        never install partial state
+  stale_snapshot_offer  snapld: restart the stream from the plan's
+                        stale_path (an old snapshot re-offered) —
+                        snapin's min_slot gate must refuse it
+  diverge_block         replay: perturb the NEXT slot's state delta —
+                        the divergence verdict must flip CNC_FAIL
+                        naming that slot, never a silent wrong state
+
 Every injection is recorded as an EV_CHAOS trace event BEFORE the
 frames flow (trace/events.CHAOS_ACTION_IDS stays in lockstep with
 ACTIONS — tests/test_trace.py), so a post-mortem names the attack even
@@ -80,7 +102,11 @@ import random
 STEM_ACTIONS = ("crash", "freeze_hb", "wedge", "stall_fseq")
 TRAFFIC_ACTIONS = ("flood_forged", "flood_torsion", "flood_dup",
                    "flood_malformed_quic", "flood_crds_spam")
-ACTIONS = STEM_ACTIONS + ("fail_dispatch",) + TRAFFIC_ACTIONS
+# snapshot/replay robustness drills (r17): adapter-routed, like traffic
+SNAPSHOT_ACTIONS = ("crash_mid_snapshot", "corrupt_checkpt_frame",
+                    "stale_snapshot_offer", "diverge_block")
+ACTIONS = STEM_ACTIONS + ("fail_dispatch",) + TRAFFIC_ACTIONS \
+    + SNAPSHOT_ACTIONS
 
 
 class ChaosPlan:
@@ -113,6 +139,12 @@ class ChaosPlan:
                 # seed derived from the plan seed (same plan -> same
                 # attack bytes; the generators below are deterministic)
                 parsed["frames"] = int(ev.get("frames", 256))
+                parsed["seed"] = int(ev.get("seed",
+                                            rng.randint(0, 1 << 30)))
+            elif act in SNAPSHOT_ACTIONS:
+                # snapshot/replay drills carry a seed too (the corrupt
+                # byte position, the divergence perturbation) so the
+                # same plan reproduces the same fault bit-for-bit
                 parsed["seed"] = int(ev.get("seed",
                                             rng.randint(0, 1 << 30)))
             for key in ("at_iter", "at_rx"):

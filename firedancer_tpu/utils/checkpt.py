@@ -13,6 +13,18 @@ restore/fd_snapin_tile.c). This module re-expresses both seams:
     by a sha256 trailer over every raw byte, verified on restore.
   * funk_checkpt / funk_restore: the published root of a Funk instance
     (records sorted by key for determinism) -> frames -> an equal Funk.
+  * snapshot_checkpt / snapshot_restore_into (r17): the v2 snapshot
+    layout — one meta row (slot, bank hash, record count) then record
+    rows carrying the shm store's OWN tag-framed value bytes
+    (funk/shmfunk.py encode_value), so a ShmFunk's record map + heap
+    serialize directly (no decode/re-encode) and either backend
+    restores from either stream. Restore is INSTALL-AFTER-VERIFY:
+    every row is read, decoded, and the sha256 trailer checked before
+    the first write lands in the target — a truncated, corrupt, or
+    stale stream refuses loudly with the target untouched.
+  * snapshot_write_atomic: tmp + fsync + os.replace, so a writer crash
+    mid-checkpoint leaves the previous snapshot file intact and the
+    half-written .tmp fails verification rather than restoring.
 
 Account record values serialize tagged: ints (legacy lamports) and
 accdb Accounts both round-trip exactly.
@@ -20,13 +32,25 @@ accdb Accounts both round-trip exactly.
 from __future__ import annotations
 
 import hashlib
+import os
 import struct
 import zlib
 
 MAGIC = b"FDTPUCK1"
+# v2 snapshot meta-row prefix (first frame of a snapshot_checkpt
+# stream; a legacy funk_checkpt stream's first frame is the bare u64
+# record count, so the two formats are self-distinguishing)
+SNAP_META = b"FDTPUSN2"
 STYLE_RAW = 0
 STYLE_ZLIB = 1
 FRAME_MAX = 1 << 30
+# root marker snapin installs AFTER a successful shared-store restore
+# (value = (slot, bank_hash)); the replay tile's cold-start gate polls
+# it to learn the snapshot boundary. NUL-prefixed so it can never
+# collide with an account pubkey, and NUL-padded to exactly 32 bytes —
+# the native store ABI reads fixed 32-byte keys, so a short key would
+# hash trailing garbage that differs per process.
+RESTORE_MARKER_KEY = b"\x00fdtpu/restored".ljust(32, b"\x00")
 
 
 class CheckptError(ValueError):
@@ -185,3 +209,139 @@ def funk_restore(funk_cls, fp):
     if got != cnt:
         raise CheckptError(f"record count mismatch: {got} != {cnt}")
     return funk
+
+
+# ---------------------------------------------------------------------------
+# v2 snapshot rows (r17): meta + the shm store's own value framing
+# ---------------------------------------------------------------------------
+
+def _raw_root_items(funk) -> list[tuple[bytes, bytes]]:
+    """Published-root records as (key, tag-framed value bytes), sorted
+    by key for determinism. A shm-backed funk (has `.raw`) serves its
+    record map + heap bytes DIRECTLY; a process funk encodes through
+    the same tag framing (funk/shmfunk.py encode_value), so the wire
+    form is backend-independent."""
+    raw = getattr(funk, "raw", None)
+    if raw is not None:
+        items = [(bytes(k), bytes(v)) for k, v in raw.iter_layer(0)
+                 if v is not None]
+    else:
+        from ..funk.shmfunk import encode_value
+        items = [(bytes(k), encode_value(v))
+                 for k, v in funk.root_items().items()]
+    # the restore marker is LOCAL runtime state (snapin's handoff to
+    # replay), never chain state: a snapshot carrying it would falsely
+    # signal a restore boundary on whoever restores it
+    items = [(k, v) for k, v in items if k != RESTORE_MARKER_KEY]
+    items.sort()
+    return items
+
+
+def snapshot_checkpt(funk, fp, slot: int = 0,
+                     bank_hash: bytes = bytes(32), compress: bool = True):
+    """v2 snapshot stream: meta row (SNAP_META | u64 slot | u64 count |
+    32B bank hash) then one record row per published-root record. The
+    meta row is what lets a restorer refuse a STALE offer (slot gate)
+    and verify the restored state's bank hash before joining."""
+    if len(bank_hash) != 32:
+        raise CheckptError("bank_hash must be 32 bytes")
+    w = CheckptWriter(fp, compress)
+    items = _raw_root_items(funk)
+    w.frame(SNAP_META + struct.pack("<QQ", int(slot), len(items))
+            + bytes(bank_hash))
+    for k, ev in items:
+        w.frame(struct.pack("<II", len(k), len(ev)) + k + ev)
+    w.fini()
+
+
+def snapshot_restore_into(funk, fp, min_slot: int | None = None):
+    """Restore a snapshot stream INTO an existing funk's published
+    root — install-after-verify: the WHOLE stream (every row decoded,
+    sha256 trailer checked, record count matched, slot gate passed)
+    verifies before the first write lands, so a truncated/corrupt/
+    stale stream leaves the target untouched. Accepts both the v2
+    snapshot layout and a legacy funk_checkpt stream (meta-less,
+    slot 0). -> (slot, bank_hash, record count)."""
+    from ..funk.shmfunk import decode_value
+    r = CheckptReader(fp)
+    it = r.frames()
+    try:
+        hdr = next(it)
+    except StopIteration:
+        raise CheckptError("empty checkpoint") from None
+    if hdr.startswith(SNAP_META):
+        if len(hdr) != len(SNAP_META) + 16 + 32:
+            raise CheckptError("bad snapshot meta row")
+        slot, cnt = struct.unpack_from("<QQ", hdr, len(SNAP_META))
+        bank_hash = bytes(hdr[len(SNAP_META) + 16:])
+        legacy = False
+    elif len(hdr) == 8:
+        (cnt,) = struct.unpack("<Q", hdr)
+        slot, bank_hash, legacy = 0, bytes(32), True
+    else:
+        raise CheckptError("bad snapshot meta row")
+    if min_slot is not None and slot < int(min_slot):
+        raise CheckptError(
+            f"stale snapshot: slot {slot} < required {int(min_slot)}")
+    # stage 1: drain EVERY frame (the reader verifies the integrity
+    # trailer at the terminal frame) and decode every row — any failure
+    # here refuses the snapshot with zero writes issued
+    rows: list[tuple[bytes, bytes | None, object]] = []
+    for data in it:
+        if len(data) < 8:
+            raise CheckptError("snapshot row too short")
+        klen, vlen = struct.unpack_from("<II", data, 0)
+        if 8 + klen + vlen != len(data):
+            raise CheckptError("snapshot row size mismatch")
+        k = bytes(data[8:8 + klen])
+        ev = bytes(data[8 + klen:8 + klen + vlen])
+        try:
+            v = _dec_val(ev) if legacy else decode_value(ev)
+        except CheckptError:
+            raise
+        except Exception as e:
+            raise CheckptError(f"corrupt snapshot row: {e!r}") from None
+        rows.append((k, None if legacy else ev, v))
+    if len(rows) != cnt:
+        raise CheckptError(
+            f"record count mismatch: {len(rows)} != {cnt}")
+    # stage 2: install. A shm-backed target takes the verified raw
+    # bytes heap-direct; a process funk takes the decoded values.
+    raw = getattr(funk, "raw", None)
+    for k, ev, v in rows:
+        if raw is not None and ev is not None:
+            rc = raw.put(0, k, ev)
+            if rc != 0:
+                raise MemoryError(
+                    f"shm funk store full (rc {rc}): raise "
+                    f"[funk] rec_max/heap_mb")
+        else:
+            funk.rec_write(None, k, v)
+    return int(slot), bank_hash, int(cnt)
+
+
+def snapshot_write_atomic(path: str, funk, slot: int = 0,
+                          bank_hash: bytes = bytes(32),
+                          compress: bool = True, _frame_hook=None):
+    """Crash-safe snapshot writer: stream to `<path>.tmp`, fsync, then
+    os.replace into place — a writer crash mid-checkpoint leaves the
+    previous snapshot intact, and the half-written .tmp fails
+    magic/trailer verification if anything ever offers it. _frame_hook
+    (called with the row index before each record row) is the chaos
+    seam: the crash_mid_snapshot drill exits the process from inside
+    it."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        w = CheckptWriter(f, compress)
+        items = _raw_root_items(funk)
+        w.frame(SNAP_META + struct.pack("<QQ", int(slot), len(items))
+                + bytes(bank_hash))
+        for i, (k, ev) in enumerate(items):
+            if _frame_hook is not None:
+                _frame_hook(i)
+            w.frame(struct.pack("<II", len(k), len(ev)) + k + ev)
+        w.fini()
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path

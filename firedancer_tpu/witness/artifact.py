@@ -73,6 +73,7 @@ _MERGE_RULES = {
     "leader_knee": ((), ("e2e_leader",)),
     "exec_scale": ((), ("exec_scale",)),
     "flood_soak": (("rlc_prefilter_vps",), ("flood_",)),
+    "catchup": (("replay_tps",), ("catchup_",)),
 }
 
 

@@ -29,6 +29,10 @@ Stage catalog (plan order — the hash chain follows it):
                    proving the knee moved off the bank (r16)
     flood_soak     bench.py flood stage: front-door survival goodput +
                    `rlc_prefilter_vps` at chip rate (r14)
+    catchup        bench.py catchup stage: follower cold-start from a
+                   ShmFunk snapshot racing live tail ingest — replay
+                   over the exec family against the oracle's pinned
+                   bank hashes, measured as replay_tps/catchup_s (r17)
     multichip      witness/multichip.py: the shard_map layout shootout
                    — per-chip rr tiles vs one mesh tile, measured side
                    by side with per-device memory/occupancy series
@@ -46,7 +50,8 @@ import sys
 
 # ordered: the sweep runs (and the hash chain links) in this order
 STAGES = ("device_probe", "kernel_vps", "mxu_fmul", "e2e_feed",
-          "leader_knee", "exec_scale", "flood_soak", "multichip")
+          "leader_knee", "exec_scale", "flood_soak", "catchup",
+          "multichip")
 
 # [witness] section keys (lint/registry.py WITNESS_SECTION_KEYS is the
 # static mirror — tests/test_witness.py keeps it honest)
@@ -198,6 +203,10 @@ _CPU_SMOKE_STAGE_ENV = {
                    "FDTPU_BENCH_FLOOD_PROBE_PPS": "40",
                    "FDTPU_BENCH_FLOOD_SYBILS": "8",
                    "FDTPU_BENCH_FLOOD_MULT": "3"},
+    "catchup": {"FDTPU_BENCH_CATCHUP_COUNT": "96",
+                "FDTPU_BENCH_CATCHUP_SLOTS": "8",
+                "FDTPU_BENCH_CATCHUP_SNAP_SLOT": "3",
+                "FDTPU_BENCH_CATCHUP_EXEC_TILES": "2"},
 }
 
 
@@ -220,6 +229,7 @@ def default_stage_cmds(repo_root: str,
         "leader_knee": [py, bench],
         "exec_scale": [py, bench],
         "flood_soak": [py, bench],
+        "catchup": [py, bench],
         "multichip": multi,
     }
 
@@ -231,6 +241,7 @@ _STAGE_CHILD_ENV = {
     "leader_knee": {"FDTPU_BENCH_LEADER_CHILD": "1"},
     "exec_scale": {"FDTPU_BENCH_EXEC_SCALE_CHILD": "1"},
     "flood_soak": {"FDTPU_BENCH_FLOOD_CHILD": "1"},
+    "catchup": {"FDTPU_BENCH_CATCHUP_CHILD": "1"},
 }
 
 

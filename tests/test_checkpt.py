@@ -115,3 +115,206 @@ def test_zlib_trailing_garbage_rejected():
     frame = struct.pack("<BQQ", STYLE_ZLIB, 5, len(body)) + body
     with pytest.raises(CheckptError):
         list(CheckptReader(io.BytesIO(MAGIC + frame)).frames())
+
+
+# -- v2 snapshots over both funk backends (r17) --------------------------
+#
+# The follower cold-start path snapshots a leader-side store and
+# restores it into whichever backend the topology carved — so every
+# drill below runs against the process funk AND the shm store facade
+# (plus the cross-backend restore the catch-up bench actually does).
+
+from firedancer_tpu.tiles.snapshot import state_fingerprint
+from firedancer_tpu.utils.checkpt import (
+    RESTORE_MARKER_KEY, snapshot_checkpt, snapshot_restore_into,
+    snapshot_write_atomic,
+)
+
+BACKENDS = ["process", "shm"]
+
+
+def _mk_funk(backend):
+    if backend == "process":
+        return Funk()
+    from firedancer_tpu.funk.shmfunk import ShmFunk
+    return ShmFunk(rec_max=1024, txn_max=16, heap_sz=1 << 20)
+
+
+def _fini_funk(funk):
+    close = getattr(funk, "close", None)
+    if close is not None:
+        close(unlink=True)
+
+
+def _populate(funk, n=20, seed=11):
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        k = rng.bytes(32)
+        if i % 2:
+            funk.rec_write(None, k, int(rng.integers(0, 1 << 60)))
+        else:
+            funk.rec_write(None, k, Account(
+                lamports=int(rng.integers(1, 1 << 50)),
+                data=rng.bytes(int(rng.integers(0, 64))),
+                owner=rng.bytes(32), rent_epoch=i))
+
+
+def _snap_bytes(funk, slot=7, bank_hash=None, compress=True):
+    bank_hash = bank_hash or bytes(range(32))
+    buf = io.BytesIO()
+    snapshot_checkpt(funk, buf, slot=slot, bank_hash=bank_hash,
+                     compress=compress)
+    return buf.getvalue(), bank_hash
+
+
+@pytest.mark.parametrize("src", BACKENDS)
+@pytest.mark.parametrize("dst", BACKENDS)
+def test_snapshot_roundtrip_across_backends(src, dst):
+    """slot + bank hash + every record survive src->dst restore, and
+    the restored store fingerprints identically to the source (the
+    snapin handoff invariant)."""
+    a, b = _mk_funk(src), _mk_funk(dst)
+    try:
+        _populate(a)
+        raw, bank_hash = _snap_bytes(a)
+        slot, got_hash, cnt = snapshot_restore_into(b, io.BytesIO(raw))
+        assert (slot, got_hash, cnt) == (7, bank_hash, 20)
+        assert b.root_items() == a.root_items()
+        assert state_fingerprint(b) == state_fingerprint(a)
+    finally:
+        _fini_funk(a)
+        _fini_funk(b)
+
+
+@pytest.mark.parametrize("dst", BACKENDS)
+def test_snapshot_truncation_installs_nothing(dst):
+    """Mid-stream truncation at EVERY prefix length must refuse the
+    snapshot with the target left untouched — never partial state."""
+    a, b = _mk_funk("process"), _mk_funk(dst)
+    try:
+        _populate(a, n=6)
+        raw, _ = _snap_bytes(a)
+        sentinel = b"\x05" * 32
+        b.rec_write(None, sentinel, 123)
+        for cut in range(0, len(raw) - 1, 97):
+            with pytest.raises(CheckptError):
+                snapshot_restore_into(b, io.BytesIO(raw[:cut]))
+            assert b.root_items() == {sentinel: 123}
+    finally:
+        _fini_funk(a)
+        _fini_funk(b)
+
+
+@pytest.mark.parametrize("dst", BACKENDS)
+def test_snapshot_corrupt_frame_installs_nothing(dst):
+    a, b = _mk_funk("process"), _mk_funk(dst)
+    try:
+        _populate(a, n=6)
+        raw, _ = _snap_bytes(a)
+        bad = bytearray(raw)
+        bad[len(bad) * 2 // 3] ^= 0x40
+        with pytest.raises(CheckptError):
+            snapshot_restore_into(b, io.BytesIO(bytes(bad)))
+        assert b.root_items() == {}
+    finally:
+        _fini_funk(a)
+        _fini_funk(b)
+
+
+@pytest.mark.parametrize("dst", BACKENDS)
+def test_snapshot_stale_offer_refused(dst):
+    """A snapshot older than the restorer's min_slot is refused loudly
+    (stale_snapshot_offer drill) with zero writes."""
+    a, b = _mk_funk("process"), _mk_funk(dst)
+    try:
+        _populate(a, n=4)
+        raw, _ = _snap_bytes(a, slot=7)
+        with pytest.raises(CheckptError, match="stale"):
+            snapshot_restore_into(b, io.BytesIO(raw), min_slot=8)
+        assert b.root_items() == {}
+        # boundary: slot == min_slot is acceptable
+        snapshot_restore_into(b, io.BytesIO(raw), min_slot=7)
+        assert len(b.root_items()) == 4
+    finally:
+        _fini_funk(a)
+        _fini_funk(b)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_snapshot_atomic_crash_keeps_previous_file(backend, tmp_path):
+    """A writer crash mid-stream (the _frame_hook chaos seam) must
+    leave the previous snapshot restorable and the torn .tmp
+    unverifiable."""
+    funk = _mk_funk(backend)
+    path = str(tmp_path / "snap.ckpt")
+    try:
+        _populate(funk, n=4, seed=3)
+        snapshot_write_atomic(path, funk, slot=3,
+                              bank_hash=bytes(range(32)))
+        before = open(path, "rb").read()
+        funk.rec_write(None, b"\x07" * 32, 777)
+
+        def boom(i):
+            if i >= 2:
+                raise RuntimeError("simulated crash mid-snapshot")
+        with pytest.raises(RuntimeError):
+            snapshot_write_atomic(path, funk, slot=4,
+                                  bank_hash=bytes(32), _frame_hook=boom)
+        assert open(path, "rb").read() == before
+        restored = _mk_funk("process")
+        try:
+            slot, _, _ = snapshot_restore_into(
+                restored, io.BytesIO(open(path, "rb").read()))
+            assert slot == 3
+        finally:
+            _fini_funk(restored)
+        import os as _os
+        if _os.path.exists(path + ".tmp"):
+            bad = _mk_funk("process")
+            try:
+                with pytest.raises(CheckptError):
+                    snapshot_restore_into(
+                        bad, io.BytesIO(open(path + ".tmp", "rb").read()))
+            finally:
+                _fini_funk(bad)
+    finally:
+        _fini_funk(funk)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_snapshot_excludes_restore_marker(backend):
+    """The restore marker is local runtime state: a snapshot taken
+    from a restored store must not re-export it (else a second-hop
+    restore would release a follower's gate with a stale boundary)."""
+    funk = _mk_funk(backend)
+    try:
+        _populate(funk, n=3, seed=9)
+        funk.rec_write(None, RESTORE_MARKER_KEY, (3, bytes(32)))
+        raw, _ = _snap_bytes(funk)
+        fresh = _mk_funk("process")
+        try:
+            _, _, cnt = snapshot_restore_into(fresh, io.BytesIO(raw))
+            assert cnt == 3
+            assert RESTORE_MARKER_KEY not in fresh.root_items()
+        finally:
+            _fini_funk(fresh)
+    finally:
+        _fini_funk(funk)
+
+
+def test_legacy_checkpt_restores_as_slot_zero():
+    """app/genesis.py output (a legacy meta-less funk_checkpt) must
+    bootstrap a follower: restore accepts it as slot 0 with a zero
+    bank hash — the cfg/follower-demo.toml cold-start path."""
+    funk = Funk()
+    _populate(funk, n=5, seed=2)
+    buf = io.BytesIO()
+    funk_checkpt(funk, buf)
+    cold = _mk_funk("shm")
+    try:
+        slot, bank_hash, cnt = snapshot_restore_into(
+            cold, io.BytesIO(buf.getvalue()))
+        assert (slot, bank_hash, cnt) == (0, bytes(32), 5)
+        assert cold.root_items() == funk.root_items()
+    finally:
+        _fini_funk(cold)

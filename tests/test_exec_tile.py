@@ -195,11 +195,12 @@ def test_exec_family_byte_identity_vs_in_process(wksp):
     for i, f in enumerate(frames):
         rings["pack_bank0"].publish(f, sig=i)
     bank.poll_once()
-    assert bank._ef is not None and bank._ef["remaining"] >= 1
+    assert bank.fanout.wave is not None \
+        and bank.fanout.wave["remaining"] >= 1
     for e in execs:
         e.poll_once()
     bank.poll_once()
-    assert bank._ef is None
+    assert bank.fanout.wave is None
 
     assert bank.m["transfers"] == bank_a.m["transfers"] > 0
     assert bank.m["exec_fail"] == bank_a.m["exec_fail"]
@@ -240,10 +241,7 @@ def test_exec_cross_tile_conflict_isolation(wksp):
     ]
     # inject directly at the scheduler layer (the wire carries raw
     # payloads; here the partition itself is under test)
-    bank._ef = {"recs": [], "txns": txns, "xid": None,
-                "wave_seq": None, "remaining": 0, "ok": 0, "fail": 0,
-                "deadline": None}
-    bank._ef_send()
+    bank.fanout.dispatch(txns, tag=[])
     per_tile_accts = []
     chain_frames = []
     from firedancer_tpu.disco.tiles import (_EXEC_HDR, _EXEC_TXN,
@@ -272,7 +270,7 @@ def test_exec_cross_tile_conflict_isolation(wksp):
     for e in execs:
         e.poll_once()
     bank.poll_once()
-    assert bank._ef is None
+    assert bank.fanout.wave is None
     oracle = {k: 1_000_000 for k in keys}
     execute_block_serial(oracle, txns)
     for k in keys:
@@ -298,23 +296,25 @@ def test_exec_tile_death_redispatch_drill(wksp):
     for i, f in enumerate(_microblocks(txns, per=6)):
         rings["pack_bank0"].publish(f, sig=i)
     bank.poll_once()
-    assert bank._ef is not None
-    xid1 = bank._ef["xid"]
+    assert bank.fanout.wave is not None
+    xid1 = bank.fanout.wave["xid"]
     # tile 0 'dies': nobody drains exec_disp0. Tile 1 completes its
     # share — the wave must NOT publish on a partial completion set.
     execs[1].poll_once()
     bank.poll_once()
-    assert bank._ef is not None and bank._ef["xid"] == xid1
+    assert bank.fanout.wave is not None \
+        and bank.fanout.wave["xid"] == xid1
     # mid-wave store state is invisible at the root
     root0 = {bytes.fromhex(k): bank.funk.rec_query(
         None, bytes.fromhex(k)) for k in genesis}
     assert root0 == {bytes.fromhex(k): v for k, v in genesis.items()}
     # timeout (forced, no wall-clock flake) -> cancel + redispatch
     # under a fresh fork
-    bank._ef["deadline"] = time.monotonic() - 1
+    bank.fanout.wave["deadline"] = time.monotonic() - 1
     bank.poll_once()
     assert bank.m["exec_redispatch"] == 1
-    assert bank._ef is not None and bank._ef["xid"] != xid1
+    assert bank.fanout.wave is not None \
+        and bank.fanout.wave["xid"] != xid1
     assert not bank.funk.txn_is_prepared(xid1)
     # 'restart': fresh adapters from seq 0 — they see the STALE frames
     # first (cancelled fork -> abandoned, no completion), then the
@@ -332,9 +332,10 @@ def test_exec_tile_death_redispatch_drill(wksp):
         stale += e.m["stale_xid"]
     assert stale >= 1      # cancelled-fork frames replayed, abandoned
     deadline = time.monotonic() + 10
-    while bank._ef is not None and time.monotonic() < deadline:
+    while bank.fanout.wave is not None \
+            and time.monotonic() < deadline:
         bank.poll_once()
-    assert bank._ef is None                # not wedged
+    assert bank.fanout.wave is None        # not wedged
     assert bank.m["exec_redispatch"] == 1
     # exactly-once: balances match ONE serial application
     all_t = []

@@ -1,0 +1,290 @@
+"""Follower mode (r17): cold-start from a shm-store snapshot + catch-up
+replay over the exec tile family.
+
+The drills pinned here are the ISSUE-16 acceptance set, in-process so
+they run in tier-1:
+
+* end-to-end cold start: leader oracle replays N slots (InlineFanout —
+  the same WaveExecutor engine the exec shards run), snapshots at S;
+  the follower restores the snapshot into a WireFunk through the real
+  snapld -> snapin cores, picks up the restore marker, replays the
+  tail over a real ExecFanout + 2 ExecAdapters, and lands on the
+  oracle's per-slot bank hashes and balances.
+* divergence verdict: a diverging block flips the divergent_slot
+  metric and fails the tile loudly, naming the first divergent slot —
+  never a silent wrong state.
+* kill-exec-shard: a shard dead mid-wave forces timeout cancel +
+  whole-wave redispatch under a fresh fork; when the shard rejoins the
+  wave completes — exactly-once application, no wedged producer.
+"""
+import hashlib
+import os
+import struct
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from firedancer_tpu.runtime import Ring, Store, Workspace
+
+pytestmark = pytest.mark.exec
+
+os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+
+N_GENESIS = 8
+
+
+def _genesis(n=N_GENESIS):
+    from firedancer_tpu.tiles.synth import synth_signer_seed
+    from firedancer_tpu.utils.ed25519_ref import keypair
+    return {keypair(synth_signer_seed(i))[-1]: 1 << 44
+            for i in range(n)}
+
+
+def _slot_slices(txns, n_slots):
+    """slot -> one complete slice carrying one entry batch (hand-built
+    tip, PoH verify off — the bank-hash chain is what's under test)."""
+    from firedancer_tpu.tiles.shred import pack_slice
+    per = max(1, len(txns) // n_slots)
+    out = {}
+    for s in range(1, n_slots + 1):
+        chunk = txns[(s - 1) * per:s * per]
+        tip = hashlib.sha256(b"fo-tip-%d" % s).digest()
+        batch = struct.pack("<I", 1) + tip + struct.pack("<I", len(chunk))
+        for t in chunk:
+            batch += struct.pack("<H", len(t)) + t
+        out[s] = pack_slice(s, 0, True, batch)
+    return out
+
+
+def _mk_oracle(genesis):
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.tiles.replay import InlineFanout, ReplayCore
+    funk = Funk()
+    return ReplayCore(genesis=genesis, verify_poh=False, funk=funk,
+                      fanout=InlineFanout(funk))
+
+
+def _mk_follower(wksp, n_exec=2, redispatch_s=5.0, expected=None,
+                 **core_kw):
+    """ReplayCore + real ExecFanout over rings + n_exec ExecAdapters
+    (the test_exec_tile harness shape, replay-side)."""
+    from firedancer_tpu.disco.tiles import ExecAdapter, ExecFanout
+    from firedancer_tpu.funk.shmfunk import WireFunk
+    from firedancer_tpu.tiles.replay import ReplayCore
+    st = Store(wksp, rec_max=4096, txn_max=64, heap_sz=1 << 20)
+    funk_plan = {"backend": "shm", "rec_max": 4096, "txn_max": 64,
+                 "heap_mb": 1, "off": st.off, "heap_sz": 1 << 20}
+    links = {}
+    for i in range(n_exec):
+        links[f"exec_disp{i}"] = {"mtu": 4096}
+        links[f"exec_done{i}"] = {"mtu": 64}
+    rings = {ln: Ring.create(wksp, depth=64, mtu=li["mtu"])
+             for ln, li in links.items()}
+    plan = {"links": links, "funk": funk_plan}
+    funk = WireFunk.from_plan(wksp, funk_plan)
+    disp = [f"exec_disp{i}" for i in range(n_exec)]
+    done = [f"exec_done{i}" for i in range(n_exec)]
+    ctx = SimpleNamespace(
+        tile_name="replay", plan=plan, wksp=wksp,
+        in_rings={ln: rings[ln] for ln in done},
+        out_rings={ln: rings[ln] for ln in disp},
+        out_fseqs={ln: [] for ln in disp}, in_seq0={})
+    fanout = ExecFanout(ctx, funk, disp, done,
+                        m={"exec_waves": 0, "exec_redispatch": 0,
+                           "overruns": 0},
+                        redispatch_s=redispatch_s)
+    core = ReplayCore(funk=funk, fanout=fanout, verify_poh=False,
+                      expected=expected or {}, **core_kw)
+    fanout.m = core.metrics
+    execs = []
+    for i in range(n_exec):
+        ectx = SimpleNamespace(
+            tile_name=f"exec{i}", plan=plan, wksp=wksp,
+            in_rings={f"exec_disp{i}": rings[f"exec_disp{i}"]},
+            out_rings={f"exec_done{i}": rings[f"exec_done{i}"]},
+            out_fseqs={f"exec_done{i}": []}, in_seq0={})
+        execs.append(ExecAdapter(ectx, {"batch": 8}))
+    return core, execs, rings, funk
+
+
+class _ShardThreads:
+    """Poll exec adapters from background threads: ReplayCore's
+    _execute_fanout spins the wave to completion on the caller's
+    thread, so the shards must make progress concurrently (in the real
+    topology they are separate processes)."""
+
+    def __init__(self):
+        self.stop = threading.Event()
+        self.threads = []
+
+    def run(self, adapter, delay_s=0.0):
+        def loop():
+            if delay_s:
+                time.sleep(delay_s)
+            while not self.stop.is_set():
+                adapter.poll_once()
+                time.sleep(1e-4)
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self.threads.append(t)
+
+    def join(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=10)
+
+
+@pytest.fixture()
+def wksp():
+    w = Workspace(f"/fdtpu_fol_{os.getpid()}", 1 << 24)
+    yield w
+    w.close()
+    w.unlink()
+
+
+def test_follower_cold_start_catchup_end_to_end(wksp, tmp_path):
+    """Cold start from a ShmFunk snapshot -> snapld/snapin restore ->
+    marker release -> multi-slot tail replayed over 2 exec shards ->
+    bank hashes match the leader oracle's per-slot hashes, balances
+    match exactly."""
+    from firedancer_tpu.tiles.snapshot import (SnapInserter, SnapLoader,
+                                               state_fingerprint)
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    from firedancer_tpu.utils.checkpt import snapshot_write_atomic
+    n_slots, snap_slot = 6, 2
+    genesis = _genesis()
+    txns = make_signed_txns(24, seed=41)
+    slices = _slot_slices(txns, n_slots)
+
+    oracle = _mk_oracle(genesis)
+    snap_path = str(tmp_path / "snap.ckpt")
+    want_fp = None
+    for s in range(1, n_slots + 1):
+        oracle.on_slice(slices[s])
+        if s == snap_slot:
+            snapshot_write_atomic(snap_path, oracle.funk, slot=s,
+                                  bank_hash=oracle.bank_hash_of[s])
+            want_fp = state_fingerprint(oracle.funk)
+    assert oracle.metrics["txns"] == len(txns)
+
+    expected = {s: oracle.bank_hash_of[s]
+                for s in range(snap_slot + 1, n_slots + 1)}
+    core, execs, rings, funk = _mk_follower(wksp, n_exec=2,
+                                            expected=expected,
+                                            wait_restore=True)
+    # the tail arrives BEFORE the restore finishes (the catch-up race):
+    # everything buffers behind the restore gate
+    for s in range(snap_slot + 1, n_slots + 1):
+        core.on_slice(slices[s])
+    assert core.metrics["slots_replayed"] == 0
+    assert core.metrics["buffered"] == n_slots - snap_slot
+    assert not core.check_restore()
+
+    # restore through the real snapld -> snapin cores over a ring
+    snap_ring = Ring.create(wksp, depth=64, mtu=4096)
+    loader = SnapLoader(snap_path, snap_ring, [], chunk=1024)
+    inserter = SnapInserter(snap_ring, funk=funk, min_slot=snap_slot)
+    for _ in range(10_000):
+        loader.poll_once()
+        inserter.poll_once()
+        if inserter.metrics["restored"]:
+            break
+    assert inserter.metrics["restored"] == 1
+    assert inserter.metrics["slot"] == snap_slot
+    # fingerprint of the restore == the oracle AT the snapshot slot
+    assert inserter.metrics["fingerprint"] == want_fp
+
+    shards = _ShardThreads()
+    for e in execs:
+        shards.run(e)
+    try:
+        # marker arrival seeds the chain and releases the buffered tail
+        assert core.check_restore()
+        assert core.metrics["restore_slot"] == snap_slot
+        assert core.metrics["slots_replayed"] == n_slots - snap_slot
+        assert core.metrics["divergent_slot"] == 0
+        assert core.metrics["buffered"] == 0 and core.metrics["behind"] == 0
+        assert core.metrics["exec_waves"] >= n_slots - snap_slot
+    finally:
+        shards.join()
+    # the expected pins did not raise AND the hashes are the oracle's
+    for s in range(snap_slot + 1, n_slots + 1):
+        assert core.bank_hash_of[s] == oracle.bank_hash_of[s]
+    # exactly-once balances across restore + fan-out replay
+    for pk in genesis:
+        assert funk.rec_query(None, pk) \
+            == oracle.funk.rec_query(None, pk)
+    # both shards carried work
+    assert all(e.m["txns"] > 0 for e in execs)
+
+
+def test_follower_divergence_verdict_names_first_slot(tmp_path):
+    """A diverging block must flip divergent_slot and fail loudly
+    naming the first divergent slot — before any tower publish."""
+    from firedancer_tpu.funk.funk import Funk
+    from firedancer_tpu.tiles.replay import InlineFanout, ReplayCore
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    n_slots = 3
+    genesis = _genesis()
+    txns = make_signed_txns(12, seed=43)
+    slices = _slot_slices(txns, n_slots)
+    oracle = _mk_oracle(genesis)
+    for s in range(1, n_slots + 1):
+        oracle.on_slice(slices[s])
+
+    funk = Funk()
+    follower = ReplayCore(
+        genesis=genesis, verify_poh=False, funk=funk,
+        fanout=InlineFanout(funk),
+        expected={s: oracle.bank_hash_of[s]
+                  for s in range(1, n_slots + 1)})
+    follower.on_slice(slices[1])
+    assert follower.metrics["slots_replayed"] == 1
+    follower._diverge_seed = 7          # the diverge_block chaos seam
+    with pytest.raises(RuntimeError, match="divergence at slot 2"):
+        follower.on_slice(slices[2])
+    assert follower.metrics["divergent_slot"] == 2
+
+
+def test_follower_exec_shard_death_redispatch(wksp):
+    """Shard 0 dead at dispatch time: the wave cannot commit partial,
+    the deadline forces cancel + whole-wave redispatch under a fresh
+    fork, and once the shard rejoins (ring re-read from seq 0, stale
+    frames abandoned) the wave completes — exactly-once balances, no
+    wedge."""
+    from firedancer_tpu.svm.executor import execute_block_serial
+    from firedancer_tpu.tiles.synth import make_signed_txns
+    n_slots = 1
+    genesis = _genesis()
+    txns = make_signed_txns(8, seed=47)
+    slices = _slot_slices(txns, n_slots)
+    oracle = _mk_oracle(genesis)
+    oracle.on_slice(slices[1])
+
+    core, execs, rings, funk = _mk_follower(
+        wksp, n_exec=2, redispatch_s=0.3,
+        expected={1: oracle.bank_hash_of[1]},
+        genesis=genesis)
+    shards = _ShardThreads()
+    shards.run(execs[1])                 # shard 0 is dead...
+    shards.run(execs[0], delay_s=1.0)    # ...until it restarts
+    try:
+        core.on_slice(slices[1])         # spins until the wave commits
+    finally:
+        shards.join()
+    assert core.metrics["slots_replayed"] == 1
+    assert core.metrics["exec_redispatch"] >= 1
+    assert core.metrics["divergent_slot"] == 0
+    assert core.bank_hash_of[1] == oracle.bank_hash_of[1]
+    # exactly-once: despite cancelled attempts, balances match one
+    # serial application (srcs AND the fresh dest accounts)
+    oracle_bal = dict(_genesis().items())
+    transfers, _ = core._extract_transfers(txns)
+    execute_block_serial(oracle_bal, transfers)
+    for pk, want in oracle_bal.items():
+        got = funk.rec_query(None, pk)
+        assert getattr(got, "lamports", got) == want
+    # the restarted shard saw and abandoned the cancelled fork's frames
+    assert execs[0].m["stale_xid"] >= 1

@@ -410,6 +410,51 @@ def test_funk_section_is_clean_when_valid():
     assert lint_config(cfg, "<fixture>") == []
 
 
+def test_bad_replay_schema_and_did_you_mean():
+    # typo'd [replay] key: the tiles/replay.py schema gate
+    findings = lint_config(_cfg(replay={"exec_tile_cn": 2}),
+                           "<fixture>")
+    fires_once(findings, "bad-replay")
+    assert "did you mean 'exec_tile_cnt'" in findings[0].message
+    # out-of-range values
+    fires_once(lint_config(_cfg(replay={"exec_tile_cnt": -1}),
+                           "<fixture>"), "bad-replay")
+    fires_once(lint_config(_cfg(replay={"redispatch_s": 0}),
+                           "<fixture>"), "bad-replay")
+
+
+def test_bad_snapshot_schema_and_did_you_mean():
+    # typo'd [snapshot] key: the tiles/snapshot.py schema gate
+    findings = lint_config(_cfg(snapshot={"every_slot": 8}),
+                           "<fixture>")
+    fires_once(findings, "bad-snapshot")
+    assert "did you mean 'every_slots'" in findings[0].message
+    # out-of-range values
+    fires_once(lint_config(_cfg(snapshot={"min_slot": -1}),
+                           "<fixture>"), "bad-snapshot")
+    fires_once(lint_config(_cfg(snapshot={"chunk": 8}),
+                           "<fixture>"), "bad-snapshot")
+
+
+def test_replay_snapshot_sections_clean_when_valid():
+    cfg = _cfg(replay={"exec_tile_cnt": 2, "redispatch_s": 1.5},
+               snapshot={"path": "/tmp/snap.ckpt", "every_slots": 8,
+                         "min_slot": 4})
+    assert lint_config(cfg, "<fixture>") == []
+
+
+def test_replay_snapshot_registry_mirrors():
+    """The lint registry's section-key tuples mirror the validators'
+    defaults tables — a key added to one side without the other is a
+    review gap."""
+    from firedancer_tpu.lint.registry import (REPLAY_SECTION_KEYS,
+                                              SNAPSHOT_SECTION_KEYS)
+    from firedancer_tpu.tiles.replay import REPLAY_DEFAULTS
+    from firedancer_tpu.tiles.snapshot import SNAPSHOT_DEFAULTS
+    assert set(REPLAY_SECTION_KEYS) == set(REPLAY_DEFAULTS)
+    assert set(SNAPSHOT_SECTION_KEYS) == set(SNAPSHOT_DEFAULTS)
+
+
 def test_per_shard_ins_entry_expands_not_folds():
     """A sharded-tile per-shard ins entry (all-str list: shard k
     consumes entry[k]) must count every listed link as consumed — the

@@ -1,7 +1,13 @@
 """Snapshot restore pipeline test: checkpoint file -> snapld (multi-frag
 stream) -> snapin (reassemble + restore) across OS processes
 (ref: src/discof/restore/ pipeline shape; multi-frag ctl SOM/EOM
-discipline src/tango/fd_tango_base.h)."""
+discipline src/tango/fd_tango_base.h).
+
+r17: the drill runs over BOTH funk backends — without a carved store
+snapin restores into a private process funk; with [funk] backend="shm"
+it restores into the topology's shared store and installs the restore
+marker the replay tile's cold-start gate polls for.
+"""
 import pytest
 
 pytestmark = pytest.mark.slow
@@ -16,7 +22,8 @@ from firedancer_tpu.tiles.snapshot import state_fingerprint
 from firedancer_tpu.utils.checkpt import funk_checkpt
 
 
-def test_snapshot_restore_pipeline(tmp_path):
+@pytest.mark.parametrize("backend", ["process", "shm"])
+def test_snapshot_restore_pipeline(tmp_path, backend):
     os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
     rng = np.random.default_rng(11)
     funk = Funk()
@@ -36,8 +43,12 @@ def test_snapshot_restore_pipeline(tmp_path):
     # the stream must span MANY frags (multi-frag path exercised)
     assert os.path.getsize(path) > 16 * 1024
 
+    topo_kw = {}
+    if backend == "shm":
+        topo_kw["funk"] = {"backend": "shm", "heap_mb": 4,
+                           "rec_max": 1024}
     topo = (
-        Topology(f"sn{os.getpid()}", wksp_size=1 << 23)
+        Topology(f"sn{os.getpid()}", wksp_size=1 << 23, **topo_kw)
         .link("snap", depth=32, mtu=1280)          # depth << frag count
         .tile("snapld", "snapld", outs=["snap"], path=str(path),
               chunk=1024)
@@ -54,6 +65,27 @@ def test_snapshot_restore_pipeline(tmp_path):
         ld = runner.metrics("snapld")
         assert ld["frags"] > 16 and ld["done"] == 1
         assert m["frags"] == ld["frags"]
+        if backend == "shm":
+            # the shared-store restore is visible to a fresh join of
+            # the SAME region — marker installed, fingerprint holds
+            # with the marker excluded (the replay handoff contract)
+            import json
+            from firedancer_tpu.funk.shmfunk import WireFunk
+            from firedancer_tpu.runtime import Workspace
+            from firedancer_tpu.utils.checkpt import RESTORE_MARKER_KEY
+            name = f"/fdtpu_sn{os.getpid()}"
+            plan = json.load(open(f"/dev/shm/fdtpu_sn{os.getpid()}"
+                                  f".plan.json"))
+            w = Workspace(name, os.path.getsize("/dev/shm" + name),
+                          create=False)
+            try:
+                shared = WireFunk.from_plan(w, plan["funk"])
+                slot, bank_hash = shared.rec_query(
+                    None, RESTORE_MARKER_KEY)
+                assert slot == 0 and bank_hash == bytes(32)
+                assert state_fingerprint(shared) == want_fp
+            finally:
+                w.close()
     finally:
         runner.halt()
         runner.close()
