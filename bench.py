@@ -1454,8 +1454,18 @@ def main():
         # the hardcoded filename used to go stale every round.
         if result.get("platform", "").startswith("cpu"):
             from firedancer_tpu.witness import latest_witnessed
+            from firedancer_tpu.witness.provenance import lint_state
             hit = latest_witnessed(HERE, require_platform="tpu")
-            if hit:
+            lint = lint_state(HERE)
+            if hit and not lint.get("clean"):
+                # a witnessed number re-published from a tree that no
+                # longer passes its own static gates would launder the
+                # old measurement as current-state evidence
+                result["witnessed_tpu_refused"] = (
+                    f"tree has {lint.get('errors')} non-baseline lint "
+                    f"error(s) — fix or baseline before re-embedding "
+                    f"the witnessed record")
+            elif hit:
                 _, wit = hit
                 # the embedded fallback stays the compact bare record;
                 # the full fdwitness chain lives in the artifact itself

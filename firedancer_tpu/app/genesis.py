@@ -24,7 +24,7 @@ import argparse
 import hashlib
 import sys
 
-from ..funk.funk import Funk
+from ..funk.funk import Funk, key32
 from ..svm.accdb import Account
 from ..svm.stake import STAKE_PROGRAM_ID, ST_DELEGATED, StakeState
 from ..svm.vote import VOTE_PROGRAM_ID, VoteState
@@ -55,7 +55,7 @@ def build_genesis(n_validators: int = 3, n_user_accounts: int = 16,
         funk.rec_write(None, stake_key, Account(
             lamports=stake, data=st.to_bytes(),
             owner=STAKE_PROGRAM_ID))
-        funk.rec_write(None, identity, Account(
+        funk.rec_write(None, key32(identity), Account(
             lamports=user_lamports))
         validators.append((identity, vote_key, stake_key))
     # user accounts come from THE shared synth-genesis map (the same
@@ -67,7 +67,7 @@ def build_genesis(n_validators: int = 3, n_user_accounts: int = 16,
             f"user-accounts capped at {len(users)} (the deterministic "
             f"synth signer pool wraps); requested {n_user_accounts}")
     for pub in users:
-        funk.rec_write(None, pub, Account(lamports=user_lamports))
+        funk.rec_write(None, key32(pub), Account(lamports=user_lamports))
     return funk, validators
 
 

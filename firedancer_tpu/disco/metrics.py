@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..runtime.tango import u64_snapshot
+
 NBUCKETS = 32
 HIST_U64 = 2 + NBUCKETS          # count, sum_ns, buckets
 HIST_KINDS = ("wait", "work", "tpu")   # order fixes the shm layout
@@ -79,10 +81,16 @@ class HistAccum:
     def seed_from(self, view_u64: np.ndarray):
         """Resume a cumulative series from its shm block (supervised
         restart: flush_into writes wholesale, so a fresh accumulator
-        would rewind the readers' cumulative counters to zero)."""
-        self.count = int(view_u64[0])
-        self.sum_ns = int(view_u64[1])
-        self.buckets = [int(x) for x in view_u64[2:2 + NBUCKETS]]
+        would rewind the readers' cumulative counters to zero). The
+        old tile's final flush can still be landing while the restart
+        seeds, so snapshot the block once instead of field-by-field
+        reads of the live view — count is flushed last, so a count
+        belonging to newer buckets would double-add samples for the
+        rest of the tile's life."""
+        snap = u64_snapshot(view_u64)
+        self.count = int(snap[0])
+        self.sum_ns = int(snap[1])
+        self.buckets = [int(x) for x in snap[2:2 + NBUCKETS]]
 
     def flush_into(self, view_u64: np.ndarray):
         # count is written LAST: a racing reader may see stale buckets

@@ -209,6 +209,7 @@ class Supervisor:
     def _trace_mark(self, tn: str, etype: int):
         tr = self._trace.get(tn)
         if tr is not None:
+            # fdlint: disable=dual-writer — handoff: post-mortem mark in a DEAD tile's ring; the owner was reaped, ownership passed to the supervisor until restart
             tr.event(etype)
 
     def _dump_blackbox(self, tn: str, reason: str):

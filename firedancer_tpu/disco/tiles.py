@@ -1043,8 +1043,9 @@ class BankAdapter:
             self._next_xid = 1
             # genesis balances: airdropped synth accounts (tests inject
             # via args; production restores from snapshot)
+            from ..funk.funk import key32
             for acct_hex, bal in args.get("genesis", {}).items():
-                self.funk.rec_write(None, bytes.fromhex(acct_hex),
+                self.funk.rec_write(None, key32(bytes.fromhex(acct_hex)),
                                     int(bal))
             # genesis_synth = N: fund the deterministic synth signers
             # (config-file convenience — TOML can't derive pubkeys; the
@@ -1053,14 +1054,14 @@ class BankAdapter:
             if args.get("genesis_synth"):
                 for pub, bal in _synth_genesis(
                         int(args["genesis_synth"])).items():
-                    self.funk.rec_write(None, pub, bal)
+                    self.funk.rec_write(None, key32(pub), bal)
             if self.exec_mode == "general":
                 from ..svm import AccDb, TxnExecutor
                 from ..svm.accdb import Account as _Acct
                 # the general executor needs TYPED genesis accounts
                 for key, val in list(self.funk.root_items().items()):
                     if isinstance(val, int):
-                        self.funk.rec_write(None, key,
+                        self.funk.rec_write(None, key32(key),
                                             _Acct(lamports=val))
                 self.db = AccDb(self.funk)
                 self.executor = TxnExecutor(self.db)

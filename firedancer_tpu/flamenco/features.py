@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import struct
 
+from ..funk.funk import key32
 from ..svm.accdb import Account
 from ..utils.base58 import b58_decode_32
 
@@ -52,7 +53,7 @@ def decode_feature(data: bytes) -> int | None:
 def activate(funk, xid, feature_id: bytes, slot: int):
     """Write the feature account as activated at `slot` (genesis/test
     plumbing; on a live cluster activation lands via governance)."""
-    funk.rec_write(xid, feature_id, Account(
+    funk.rec_write(xid, key32(feature_id), Account(
         1, bytearray(encode_feature(slot)), FEATURE_PROGRAM_ID))
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import struct
 
+from ..funk.funk import key32
 from ..svm.accdb import Account
 from ..svm.stake import STAKE_PROGRAM_ID, StakeState
 from ..svm.vote import VOTE_PROGRAM_ID, VoteState, _HDR_SZ
@@ -163,14 +164,14 @@ def apply_rewards_partition(funk, xid, rewards, parent_blockhash: bytes,
             na = Account(acct.lamports + stake_delta,
                          bytearray(st.to_bytes()), acct.owner,
                          acct.executable, acct.rent_epoch)
-            funk.rec_write(xid, stake_key, na)
+            funk.rec_write(xid, key32(stake_key), na)
             paid += stake_delta
         if vote_delta:
             va = funk.rec_query(xid, vote_key)
             if isinstance(va, Account):
                 nv = Account(va.lamports + vote_delta, va.data,
                              va.owner, va.executable, va.rent_epoch)
-                funk.rec_write(xid, vote_key, nv)
+                funk.rec_write(xid, key32(vote_key), nv)
                 paid += vote_delta
     return paid
 

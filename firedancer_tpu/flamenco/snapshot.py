@@ -32,6 +32,7 @@ import io
 import struct
 import tarfile
 
+from ..funk.funk import key32
 from ..svm.accdb import Account
 
 STORED_META = struct.Struct("<QQ32s")          # write_version, dlen, key
@@ -247,7 +248,7 @@ class SnapshotRestorer:
             # unverified state past the checksum
             if acct.lamports == 0:
                 continue
-            self.funk.rec_write(None, pk, acct)
+            self.funk.rec_write(None, key32(pk), acct)
         self._staging.clear()
         return True
 

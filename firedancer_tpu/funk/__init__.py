@@ -4,7 +4,7 @@ Re-expression of the reference's funk database
 (ref: src/funk/fd_funk.h:4-90 — record table + in-preparation
 transaction tree; src/funk/fd_funk_txn.h — fork management APIs).
 """
-from .funk import Funk, FunkTxnError  # noqa: F401
+from .funk import Funk, FunkTxnError, key32  # noqa: F401
 from .shmfunk import (  # noqa: F401
     FUNK_DEFAULTS, ShmFunk, WireFunk, make_funk, normalize_funk,
 )

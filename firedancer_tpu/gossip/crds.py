@@ -40,6 +40,19 @@ class CrdsValue:
     data: bytes            # bincode variant payload
     signature: bytes = b""
 
+    def __post_init__(self):
+        # fixed-width wire fields: a wrong-length origin/signature
+        # doesn't fail here, it SHIFTS every later byte of the encoded
+        # frame, so the peer decodes garbage under a valid-looking tag
+        if len(self.origin) != 32:
+            raise ValueError(
+                f"CRDS origin must be a 32-byte pubkey, got "
+                f"{len(self.origin)}")
+        if self.signature and len(self.signature) != 64:
+            raise ValueError(
+                f"CRDS signature must be 64 bytes (or empty for "
+                f"unsigned), got {len(self.signature)}")
+
     def key(self) -> tuple:
         return (self.origin, self.kind, self.index)
 

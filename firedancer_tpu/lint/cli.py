@@ -6,6 +6,19 @@ File routing (mirrors the analyzer scopes):
                                       `# fdlint: layers=` honored)
     **/tiles/*.py, **/disco/tiles.py -> tile-contract analysis
     **/ops/*.py,  **/tiles/*.py      -> JAX/Pallas purity analysis
+    **/*.py                          -> abi short-key + shm ownership
+                                        analysis (lint/abi.py,
+                                        lint/ownership.py)
+
+Tree-level passes (wire-contract catalog, registry-drift mirror) run
+whenever the scan covers the package itself — they pin cross-module
+agreements and so read their cataloged modules directly.
+
+`--changed [BASE]` lints only files reported by
+`git diff --name-only BASE` (default HEAD) — the fast pre-commit
+loop; the full default run stays the tier-1 gate. Touching lint/
+itself escalates to a full run, since every file is reachable from an
+analyzer change.
 
 Exit status: nonzero iff any non-baselined ERROR finding remains
 (warnings report but never gate). `--format json` is stable for
@@ -15,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from .core import (Finding, RULES, filter_baselined, load_baseline,
@@ -23,14 +37,16 @@ from .core import (Finding, RULES, filter_baselined, load_baseline,
 DEFAULT_BASELINE = "lint-baseline.toml"
 
 
-def _collect(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
-    toml, contract, jaxf = [], [], []
+def _collect(paths: list[str]) -> tuple[
+        list[str], list[str], list[str], list[str]]:
+    toml, contract, jaxf, py = [], [], [], []
 
     def route(p: str):
         q = p.replace(os.sep, "/")
         if q.endswith(".toml") and not q.endswith(DEFAULT_BASELINE):
             toml.append(p)
         elif q.endswith(".py"):
+            py.append(p)
             if "/tiles/" in q or q.endswith("disco/tiles.py"):
                 contract.append(p)
             if "/ops/" in q or "/tiles/" in q:
@@ -46,15 +62,20 @@ def _collect(paths: list[str]) -> tuple[list[str], list[str], list[str]]:
                     route(os.path.join(root, fn))
         else:
             route(path)
-    return toml, contract, jaxf
+    return toml, contract, jaxf, py
 
 
-def run(paths: list[str]) -> list[Finding]:
+def run(paths: list[str], tree: bool | None = None) -> list[Finding]:
+    """Lint `paths`. `tree` forces the tree-level passes on/off;
+    None auto-enables them when the scan reaches into the package."""
     from .core import check_suppressions
+    from .abi import (lint_abi_source, lint_registry_drift,
+                      lint_wire_contracts, pkg_root)
     from .contracts import lint_tiles_source
     from .graph import lint_config_file
     from .jaxlint import lint_jax_source
-    toml, contract, jaxf = _collect(paths)
+    from .ownership import lint_ownership_source
+    toml, contract, jaxf, py = _collect(paths)
     findings: list[Finding] = []
     sources: dict[str, str] = {}        # read each file exactly once
 
@@ -71,15 +92,51 @@ def run(paths: list[str]) -> list[Finding]:
         findings.extend(lint_tiles_source(src(p), p))
     for p in jaxf:
         findings.extend(lint_jax_source(src(p), p))
+    for p in py:
+        findings.extend(lint_abi_source(src(p), p))
+        findings.extend(lint_ownership_source(src(p), p))
+    if tree is None:
+        root = os.path.abspath(pkg_root())
+        tree = any(os.path.abspath(p).startswith(root + os.sep)
+                   for p in py)
+    if tree:
+        findings.extend(lint_wire_contracts())
+        findings.extend(lint_registry_drift())
     for p in sorted(sources):           # typo'd disable= tokens
         findings.extend(check_suppressions(sources[p], p))
     return findings
 
 
+def changed_paths(repo_root: str, base: str) -> list[str] | None:
+    """Repo files changed vs `base` (plus untracked), absolute paths;
+    None when git is unavailable (caller falls back to a full run)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+        extra = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    names = [ln.strip() for ln in
+             diff.stdout.splitlines() + extra.stdout.splitlines()
+             if ln.strip()]
+    out = []
+    for name in names:
+        p = os.path.join(repo_root, name)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="fdlint",
-        description="static topology / tile-contract / JAX purity lint")
+        description="static topology / tile-contract / JAX purity / "
+                    "wire-abi / shm-ownership lint")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: cfg "
                          "firedancer_tpu, relative to the repo root)")
@@ -91,6 +148,11 @@ def main(argv=None) -> int:
                     help="ignore the baseline file")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="lint only files changed vs BASE (default "
+                         "HEAD) — fast pre-commit loop; falls back to "
+                         "a full run if lint/ itself changed")
     args = ap.parse_args(argv)
 
     if args.rules:
@@ -103,7 +165,33 @@ def main(argv=None) -> int:
         os.path.abspath(__file__))))
     paths = args.paths or [os.path.join(repo_root, "cfg"),
                            os.path.join(repo_root, "firedancer_tpu")]
-    findings = run(paths)
+    tree: bool | None = None
+    if args.changed is not None:
+        changed = changed_paths(repo_root, args.changed)
+        if changed is not None and not any(
+                "/lint/" in p.replace(os.sep, "/") for p in changed):
+            scoped = [p for p in changed
+                      if any(os.path.abspath(p).startswith(
+                          os.path.abspath(d) + os.sep) or
+                          os.path.abspath(p) == os.path.abspath(d)
+                          for d in paths)]
+            # tree-level catalogs pin cross-module agreements: run
+            # them only when a mirrored/cataloged module changed
+            from .abi import (SECTION_MIRRORS, WIRE_CONTRACTS,
+                              _ADAPTERS_SUFFIX)
+            watched = {_ADAPTERS_SUFFIX}
+            watched.update(m[1] for m in SECTION_MIRRORS)
+            for _, _, sites in WIRE_CONTRACTS:
+                watched.update(s[0] for s in sites)
+            tree = any(p.replace(os.sep, "/").endswith(w)
+                       for p in scoped for w in watched)
+            paths = scoped
+            if not paths and not tree:
+                sys.stdout.write(
+                    render_json([]) if args.format == "json"
+                    else "clean: no lintable changes\n")
+                return 0
+    findings = run(paths, tree=tree)
 
     if not args.no_baseline:
         bl_path = args.baseline

@@ -9,8 +9,8 @@ pytest gate cover all of them.
 
 Suppression syntax (checked against the rule catalog):
 
-    x = thing()        # fdlint: disable=rule-id[,rule-id2] — why
-    # fdlint: disable=rule-id — why            (applies to next line)
+    x = thing()        # fdlint: disable=<rule-id>[,<rule-id2>] — why
+    # fdlint: disable=<rule-id> — why          (applies to next line)
 
 Baseline (`lint-baseline.toml` at the repo root) grandfathers legacy
 findings by (rule, path[, line]) so the CLI can gate NEW findings while
@@ -203,10 +203,51 @@ RULES: dict[str, tuple[str, str, str]] = {
         "jax", "warning",
         "jax.jit entry point without donate_argnums/donate_argnames: "
         "large device inputs are copied instead of reused"),
+    # -- wire/shm ABI family (lint/abi.py) -------------------------------
+    "wire-mismatch": (
+        "abi", "error",
+        "a cataloged cross-process wire site drifted: the struct "
+        "format strings extracted at the site no longer match the "
+        "WIRE_CONTRACTS catalog (or the site vanished) — producer and "
+        "consumer tiles would parse different bytes"),
+    "wire-mtu": (
+        "abi", "error",
+        "link mtu below the wire family's minimum frame for its "
+        "producer kind (exec dispatch header+row, exec done, shred "
+        "slice/shred wire, tower vote, snapshot chunk) — publish "
+        "asserts mid-flight instead of at review"),
+    "short-key": (
+        "abi", "error",
+        "bytes key reaches a store/funk WRITE api without a provable "
+        "32-byte width — the native store ABI reads EXACTLY 32 bytes, "
+        "so a shorter buffer hashes per-process trailing garbage and "
+        "the record becomes unfindable from other tiles (the r17 "
+        "_key32 bug class)"),
+    "registry-drift": (
+        "abi", "error",
+        "lint/registry.py mirror disagrees with the code it mirrors: "
+        "an adapter consumes an args key the registry does not "
+        "declare (or declares one nothing consumes), or a "
+        "*_SECTION_KEYS tuple drifted from its module's *_DEFAULTS"),
+    # -- shm single-writer family (lint/ownership.py) --------------------
+    "dual-writer": (
+        "ownership", "error",
+        "write API of a single-writer shm region (trace ring, sup_* "
+        "metric slots, restore marker, funk root) called from a "
+        "module outside the region's cataloged writer set — two "
+        "uncoordinated writers tear the region (the supervisor's "
+        "post-mortem blackbox append is the annotated handoff "
+        "exemplar)"),
+    "torn-read": (
+        "ownership", "error",
+        "multiple subscript reads of a live shm u64 view in one "
+        "function — a concurrent writer can update between the "
+        "accesses, so the fields read belong to different states; "
+        "snapshot with .copy() (tango.u64_snapshot) first"),
     # -- suppression hygiene (lint/core.py itself) -----------------------
     "bad-suppression": (
         "core", "error",
-        "# fdlint: disable= names a rule id that is not in the "
+        "a '# fdlint: disable=' comment names a rule id not in the "
         "catalog — the suppression has no effect (typo?)"),
 }
 
@@ -279,9 +320,11 @@ def check_suppressions(source: str, path: str) -> list[Finding]:
         for r in m.group(1).split(","):
             r = r.strip()
             if r and r != "all" and r not in RULES:
+                from .registry import suggest
                 out.append(finding(
                     "bad-suppression", path, i,
-                    f"disable={r!r} is not a known rule id"))
+                    f"disable={r!r} is not a known rule id"
+                    f"{suggest(r, RULES)}"))
     return out
 
 

@@ -504,6 +504,60 @@ def _check_mtus(model, lines) -> list[Finding]:
                     _emit(out, lines, "mtu-underflow", ln,
                           f"link {ln!r} mtu {m} < {need} (poh {tn!r} "
                           f"re-wraps bank frames: header 42 -> 116)")
+    out.extend(_check_wire_mtus(model, lines))
+    return out
+
+
+def _check_wire_mtus(model, lines) -> list[Finding]:
+    """wire-mtu: fixed wire-family minimums per producer kind (the
+    r16 exec wire, r17 snapshot stream, shred/tower wires) — the lint
+    graph model attributes each cataloged wire to its topology links,
+    so a link too small for one frame of its family fails review."""
+    out: list[Finding] = []
+    links, tiles = model["links"], model["tiles"]
+
+    def mtu(ln):
+        return links[ln]["mtu"] if ln in links else None
+
+    def need(ln, floor, why):
+        m = mtu(ln)
+        if m is not None and m < floor:
+            _emit(out, lines, "wire-mtu", ln,
+                  f"link {ln!r} mtu {m} < {floor} ({why})")
+
+    for tn, t in tiles.items():
+        kind, args = t["kind"], t["args"]
+        if kind in ("bank", "replay"):
+            for ln in args.get("exec_links") or ():
+                need(ln, reg.EXEC_DISPATCH_MIN_MTU,
+                     f"{kind} {tn!r} exec dispatch: <QQH> header + "
+                     f"one 80B txn row")
+        elif kind == "exec":
+            for ln in t["outs"]:
+                need(ln, reg.EXEC_DONE_MIN_MTU,
+                     f"exec {tn!r} completion frame <QII>")
+        elif kind == "shred":
+            if args.get("batches_link"):
+                need(args["batches_link"], reg.SLICE_MIN_MTU,
+                     f"shred {tn!r} slice frame <QIB> + payload")
+            if args.get("shreds_link"):
+                need(args["shreds_link"], reg.SHRED_WIRE_MIN_MTU,
+                     f"shred {tn!r} wire: fixed header through idx")
+        elif kind == "tower":
+            for ln in t["outs"]:
+                need(ln, reg.TOWER_WIRE_MIN_MTU,
+                     f"tower {tn!r} vote frame (1+32+8+32)")
+        elif kind == "snapld":
+            chunk = args.get("chunk")
+            if not isinstance(chunk, int):
+                snap = model.get("snapshot") or {}
+                chunk = snap.get("chunk") if isinstance(snap, dict) \
+                    else None
+            if isinstance(chunk, int):
+                for ln in t["outs"]:
+                    need(ln, chunk,
+                         f"snapld {tn!r} publishes {chunk}B snapshot "
+                         f"stream chunks ([snapshot].chunk)")
     return out
 
 

@@ -132,7 +132,7 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
     "bank": {"exec": None, "poh_link": OUT, "forward_payloads": None,
              "slots_per_epoch": None, "genesis_ckpt": None,
              "genesis": None, "genesis_synth": None, "rpc_port": None,
-             "ws_port": None, "wave": None,
+             "ws_port": None, "wave": None, "redispatch_s": None,
              # exec tile fan-out (r16): one dispatch out link + one
              # completion in link per exec shard; the bank keeps wave
              # scheduling/commit ordering/poh handoff, execution runs
@@ -233,3 +233,37 @@ def suggest(key: str, candidates) -> str:
 FORWARD_VERBATIM = {"verify", "dedup"}    # every out mtu >= max in mtu
 BANK_POH_GROWTH = -20 + 42     # microblock hdr 20 -> poh frame hdr 42
 POH_ENTRY_GROWTH = -42 + 116   # poh frame hdr 42 -> entry frame hdr 116
+
+# Minimum out-link mtus per wire family, for the wire-mtu rule (the
+# r16/r17 extension of the growth contracts above): a link too small
+# for even one frame of its producer kind's wire is a review-time
+# finding, not a publish assert. Mirrored from the frame layouts in
+# disco/tiles.py (exec wire), tiles/shred.py (slice + shred wire) and
+# tiles/tower.py (vote frame) — lint/abi.py's WIRE_CONTRACTS catalog
+# pins the same formats, and tests/test_lint.py recomputes these from
+# the live struct sizes.
+EXEC_DISPATCH_MIN_MTU = 18 + 80   # <QQH> header + one 80B txn row
+EXEC_DONE_MIN_MTU = 16            # <QII> completion frame
+SLICE_MIN_MTU = 13 + 1            # <QIB> slice header + >=1 payload byte
+SHRED_WIRE_MIN_MTU = 0x49 + 4     # fixed shred header through the idx u32
+TOWER_WIRE_MIN_MTU = 1 + 32 + 8 + 32   # vote frame (largest fixed frame)
+
+# TILE_ARGS keys consumed OUTSIDE the adapter class (config-side
+# expansion in app/config.py, topo.build sizing, launch) — the
+# registry-drift analyzer exempts these from its "registered but never
+# consumed by the adapter" direction. Every entry names its consumer.
+EXTERNAL_ARG_KEYS: dict[str, tuple[str, ...]] = {
+    # app/config.py sharded_tile expansion: tile_cnt shards, cpu0+i
+    # core pinning, per-shard out-link/tcache distribution
+    "verify": ("tile_cnt", "cpu0"),
+    # rr_cnt/rr_idx are stamped onto every shard by Topology's generic
+    # shard expansion (disco/topo.py); exec ignores them (it shards by
+    # dedicated per-shard exec_links, not round-robin seq filtering)
+    "exec": ("tile_cnt", "cpu0", "rr_cnt", "rr_idx"),
+    # the gui adapter hands its args dict wholesale to
+    # gui/schema.py normalize_gui, which validates and consumes every
+    # key at config load
+    "gui": ("bench_glob", "bind_addr", "port", "report_on_halt",
+            "tps_metric", "tps_tile", "ws_max_clients", "ws_queue",
+            "ws_sndbuf"),
+}

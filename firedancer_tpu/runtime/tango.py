@@ -440,6 +440,16 @@ class TraceRing:
 FSEQ_STALE = (1 << 64) - 1    # sentinel: consumer excluded from fctl
 
 
+def u64_snapshot(view_u64):
+    """One-shot copy of a live shm u64 view. Field reads against the
+    copy are mutually coherent-enough: a writer landing between two
+    subscript loads of the LIVE view hands the reader fields from two
+    different states (the torn-read lint rule), while the copy is
+    taken in a single pass."""
+    import numpy as np
+    return np.array(view_u64, copy=True)
+
+
 class Fseq:
     def __init__(self, wksp: Workspace, off: int | None = None,
                  seq0: int = 0):

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
 
+from ..funk.funk import key32
+
 DEPTH_MAX = 128                      # ref: FD_ACCDB_DEPTH_MAX
 SYSTEM_PROGRAM_ID = bytes(32)
 
@@ -93,7 +95,7 @@ class AccDb:
         h._closed = True
         self.rw_active -= 1
         if not discard:
-            self.funk.rec_write(h.xid, h.pubkey, h.account)
+            self.funk.rec_write(h.xid, key32(h.pubkey), h.account)
 
     # -- convenience (the hot SVM path) -------------------------------------
 
@@ -108,7 +110,7 @@ class AccDb:
         a = self.peek(xid, pubkey)
         a = Account() if a is None else replace(a)
         a.lamports = lamports
-        self.funk.rec_write(xid, pubkey, a)
+        self.funk.rec_write(xid, key32(pubkey), a)
 
 
 def commit_lamports(funk, xid, pubkey: bytes, lamports: int,
@@ -123,4 +125,4 @@ def commit_lamports(funk, xid, pubkey: bytes, lamports: int,
             if isinstance(prior, Account) else Account(lamports=lamports)
     else:
         rec = lamports
-    funk.rec_write(xid, pubkey, rec)
+    funk.rec_write(xid, key32(pubkey), rec)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import struct
 
+from ..funk.funk import key32
 from ..groove import GrooveStore
 from .accdb import AccDb, Account
 
@@ -68,7 +69,7 @@ class AccDbCold(AccDb):
         # copy is DELETED at promotion — an account lives hot XOR
         # cold, so later hot updates/deletions can never be shadowed
         # by a stale cold record after a restart (r4 review)
-        self.funk.rec_write(None, pubkey, acct)
+        self.funk.rec_write(None, key32(pubkey), acct)
         self.cold.delete(pubkey)
         self.cold_stats["hits"] += 1
         self.cold_stats["promoted"] += 1
@@ -95,7 +96,7 @@ class AccDbCold(AccDb):
         self.cold.put(pubkey, account_to_bytes(acct))
         if flush:
             self.cold.flush()
-        self.funk.rec_remove(None, pubkey)
+        self.funk.rec_remove(None, key32(pubkey))
         self.cold_stats["evicted"] += 1
 
     def evict_larger_than(self, data_len: int) -> int:
@@ -123,7 +124,7 @@ class AccDbCold(AccDb):
         would leave a cold copy to resurrect; all deletions of
         possibly-cold keys must come through here."""
         self.cold.delete(pubkey)
-        self.funk.rec_remove(xid, pubkey)
+        self.funk.rec_remove(xid, key32(pubkey))
 
     def close(self):
         self.cold.close()

@@ -39,6 +39,7 @@ import hashlib
 import struct
 from dataclasses import dataclass
 
+from ..funk.funk import key32
 from ..protocol.txn import ParsedTxn, parse_txn
 from .accdb import AccDb, Account, SYSTEM_PROGRAM_ID
 
@@ -227,7 +228,7 @@ class TxnContext:
 
     def commit(self):
         for k, a in self._work.items():
-            self.db.funk.rec_write(self.xid, k, a)
+            self.db.funk.rec_write(self.xid, key32(k), a)
 
 
 class InstrCtx:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import struct
 
+from ..funk.funk import key32
 from ..utils.base58 import b58_decode_32
 from .accdb import Account
 
@@ -158,7 +159,7 @@ def _write(db, xid, key: bytes, data: bytes):
     """Materialize a sysvar account; accepts an AccDb or a bare Funk
     (the one shape for every sysvar writer)."""
     funk = db.funk if hasattr(db, "funk") else db
-    funk.rec_write(xid, key, Account(
+    funk.rec_write(xid, key32(key), Account(
         lamports=rent_exempt_minimum(len(data)), data=bytearray(data),
         owner=SYSVAR_OWNER, executable=False))
 

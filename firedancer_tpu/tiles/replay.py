@@ -41,7 +41,7 @@ import struct
 
 import numpy as np
 
-from ..funk.funk import Funk
+from ..funk.funk import Funk, key32
 from ..svm.accdb import AccDb, Account
 from ..svm.programs import OK, TxnExecutor
 from ..replay.rdisp import ConflictDag
@@ -116,7 +116,7 @@ class ReplayCore:
         self.snapshot_compress = bool(snapshot_compress)
         self.db = AccDb(self.funk)
         for key, bal in (genesis or {}).items():
-            self.funk.rec_write(None, key,
+            self.funk.rec_write(None, key32(key),
                                 Account(lamports=int(bal)))
         # the host executor drives the in-process path; the fan-out
         # path ships transfers to the exec shards instead

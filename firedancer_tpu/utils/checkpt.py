@@ -36,6 +36,8 @@ import os
 import struct
 import zlib
 
+from ..funk.funk import key32
+
 MAGIC = b"FDTPUCK1"
 # v2 snapshot meta-row prefix (first frame of a snapshot_checkpt
 # stream; a legacy funk_checkpt stream's first frame is the bare u64
@@ -204,7 +206,12 @@ def funk_restore(funk_cls, fp):
         klen, vlen = struct.unpack_from("<II", data, 0)
         k = data[8:8 + klen]
         v = _dec_val(data[8 + klen:8 + klen + vlen])
-        funk.rec_write(None, bytes(k), v)
+        if klen != 32 or len(k) != klen:
+            raise CheckptError(
+                f"corrupt checkpoint: {klen}-byte record key (funk "
+                f"keys are exactly 32) — refusing to install a key no "
+                f"other process could derive")
+        funk.rec_write(None, key32(bytes(k)), v)
         got += 1
     if got != cnt:
         raise CheckptError(f"record count mismatch: {got} != {cnt}")
@@ -309,6 +316,10 @@ def snapshot_restore_into(funk, fp, min_slot: int | None = None):
     # bytes heap-direct; a process funk takes the decoded values.
     raw = getattr(funk, "raw", None)
     for k, ev, v in rows:
+        if len(k) != 32:
+            raise CheckptError(
+                f"corrupt snapshot: {len(k)}-byte record key (funk "
+                f"keys are exactly 32)")
         if raw is not None and ev is not None:
             rc = raw.put(0, k, ev)
             if rc != 0:
@@ -316,7 +327,7 @@ def snapshot_restore_into(funk, fp, min_slot: int | None = None):
                     f"shm funk store full (rc {rc}): raise "
                     f"[funk] rec_max/heap_mb")
         else:
-            funk.rec_write(None, k, v)
+            funk.rec_write(None, key32(k), v)
     return int(slot), bank_hash, int(cnt)
 
 

@@ -179,14 +179,21 @@ def store_root(funk, vinyl: Vinyl):
     """Write funk's published root through to vinyl (accounts encode
     via the checkpoint codec — one record format across snapshot,
     checkpt, and the cold store)."""
+    from ..funk.funk import key32
     from ..utils.checkpt import _enc_val
     for key, val in funk.root_items().items():
-        vinyl.put(key, _enc_val(val))
+        vinyl.put(key32(key), _enc_val(val))
     vinyl.sync()
 
 
 def load_root(funk, vinyl: Vinyl):
     """Restore vinyl's contents into funk's root (boot path)."""
+    from ..funk.funk import key32
     from ..utils.checkpt import _dec_val
     for key in vinyl.keys():
-        funk.rec_write(None, key, _dec_val(vinyl.get(key)))
+        if len(key) != 32:
+            raise VinylError(
+                f"corrupt vinyl: {len(key)}-byte record key (funk "
+                f"keys are exactly 32) — refusing to install a root "
+                f"record no other process could look up")
+        funk.rec_write(None, key32(key), _dec_val(vinyl.get(key)))

@@ -175,6 +175,21 @@ def verify_artifact(path: str) -> int:
         if record_sha256(doc) != want:
             errors.append("flat record does not match its seal "
                           "(headline fields tampered)")
+    # lint stamp: the run header (a provenance block) records fdlint's
+    # verdict on the tree that ran; verify surfaces a dirty stamp
+    # loudly (older artifacts predate the stamp — absence is reported,
+    # not an error)
+    lint = (wit.get("header") or {}).get("lint")
+    if lint is None:
+        print("  lint_clean     (no stamp — pre-abi-lint artifact)")
+    elif lint.get("clean"):
+        print("  lint_clean     yes")
+    else:
+        print(f"  lint_clean     NO ({lint.get('errors')} error(s))")
+        errors.append(
+            f"tree had {lint.get('errors')} non-baseline lint "
+            f"error(s) when this artifact was produced "
+            f"(lint_clean stamp)")
     from .artifact import stage_platform
     for ckpt in wit.get("stages", []):
         # same platform resolution as the artifact's witnessed map

@@ -81,6 +81,38 @@ def knob_snapshot(env: dict | None = None) -> dict:
     return dict(sorted(out.items()))
 
 
+def lint_state(repo_root: str) -> dict:
+    """fdlint's verdict on the tree that produced the artifact:
+    {"clean", "errors", "warnings"}. A witnessed number from a tree
+    with non-baseline findings is still a number — but the reader
+    deserves to know the static gates did not pass. Cached per
+    process: the orchestrator stamps many stages from one tree."""
+    global _LINT_STATE
+    if _LINT_STATE is None:
+        try:
+            from ..lint.cli import run as lint_run
+            from ..lint.core import filter_baselined, load_baseline
+            findings = lint_run([os.path.join(repo_root, "cfg"),
+                                 os.path.join(repo_root,
+                                              "firedancer_tpu")])
+            findings = filter_baselined(
+                findings,
+                load_baseline(os.path.join(repo_root,
+                                           "lint-baseline.toml")))
+            errors = sum(1 for f in findings if f.severity == "error")
+            warnings = len(findings) - errors
+            _LINT_STATE = {"clean": errors == 0, "errors": errors,
+                           "warnings": warnings}
+        except Exception as e:   # lint must never block a witness run
+            _LINT_STATE = {"clean": False, "errors": -1,
+                           "warnings": -1,
+                           "reason": f"lint failed to run: {e}"}
+    return dict(_LINT_STATE)
+
+
+_LINT_STATE: dict | None = None
+
+
 def provenance_block(repo_root: str,
                      extra_env: dict | None = None) -> dict:
     """The stamp every stage checkpoint (and the run header) carries.
@@ -99,6 +131,7 @@ def provenance_block(repo_root: str,
             "python": platform.python_version(),
         },
         "versions": pkg_versions(),
+        "lint": lint_state(repo_root),
         "knobs": knob_snapshot(env),
         "clock": {
             "wall_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",

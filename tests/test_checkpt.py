@@ -318,3 +318,22 @@ def test_legacy_checkpt_restores_as_slot_zero():
         assert cold.root_items() == funk.root_items()
     finally:
         _fini_funk(cold)
+
+
+def test_funk_restore_refuses_short_record_keys():
+    """A checkpoint frame carrying a non-32-byte record key must abort
+    the restore, not install a key no other process could derive (the
+    native store reads exactly 32 key bytes; a short buffer hashes
+    per-process trailing garbage)."""
+    import struct
+    from firedancer_tpu.utils.checkpt import _enc_val
+    buf = io.BytesIO()
+    w = CheckptWriter(buf, compress=False)
+    w.frame(struct.pack("<Q", 1))
+    k = b"root8byt"                           # 8-byte key
+    ev = _enc_val(7)
+    w.frame(struct.pack("<II", len(k), len(ev)) + k + ev)
+    w.fini()
+    buf.seek(0)
+    with pytest.raises(CheckptError, match="8-byte record key"):
+        funk_restore(Funk, buf)

@@ -178,3 +178,17 @@ def test_push_respects_prunes():
     w = CrdsValue(pk(8), KIND_VOTE, 0, 500, b"y")
     n.crds.upsert(w)
     assert tgts[0] in n.push_targets_for(w)
+
+
+def test_crds_value_rejects_wrong_width_fields():
+    """Fixed-width wire fields: a 31-byte origin doesn't fail encode,
+    it SHIFTS every later byte of the frame so peers decode garbage
+    under a valid-looking tag. Construction is the only choke point."""
+    import pytest
+    with pytest.raises(ValueError, match="32-byte pubkey, got 31"):
+        CrdsValue(bytes(31), KIND_VOTE, 0, 100, b"a")
+    with pytest.raises(ValueError, match="64 bytes"):
+        CrdsValue(pk(1), KIND_VOTE, 0, 100, b"a", signature=b"s" * 63)
+    # the two legal shapes still construct
+    CrdsValue(pk(1), KIND_VOTE, 0, 100, b"a")                 # unsigned
+    CrdsValue(pk(1), KIND_VOTE, 0, 100, b"a", b"s" * 64)      # signed

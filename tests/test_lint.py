@@ -45,7 +45,8 @@ def test_shipped_tree_is_clean(capsys):
 
 def test_every_rule_has_severity_and_family():
     for rule, (family, sev, desc) in core.RULES.items():
-        assert family in ("graph", "contract", "jax", "core")
+        assert family in ("graph", "contract", "jax", "abi",
+                          "ownership", "core")
         assert sev in core.SEVERITIES
         assert desc
     assert len(core.RULES) >= 12          # ISSUE 2 acceptance floor
@@ -1062,3 +1063,451 @@ def test_overlay_layer_directive(tmp_path):
     assert rule_count(findings, "dead-link") == 0    # overlay consumes
     fires_once(findings, "depth-pow2")
     assert findings[0].path == str(base)             # attributed to base
+
+
+# ---------------------------------------------------------------------------
+# abi-family fixtures (lint/abi.py): wire contracts, short keys,
+# registry drift
+# ---------------------------------------------------------------------------
+
+# a tower module whose three cataloged sites all match the catalog —
+# the base the skew fixtures mutate
+TOWER_OK = textwrap.dedent("""
+    import struct
+    def pack_block(slot, parent_slot, block_id, parent_id):
+        return bytes([0]) + struct.pack("<QQ", slot, parent_slot) \\
+            + block_id + parent_id
+    def pack_vote(voter, stake, block_id):
+        return bytes([1]) + voter + struct.pack("<Q", stake) + block_id
+    class TowerCore:
+        def handle(self, frame):
+            slot, parent = struct.unpack_from("<QQ", frame, 1)
+            (stake,) = struct.unpack_from("<Q", frame, 33)
+""")
+
+
+def test_wire_contracts_base_fixture_is_clean():
+    from firedancer_tpu.lint.abi import lint_wire_contracts
+    assert lint_wire_contracts({"tiles/tower.py": TOWER_OK}) == []
+
+
+def test_wire_contracts_shipped_tree_is_clean():
+    from firedancer_tpu.lint.abi import lint_wire_contracts
+    assert lint_wire_contracts() == []
+
+
+def test_wire_mismatch():
+    # a cataloged site vanishing (rename/drop) is drift: the other
+    # side of the wire still parses the cataloged layout
+    from firedancer_tpu.lint.abi import lint_wire_contracts
+    src = TOWER_OK.replace("def pack_vote", "def pack_vote_v2")
+    findings = lint_wire_contracts({"tiles/tower.py": src})
+    fires_once(findings, "wire-mismatch")
+    assert "pack_vote" in findings[0].message
+
+
+def test_wire_mismatch_skewed_format_names_the_site():
+    """The static half of the skewed-wire drill: narrowing pack_vote's
+    stake from <Q to <I flags exactly that site, both as a lost
+    cataloged format and as uncataloged ABI growth."""
+    from firedancer_tpu.lint.abi import lint_wire_contracts
+    src = TOWER_OK.replace('struct.pack("<Q", stake)',
+                           'struct.pack("<I", stake)')
+    findings = lint_wire_contracts({"tiles/tower.py": src})
+    assert findings and all(f.rule == "wire-mismatch" for f in findings)
+    assert all("pack_vote" in f.message for f in findings)
+
+
+def test_wire_mismatch_whitespace_in_format_is_not_drift():
+    # struct ignores whitespace in format strings; the comparison must
+    # too ("<Q Q" == "<QQ")
+    from firedancer_tpu.lint.abi import lint_wire_contracts
+    src = TOWER_OK.replace('struct.pack("<QQ", slot', 
+                           'struct.pack("<Q Q", slot')
+    assert lint_wire_contracts({"tiles/tower.py": src}) == []
+
+
+def test_wire_mtu():
+    # a tower vote frame is 73B fixed; a 64B out link asserts at the
+    # first publish instead of failing review
+    cfg = _cfg(
+        links=[{"name": "a_b", "depth": 64, "mtu": 1280},
+               {"name": "votes", "depth": 64, "mtu": 64}],
+        tiles=[{"name": "src", "kind": "synth", "outs": ["a_b"]},
+               {"name": "t", "kind": "tower", "ins": ["a_b"],
+                "outs": ["votes"]},
+               {"name": "dst", "kind": "sink", "ins": ["votes"]}])
+    fires_once(lint_config(cfg, "<fixture>"), "wire-mtu")
+
+
+def test_wire_mtu_exec_dispatch():
+    # exec dispatch = 18B header + one 80B txn row minimum
+    cfg = _cfg(
+        links=[{"name": "a_b", "depth": 64, "mtu": 1280},
+               {"name": "d0", "depth": 64, "mtu": 96},
+               {"name": "c0", "depth": 64, "mtu": 8}],
+        tiles=[{"name": "src", "kind": "synth", "outs": ["a_b"]},
+               {"name": "b", "kind": "bank", "ins": ["a_b"],
+                "outs": ["d0"], "exec_links": ["d0"],
+                "exec_done": ["c0"]},
+               {"name": "e", "kind": "exec", "ins": ["d0"],
+                "outs": ["c0"]},
+               {"name": "dst", "kind": "sink", "ins": ["c0"]}])
+    findings = lint_config(cfg, "<fixture>")
+    assert rule_count(findings, "wire-mtu") == 2   # dispatch AND done
+
+
+def _abi_findings(body):
+    from firedancer_tpu.lint.abi import lint_abi_source
+    return lint_abi_source(textwrap.dedent(body), "<fixture>")
+
+
+def test_short_key():
+    fires_once(_abi_findings("""
+        def install(funk, acct_hex):
+            funk.rec_write(None, bytes.fromhex(acct_hex), 1)
+    """), "short-key")
+
+
+def test_short_key_provably_wrong_width():
+    f = _abi_findings("""
+        def install(store, h):
+            store.rec_write(None, h[:15], 1)
+    """)
+    fires_once(f, "short-key")
+    assert "provably 15 bytes" in f[0].message
+
+
+def test_short_key_proofs_are_accepted():
+    assert _abi_findings("""
+        MARKER = b"m" * 32
+        def install(funk, h, k, raw):
+            funk.rec_write(None, key32(h), 1)       # helper
+            funk.rec_write(None, h2(raw).digest(), 2)  # hash width
+            funk.rec_write(None, raw[9:41], 3)      # const 32B slice
+            funk.rec_write(None, MARKER, 4)         # module constant
+            if len(k) != 32:
+                raise ValueError("short")
+            funk.rec_write(None, k, 5)              # guarded name
+    """) == []
+
+
+def test_short_key_kv_receiver_filter():
+    # .put on a db/store/funk/vinyl receiver is a store write; .put on
+    # anything else (dicts, caches) is not this rule's business
+    f = _abi_findings("""
+        def go(self, k):
+            self.db.put(k, 1)
+            self.cache.put(k, 2)
+    """)
+    fires_once(f, "short-key")
+
+
+def test_registry_drift_unknown_arg():
+    from firedancer_tpu.lint.abi import check_adapter_registry
+    src = textwrap.dedent("""
+        @register("sink")
+        class SinkAdapter:
+            def __init__(self, ctx, args):
+                self.batch = args.get("batch", 1)
+                self.bogus = args.get("not_a_registered_key")
+    """)
+    findings = check_adapter_registry(src, "<fixture>")
+    fires_once(findings, "registry-drift")
+    assert "not_a_registered_key" in findings[0].message
+
+
+def test_registry_drift_did_you_mean():
+    from firedancer_tpu.lint.abi import check_adapter_registry
+    src = textwrap.dedent("""
+        @register("sink")
+        class SinkAdapter:
+            def __init__(self, ctx, args):
+                self.batch = args.get("bach", 1)
+    """)
+    findings = check_adapter_registry(src, "<fixture>")
+    assert findings and "did you mean 'batch'" in findings[0].message
+
+
+def test_registry_drift_unread_key():
+    from firedancer_tpu.lint.abi import check_adapter_registry
+    src = textwrap.dedent("""
+        @register("sink")
+        class SinkAdapter:
+            def __init__(self, ctx, args):
+                pass
+    """)
+    findings = check_adapter_registry(src, "<fixture>")
+    fires_once(findings, "registry-drift")
+    assert "'batch'" in findings[0].message
+
+
+def test_registry_drift_section_mirror():
+    from firedancer_tpu.lint import registry as reg
+    from firedancer_tpu.lint.abi import check_section_mirror
+    keys = ", ".join(f"{k!r}: None"
+                     for k in reg.TRACE_SECTION_KEYS + ("bogus",))
+    src = f"TRACE_DEFAULTS = {{{keys}}}\n"
+    findings = check_section_mirror(
+        "trace", src, "<fixture>", "TRACE_DEFAULTS",
+        "TRACE_SECTION_KEYS")
+    fires_once(findings, "registry-drift")
+    assert "bogus" in findings[0].message
+
+
+def test_registry_drift_shipped_mirrors_are_clean():
+    from firedancer_tpu.lint.abi import lint_registry_drift
+    assert lint_registry_drift() == []
+
+
+def test_bad_suppression_new_rule_did_you_mean():
+    f = core.check_suppressions(
+        "x = 1  # fdlint: disable=wire-missmatch — why\n", "<f>")
+    fires_once(f, "bad-suppression")
+    assert "did you mean 'wire-mismatch'" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# ownership-family fixtures (lint/ownership.py)
+# ---------------------------------------------------------------------------
+
+def _own_findings(body, path="gossip/pusher.py"):
+    from firedancer_tpu.lint.ownership import lint_ownership_source
+    return lint_ownership_source(textwrap.dedent(body), path)
+
+
+def test_dual_writer():
+    fires_once(_own_findings("""
+        def leak(self, etype):
+            self._tr.frag(etype, sig=1)
+    """), "dual-writer")
+
+
+def test_dual_writer_sup_slots():
+    fires_once(_own_findings("""
+        def poke(slots, tn):
+            slots[SUP_SLOTS["sup_restarts"]] = 0
+    """, path="tiles/evil.py"), "dual-writer")
+
+
+def test_dual_writer_restore_marker():
+    fires_once(_own_findings("""
+        def fake_restore(funk):
+            funk.rec_write(None, RESTORE_MARKER_KEY, b"1")
+    """, path="gossip/pusher.py"), "dual-writer")
+
+
+def test_dual_writer_cataloged_writer_is_clean():
+    # the snapshot inserter IS the restore marker's cataloged writer
+    assert _own_findings("""
+        def mark(funk):
+            funk.rec_write(None, RESTORE_MARKER_KEY, b"1")
+    """, path="tiles/snapshot.py") == []
+
+
+def test_dual_writer_handoff_annotation():
+    assert _own_findings("""
+        def reap_mark(self, etype):
+            # fdlint: disable=dual-writer — handoff: owner reaped
+            self._tr.event(etype)
+    """) == []
+
+
+def test_torn_read():
+    f = _own_findings("""
+        def seed(self, view_u64):
+            self.count = int(view_u64[0])
+            self.sum = int(view_u64[1])
+    """)
+    fires_once(f, "torn-read")
+
+
+def test_torn_read_snapshot_is_clean():
+    assert _own_findings("""
+        def seed(self, view_u64):
+            snap = u64_snapshot(view_u64)
+            self.count = int(snap[0])
+            self.sum = int(snap[1])
+    """) == []
+
+
+def test_torn_read_slicing_subviews_is_clean():
+    # carving sub-views at setup is lazy offset algebra, not a read
+    assert _own_findings("""
+        def carve(self, raw):
+            v = raw.view()
+            self.hdr = v[:64]
+            self.ring = v[64:]
+    """) == []
+
+
+def test_torn_read_tango_is_exempt():
+    # tango IS the atomic discipline: its speculative double-read of
+    # seq around the payload copy is the protocol, not a bug
+    assert _own_findings("""
+        def consume(self, view_u64):
+            a = view_u64[0]
+            b = view_u64[0]
+    """, path="runtime/tango.py") == []
+
+
+# ---------------------------------------------------------------------------
+# the fixed real defects stay fixed (abi/ownership rules on the
+# shipped modules they flagged)
+# ---------------------------------------------------------------------------
+
+def test_fixed_defects_stay_clean():
+    import os
+    from firedancer_tpu.lint.abi import lint_abi_source, pkg_root
+    from firedancer_tpu.lint.ownership import lint_ownership_source
+    for rel in ("disco/metrics.py", "vinyl/vinyl.py",
+                "utils/checkpt.py", "gossip/crds.py"):
+        p = os.path.join(pkg_root(), *rel.split("/"))
+        with open(p) as fp:
+            src = fp.read()
+        assert lint_abi_source(src, rel) == [], rel
+        assert lint_ownership_source(src, rel) == [], rel
+
+
+# ---------------------------------------------------------------------------
+# --changed incremental mode
+# ---------------------------------------------------------------------------
+
+def test_changed_paths_lists_modified_and_untracked(tmp_path):
+    import os
+    import subprocess
+    from firedancer_tpu.lint.cli import changed_paths
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+           "PATH": os.environ["PATH"], "HOME": str(tmp_path)}
+    def git(*a):
+        subprocess.run(["git", *a], cwd=repo, env=env, check=True,
+                       capture_output=True)
+    git("init", "-q")
+    (repo / "a.py").write_text("x = 1\n")
+    git("add", "a.py")
+    git("commit", "-qm", "seed")
+    (repo / "a.py").write_text("x = 2\n")          # modified
+    (repo / "b.toml").write_text("[link]\n")       # untracked
+    got = {os.path.basename(p)
+           for p in changed_paths(str(repo), "HEAD")}
+    assert got == {"a.py", "b.toml"}
+
+
+def test_cli_changed_mode_runs(capsys):
+    # on whatever state the repo is in, --changed must produce valid
+    # json and a sane exit code (full-run fallback included)
+    rc = lint_main(["--changed", "--format", "json"])
+    out = capsys.readouterr().out
+    assert rc in (0, 1)
+    if out.strip().startswith("{"):
+        assert json.loads(out)["fdlint"] == 1
+
+
+# ---------------------------------------------------------------------------
+# provenance stamp
+# ---------------------------------------------------------------------------
+
+def test_provenance_block_carries_lint_stamp(tmp_path, monkeypatch):
+    import firedancer_tpu.witness.provenance as prov
+    monkeypatch.setattr(prov, "_LINT_STATE",
+                        {"clean": True, "errors": 0, "warnings": 0})
+    block = prov.provenance_block(str(tmp_path))
+    assert block["lint"] == {"clean": True, "errors": 0, "warnings": 0}
+
+
+def test_verify_artifact_flags_dirty_lint_stamp(tmp_path, capsys):
+    from firedancer_tpu.witness import provenance as prov
+    from firedancer_tpu.witness.cli import verify_artifact
+    header = {"lint": {"clean": False, "errors": 3, "warnings": 0}}
+    wit = {"header": header, "genesis": prov.chain_hash("", header),
+           "stages": [], "run_id": "t"}
+    p = tmp_path / "a.json"
+    p.write_text(json.dumps({"witness": wit}))
+    rc = verify_artifact(str(p))
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "lint" in err
+    # same artifact with a clean stamp verifies
+    header2 = {"lint": {"clean": True, "errors": 0, "warnings": 0}}
+    wit2 = {"header": header2, "genesis": prov.chain_hash("", header2),
+            "stages": [], "run_id": "t"}
+    p.write_text(json.dumps({"witness": wit2}))
+    assert verify_artifact(str(p)) == 0
+
+
+# ---------------------------------------------------------------------------
+# the live skewed-wire drill: two real processes over a tango ring
+# exchange vote frames under a deliberately narrowed stake field; the
+# analyzer flagged exactly that site statically (see
+# test_wire_mismatch_skewed_format_names_the_site) and the runtime
+# consumer demonstrates the failure the flag prevented
+# ---------------------------------------------------------------------------
+
+SKEWED_PACK_VOTE = textwrap.dedent("""
+    import struct
+    def pack_vote(voter, stake, block_id):
+        return bytes([1]) + voter + struct.pack("<I", stake) + block_id
+""")
+
+
+def _skewed_vote_producer(name, ring_off, arena_off, depth, mtu):
+    from firedancer_tpu.runtime import Workspace, Ring
+    w = Workspace(name, 1 << 22, create=False)
+    ring = Ring(w, ring_off, depth, arena_off, mtu)
+    ns = {}
+    exec(compile(SKEWED_PACK_VOTE, "<skewed>", "exec"), ns)
+    frame = ns["pack_vote"](b"v" * 32, 7, b"b" * 32)
+    ring.publish(frame, sig=1)
+    w.close()
+
+
+def test_skewed_wire_drill_cross_process():
+    import multiprocessing as mp
+    import os
+    import time
+    from firedancer_tpu.lint.abi import lint_wire_contracts
+    from firedancer_tpu.runtime import Workspace, Ring
+    from firedancer_tpu.tiles.tower import TowerCore, pack_vote
+
+    # static half: the analyzer flags the skewed producer site BEFORE
+    # any process runs
+    skewed_mod = TOWER_OK.replace('struct.pack("<Q", stake)',
+                                  'struct.pack("<I", stake)')
+    flagged = lint_wire_contracts({"tiles/tower.py": skewed_mod})
+    assert flagged and all("pack_vote" in f.message for f in flagged)
+
+    # runtime half: the skewed frame crosses a REAL ring between two
+    # REAL processes and the consumer silently drops the vote — the
+    # wedge class the static flag catches at review time
+    name = f"/fdtpu_lintdrill_{os.getpid()}"
+    w = Workspace(name, 1 << 22)
+    try:
+        depth, mtu = 8, 256
+        ring = Ring.create(w, depth=depth, mtu=mtu)
+        ctx = mp.get_context("fork")
+        p = ctx.Process(target=_skewed_vote_producer,
+                        args=(name, ring.off, ring.arena_off, depth,
+                              mtu), daemon=True)
+        p.start()
+        deadline = time.monotonic() + 30
+        frame = None
+        while time.monotonic() < deadline:
+            rc, frag = ring.consume(0)
+            if rc == 0:
+                frame = bytes(ring.payload(frag))
+                break
+        p.join(timeout=30)
+        assert frame is not None, "producer never published"
+        core_ = TowerCore(total_stake=100)
+        core_.handle(frame)                   # skewed: 69B < 73B vote
+        assert core_.metrics["bad_frames"] == 1
+        assert core_.metrics["votes_in"] == 0
+        # the correctly-packed frame from the same inputs is accepted
+        core_.handle(pack_vote(b"v" * 32, 7, b"b" * 32))
+        assert core_.metrics["bad_frames"] == 1
+    finally:
+        w.close()
+        w.unlink()

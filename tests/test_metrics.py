@@ -820,3 +820,47 @@ def test_metric_tile_healthz_and_summary(verify_pipeline):
     # the SLO engine is live (evals advancing) and the objective holds
     assert runner.metrics("metric")["slo_evals"] > 0
     assert runner.metrics("metric")["slo_breach"] == 0
+
+
+def test_seed_from_snapshots_the_live_view():
+    """Restart resurrect: seed_from must read ONE coherent copy of the
+    shm block, not field-by-field loads of the live view — the dead
+    tile's final flush writes count LAST, so a count belonging to newer
+    buckets double-adds samples for the rest of the restarted tile's
+    life. The lint torn-read rule pins the discipline; this pins the
+    behavior."""
+    import numpy as np
+    h = HistAccum()
+    for ns in [5, 50, 500]:
+        h.add(ns)
+    view = np.zeros(HIST_U64, np.uint64)
+    h.flush_into(view)
+
+    class TornView:
+        """Simulates the racing writer: the first element access flips
+        the block to the NEXT flush's contents mid-read."""
+        def __init__(self, now, later):
+            self._now, self._later, self._reads = now, later, 0
+        def __getitem__(self, idx):
+            self._reads += 1
+            src = self._now if self._reads == 1 else self._later
+            return src[idx]
+        def __array__(self, dtype=None, copy=None):
+            # np.array(view, copy=True) — the u64_snapshot path —
+            # lands entirely on the pre-race contents
+            return np.array(self._now, dtype=dtype)
+
+    later = view.copy()
+    later[0] += 100                       # racing flush bumps count
+    h2 = HistAccum()
+    h2.seed_from(TornView(view, later))
+    assert h2.count == 3                  # coherent: pre-race block
+    assert h2.sum_ns == 555
+    assert sum(h2.buckets) == h2.count    # count never exceeds buckets
+
+    # the ownership analyzer keeps the fixed module fixed
+    from firedancer_tpu.lint.ownership import lint_ownership_source
+    import firedancer_tpu.disco.metrics as m
+    with open(m.__file__) as f:
+        src = f.read()
+    assert lint_ownership_source(src, "disco/metrics.py") == []

@@ -150,3 +150,29 @@ def test_funk_root_roundtrip(tmp_path):
     assert funk2.rec_query(None, k(2)).lamports == 7
     assert funk2.rec_query(None, k(3)) == 12345
     v2.close()
+
+
+def test_load_root_refuses_short_disk_keys(tmp_path):
+    """An on-disk vinyl record with a non-32-byte key must refuse to
+    restore: installed under a garbage-extended native key, no other
+    process could ever derive it (the r17 follower-gate wedge class)."""
+    from firedancer_tpu.utils.checkpt import _enc_val
+    from firedancer_tpu.vinyl import VinylError
+    from firedancer_tpu.vinyl.vinyl import load_root, store_root
+    p = str(tmp_path / "short.log")
+    v = Vinyl(p)
+    v.put(b"root", _enc_val(7))          # hand-written short key
+    v.sync()
+    funk = Funk()
+    with pytest.raises(VinylError, match="4-byte record key"):
+        load_root(funk, v)
+    assert funk.root_items() == {}       # nothing installed
+    v.close()
+    # store_root normalizes through key32: a short in-memory key is a
+    # hard error at the write side too
+    funk2 = Funk()
+    funk2.rec_write(None, k(1), 1)
+    v2 = Vinyl(str(tmp_path / "ok.log"))
+    store_root(funk2, v2)
+    assert v2.get(k(1)) is not None
+    v2.close()
