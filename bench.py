@@ -189,6 +189,19 @@ def _child_bench():
     sys.stdout.flush()
 
 
+def _bench_flight(stage: str):
+    """[flight] cfg for a bench stage topology, or None. Under
+    FDTPU_BENCH_FLIGHT_DIR each stage archives its telemetry history
+    to <dir>/<stage>, so report.html's history tab (and fdflight
+    post-mortems) cover the bench run itself. Off by default — the
+    recorder is reader-side only, but the bench path stays untouched
+    unless asked."""
+    root = os.environ.get("FDTPU_BENCH_FLIGHT_DIR")
+    if not root:
+        return None
+    return {"dir": os.path.join(root, stage)}
+
+
 def _e2e_run(count: int, unique: int, batch: int,
              rate_tps: float = 0.0, coalesce_us: float = 0.0,
              profile: bool = True):
@@ -209,9 +222,10 @@ def _e2e_run(count: int, unique: int, batch: int,
     prof_hz = float(os.environ.get("FDTPU_BENCH_PROF_HZ", "29"))
     prof_cfg = {"enable": True, "hz": prof_hz} \
         if profile and prof_hz > 0 else None
+    flight_cfg = _bench_flight("e2e")
     topo = (
         Topology(f"bench{os.getpid()}", wksp_size=1 << 26,
-                 prof=prof_cfg)
+                 prof=prof_cfg, flight=flight_cfg)
         .link("ingest", depth=8192, mtu=1280)
         .link("verify_dedup", depth=8192, mtu=1280)
         .link("dedup_sink", depth=8192, mtu=1280)
@@ -225,6 +239,8 @@ def _e2e_run(count: int, unique: int, batch: int,
               tcache="dedup_tc", batch=1024)
         .tile("sink", "sink", ins=["dedup_sink"], batch=1024)
     )
+    if flight_cfg:
+        topo.tile("flight", "flight")
     runner = TopologyRunner(topo.build()).start()
     try:
         runner.wait_running(timeout_s=840)   # includes verify compile
@@ -428,8 +444,10 @@ def _leader_topology(count, unique, batch, verify_tiles, rate_tps,
     cpus = os.cpu_count() or 1
     cpu0 = 1 if cpus >= verify_tiles + 6 else None
     vd = [f"vd{i}" for i in range(verify_tiles)]
+    flight_cfg = _bench_flight("leader")
     topo = (
-        Topology(f"ldr{os.getpid()}", wksp_size=1 << 27)
+        Topology(f"ldr{os.getpid()}", wksp_size=1 << 27,
+                 flight=flight_cfg)
         .link("ingest", depth=4096, mtu=1280)
         .link("dedup_pack", depth=4096, mtu=1280)
         .link("pack_bank0", depth=256, mtu=16384)
@@ -474,6 +492,8 @@ def _leader_topology(count, unique, batch, verify_tiles, rate_tps,
               clients=[{"role": "leader", "req": "shred_req",
                         "resp": "sign_resp"}])
         .tile("shredsink", "sink", ins=["shreds_mirror"]))
+    if flight_cfg:
+        topo.tile("flight", "flight")
     for i in range(verify_tiles):
         topo.link(vd[i], depth=4096, mtu=1280)
         topo.tcache(f"vtc{i}", depth=tcache_depth)
@@ -1007,7 +1027,7 @@ def _flood_topology(shed_stakes: dict, slo_floor: float | None,
                            "expr": f"sink.rx rate > {slo_floor}/s"}]}
     topo = (
         Topology(f"flood{os.getpid()}", wksp_size=1 << 26,
-                 slo=slo,
+                 slo=slo, flight=_bench_flight("flood"),
                  shed={"rate_pps": float(os.environ.get(
                            "FDTPU_BENCH_FLOOD_RATE_PPS", "0"))
                        or rate_pps,
@@ -1054,6 +1074,8 @@ def _flood_topology(shed_stakes: dict, slo_floor: float | None,
         .tile("sink", "sink", ins=["dedup_sink"], batch=256))
     if slo is not None:
         topo.tile("metric", "metric", port=0)
+    if _bench_flight("flood"):
+        topo.tile("flight", "flight")
     return topo
 
 
@@ -1630,6 +1652,12 @@ def main():
         except Exception as e:  # noqa: BLE001 — annotate, don't break
             result["bench_gate"] = {"prev": prev,
                                     "error": f"{e!r}"[:200]}
+    # flight archive provenance (r19): the round's record names the
+    # archive dir its stage topologies recorded into, so fdflight /
+    # fdgui --archive can post-mortem the exact run behind the numbers
+    if os.environ.get("FDTPU_BENCH_FLIGHT_DIR"):
+        result["flight_dir"] = os.environ["FDTPU_BENCH_FLIGHT_DIR"]
+
     _emit_report(result)
     print(json.dumps(result))
     sys.stdout.flush()

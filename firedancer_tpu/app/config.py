@@ -133,7 +133,7 @@ except ModuleNotFoundError:          # py<3.11
 
 _TOP_SECTIONS = {"topology", "link", "tcache", "tile", "trace", "slo",
                  "prof", "shed", "witness", "funk", "replay",
-                 "snapshot"}
+                 "snapshot", "flight"}
 
 
 def _deep_merge(base: dict, over: dict) -> dict:
@@ -183,7 +183,8 @@ def load_config(*paths, overrides: dict | None = None) -> dict:
                 cfg[key] = _merge_named_lists(cfg.get(key, []),
                                               layer[key], str(p))
         for key in ("topology", "trace", "slo", "prof", "shed",
-                    "witness", "funk", "replay", "snapshot"):
+                    "witness", "funk", "replay", "snapshot",
+                    "flight"):
             if key in layer:
                 merged = _deep_merge(cfg.get(key, {}), layer[key])
                 if key == "slo" and "target" in layer[key]:
@@ -273,11 +274,18 @@ def build_topology(cfg: dict, name: str | None = None):
     snap_cfg = cfg.get("snapshot")
     if snap_cfg is not None:
         normalize_snapshot(snap_cfg)
+    # [flight] durable telemetry archive — same gate (flight/__init__
+    # is the one validator; the recorder tile reads the normalized
+    # section off the plan)
+    from ..flight import normalize_flight
+    flight_cfg = cfg.get("flight")
+    if flight_cfg is not None:
+        normalize_flight(flight_cfg)
     topo = Topology(name or top.get("name", f"cfg{os.getpid()}"),
                     wksp_size=int(top.get("wksp_size", 1 << 26)),
                     trace=trace_cfg, slo=slo_cfg, prof=prof_cfg,
                     shed=shed_cfg, funk=funk_cfg, replay=replay_cfg,
-                    snapshot=snap_cfg)
+                    snapshot=snap_cfg, flight=flight_cfg)
     for ln in cfg.get("link", []):
         topo.link(ln["name"], depth=int(ln.get("depth", 128)),
                   mtu=int(ln.get("mtu", 1280)))

@@ -88,13 +88,16 @@ class TileHttpServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                on_connect = plumbing.ws_routes.get(self.path)
+                # route on the bare path: curl'd ?since=/cache-buster
+                # query strings must not 404 an exact-match table
+                path = self.path.split("?", 1)[0]
+                on_connect = plumbing.ws_routes.get(path)
                 if on_connect is not None and "upgrade" in \
                         self.headers.get("Connection", "").lower():
                     plumbing.requests.bump()
                     plumbing._ws_upgrade(self, on_connect)
                     return
-                handler = plumbing.routes.get(self.path)
+                handler = plumbing.routes.get(path)
                 if handler is None:
                     plumbing.requests.bump()
                     self.send_error(404)
@@ -184,13 +187,16 @@ class TileHttpServer:
             # this client: registration AFTER on_connect guarantees
             # the documented snapshot-then-deltas order
             on_connect(conn)
+            # register under the BARE path — broadcast(path) keys on
+            # the route table, so a ?query here would orphan the client
+            ws_path = handler.path.split("?", 1)[0]
             with self._ws_lock:
-                self._ws_clients.setdefault(handler.path, []) \
+                self._ws_clients.setdefault(ws_path, []) \
                     .append(conn)
             self.ws_accepted.bump()
             conn.run_reader()
         finally:
-            self._unregister(handler.path, conn)
+            self._unregister(handler.path.split("?", 1)[0], conn)
 
     def _unregister(self, path: str, conn):
         with self._ws_lock:

@@ -460,19 +460,23 @@ class SloEngine:
                 self.trace.event(EV_SLO,
                                  arg=max(0, int(st.value or 0)),
                                  count=idx)
-            if self.dump:
-                ev["dump"] = self._dump(st)
+        if self.dump:
+            # both edges dump (clear included, with the kind field):
+            # the fdflight recorder observes exact breach/clear
+            # transitions from the files, not just the breach edge
+            ev["dump"] = self._dump(st, kind)
         return ev
 
-    def _dump(self, st: _TargetState) -> str | None:
-        """Breach snapshot next to the supervisor black boxes — the
-        post-mortem artifact: which objective, what value, how the
+    def _dump(self, st: _TargetState, kind: str = "breach") -> str | None:
+        """Breach/clear snapshot next to the supervisor black boxes —
+        the post-mortem artifact: which objective, what value, how the
         windows looked. Must never block evaluation."""
         from ..utils.tempo import monotonic_ns
         path = slo_dump_path(self.plan.get("topology", "?"),
                              st.spec["name"])
         doc = {
             "topology": self.plan.get("topology", "?"),
+            "kind": kind,
             "dumped_at_ns": monotonic_ns(),
             "target": st.spec["name"],
             "expr": st.spec["expr"],

@@ -2387,6 +2387,12 @@ class ReplayAdapter:
                "exec_redispatch", "divergent_slot", "snapshots",
                "restore_slot", "behind", "overruns"]
     GAUGES = ["buffered", "behind", "divergent_slot", "restore_slot"]
+    # catch-up telemetry promoted to first-class fdtpu_tile_<name>
+    # prometheus families (r19): until now only the fdgui catch-up
+    # panel read these slots — dashboards and [slo] targets can key on
+    # them directly
+    DEVICE_SERIES = ["slots_replayed", "divergent_slot", "restore_slot",
+                     "behind", "buffered"]
 
     def __init__(self, ctx, args):
         _setup_jax()
@@ -2839,6 +2845,7 @@ class MetricAdapter:
                 **full_snapshot(ctx.plan, ctx.wksp),
                 "slo": self.engine.status(),
                 "slo_history": list(self.engine.history),
+                "catchup": self._catchup(),
             }).encode()
             return 200, "application/json", body
 
@@ -2853,6 +2860,25 @@ class MetricAdapter:
             port=int(args.get("port", 0)),
             bind_addr=args.get("bind_addr", "127.0.0.1"))
         self.port = self.server.port
+
+    def _catchup(self) -> dict | None:
+        """r17 replay/snapshot progress as a first-class summary block
+        (r19): per-replay-tile catch-up slots, mirroring the fdgui
+        panel so dashboards scraping /summary.json need no gui tile.
+        None when the topology has no replay tile."""
+        from . import topo as topo_mod
+        out = {}
+        for tn, spec in self.ctx.plan["tiles"].items():
+            if spec["kind"] != "replay":
+                continue
+            names = spec.get("metrics_names", [])
+            vals = topo_mod.read_metrics(self.ctx.wksp, self.ctx.plan,
+                                         tn)
+            m = {nm: int(vals[i]) for i, nm in enumerate(names)}
+            out[tn] = {k: m.get(k, 0) for k in
+                       ("slots_replayed", "divergent_slot",
+                        "restore_slot", "behind", "buffered")}
+        return out or None
 
     def _healthz(self) -> dict:
         from ..runtime import Cnc, CNC_RUN
@@ -2909,6 +2935,45 @@ class MetricAdapter:
                 "slo_breach": self.engine.breached,
                 "slo_breaches": self.engine.total_breaches,
                 "slo_evals": self.engine.evals}
+
+
+@register("flight")
+class FlightAdapter:
+    """fdflight recorder tile (r19): drains the shm observability
+    plane — metric slot deltas, link counters + consume-latency
+    quantiles, SLO breach/clear transitions, sampled trace events,
+    prof folded-stack digests — into the durable on-disk archive the
+    `[flight]` section configures (flight/archive.py segments +
+    incident bundles). Reader-side only, the fdmetrics contract: every
+    drain pass is a read of regions other tiles already maintain, so
+    writer tiles pay nothing. The drain cadence (`[flight].hz`) is
+    rate-limited inside the recorder; the stem just calls
+    housekeeping. On halt the recorder takes one final drain and seals
+    any pending incident, so a clean shutdown archives its own tail.
+
+    args: none — all configuration rides the plan's [flight] section
+    (validated at config load + topo.build + fdlint bad-flight)."""
+
+    METRICS = ["frames", "drains", "segments", "incidents", "bytes"]
+    GAUGES = ["segments"]
+
+    def __init__(self, ctx, args):
+        from ..flight.recorder import FlightRecorder
+        self.ctx = ctx
+        self.recorder = FlightRecorder(ctx.plan, ctx.wksp,
+                                       ctx.plan.get("flight"))
+
+    def housekeeping(self):
+        self.recorder.maybe_drain()
+
+    def poll_once(self) -> int:
+        return 0
+
+    def on_halt(self):
+        self.recorder.close()
+
+    def metrics_items(self):
+        return dict(self.recorder.metrics)
 
 
 @register("bundle")
@@ -3374,13 +3439,31 @@ class GuiAdapter:
                 sorted(_glob.glob(self._bench_glob)))).encode()
             return 200, "application/json", body
 
+        def history_route():
+            # archive-backed history panel (r19): sparklines from the
+            # [flight] directory on DISK, so the window reaches past
+            # whatever the live shm rings still hold
+            from ..gui.report import history_series
+            flight_dir = (ctx.plan.get("flight") or {}).get("dir")
+            if not flight_dir:
+                return 404, "application/json", json.dumps(
+                    {"error": "topology has no [flight] archive"}
+                ).encode()
+            try:
+                body = json.dumps(history_series(flight_dir)).encode()
+            except Exception as e:   # noqa: BLE001 — unreadable dir
+                return 503, "application/json", json.dumps(
+                    {"error": f"archive unreadable: {e!r}"}).encode()
+            return 200, "application/json", body
+
         def on_ws_connect(conn):
             conn.send_json(snapshot_doc(ctx.plan))
 
         self.server = TileHttpServer(
             {"/": page_route, "/index.html": page_route,
              "/summary.json": summary_route,
-             "/flame.json": flame_route, "/bench.json": bench_route},
+             "/flame.json": flame_route, "/bench.json": bench_route,
+             "/history.json": history_route},
             port=a["port"], bind_addr=a["bind_addr"],
             ws_routes={"/ws": on_ws_connect},
             ws_max_clients=a["ws_max_clients"],

@@ -86,7 +86,8 @@ class Topology:
                  trace: dict | None = None, slo: dict | None = None,
                  prof: dict | None = None, shed: dict | None = None,
                  funk: dict | None = None, replay: dict | None = None,
-                 snapshot: dict | None = None):
+                 snapshot: dict | None = None,
+                 flight: dict | None = None):
         self.name = name
         self.wksp_size = wksp_size
         self.links: dict[str, LinkSpec] = {}
@@ -115,6 +116,9 @@ class Topology:
         # adapters read off the plan
         self.replay = replay
         self.snapshot = snapshot
+        # [flight] durable telemetry archive (flight/__init__ schema):
+        # the recorder tile reads the normalized section off the plan
+        self.flight = flight
 
     def link(self, name: str, depth: int = 128, mtu: int = 1280,
              external: bool = False):
@@ -326,6 +330,12 @@ class Topology:
                 as _norm_snap
             plan["snapshot"] = _norm_snap(self.snapshot) \
                 if self.snapshot is not None else None
+            # [flight]: validated here (fail before launch) and carried
+            # on the plan — the flight recorder tile and the gui
+            # history route read it; None = no archive on this topology
+            from ..flight import normalize_flight as _norm_flight
+            plan["flight"] = _norm_flight(self.flight) \
+                if self.flight is not None else None
             for tn, t in self.tiles.items():
                 if "shed" in t.args:
                     _norm_shed(t.args["shed"], per_tile=True)

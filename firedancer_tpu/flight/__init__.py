@@ -1,0 +1,116 @@
+"""fdflight: the durable flight-data archive (r19).
+
+Every observability surface this repo grew — fdmetrics counters,
+fdtrace rings, fdprof samples, the SLO engine's breach deque — is
+shared-memory-resident with overwrite-oldest semantics, exactly like
+the reference validator's, and therefore answers "what is happening"
+but never "what happened 30 seconds ago" once the rings wrap or the
+workspace is unlinked. This package is the missing primitive under
+ROADMAP items 3 (cluster judge needs node-tagged telemetry) and 5
+(offline autotuning needs per-host history): a bounded, append-only,
+crash-tolerant on-disk archive of the shm observability plane, drained
+by a reader-side recorder tile (disco/tiles.py FlightAdapter — the
+fdmetrics contract: zero writer-side cost) and queried post-mortem by
+`tools/fdflight`, `monitor --json --archive`, and the fdgui history
+panel.
+
+Config — the `[flight]` topology section, validated by the standard
+triple (config load here, topo.build, fdlint's bad-flight rule with
+the registry mirror in lint/registry.py FLIGHT_SECTION_KEYS):
+
+    [flight]
+    dir       = "/tmp/fdtpu-flight/fdtpu"   # archive directory
+    segment_mb = 8.0       # rotate the active segment at this size
+    retain_mb  = 64.0      # age out oldest segments beyond this total
+    hz         = 4.0       # recorder drain cadence
+    sources    = ["metrics", "links", "slo", "trace", "prof"]
+    incident_window_s = 5.0   # +/- bundle window around an SLO breach
+    node_id    = 0         # stamped into every frame (cluster merge)
+
+On-disk format: fixed-width 64-byte binary frames (flight/codec.py —
+monotonic_ns | node_id | kind | source | name | value), segments named
+`seg-*.fdf` under `dir` (flight/archive.py), incident bundles sealed
+atomically next to them (flight/recorder.py). Torn tail frames from a
+SIGKILL mid-write are detected by per-frame magic+CRC and dropped on
+read, never propagated.
+"""
+from __future__ import annotations
+
+FLIGHT_DEFAULTS = {
+    "dir": "/tmp/fdtpu-flight/default",
+    "segment_mb": 8.0,
+    "retain_mb": 64.0,
+    "hz": 4.0,
+    "sources": None,        # None = every source family
+    "incident_window_s": 5.0,
+    "node_id": 0,
+}
+
+# the frame-source families the recorder can drain (codec kinds map
+# onto these; `sources` selects a subset)
+FLIGHT_SOURCES = ("metrics", "links", "slo", "trace", "prof")
+
+
+def _suggest(key: str, candidates) -> str:
+    # the ONE did-you-mean helper (lint/registry.py); lazy so the
+    # recorder hot path never pays the lint import
+    from ..lint.registry import suggest
+    return suggest(key, candidates)
+
+
+def normalize_flight(spec) -> dict:
+    """Validate + default-fill a `[flight]` table. Returns a plain
+    JSON-able dict; raises ValueError with a did-you-mean on typos —
+    the same fail-before-launch stance as normalize_trace."""
+    out = dict(FLIGHT_DEFAULTS)
+    if spec is None:
+        return out
+    if not isinstance(spec, dict):
+        raise ValueError(f"flight spec must be a table, got {spec!r}")
+    unknown = set(spec) - set(FLIGHT_DEFAULTS)
+    if unknown:
+        key = sorted(unknown)[0]
+        raise ValueError(f"unknown flight key(s) {sorted(unknown)}"
+                         + _suggest(key, FLIGHT_DEFAULTS))
+    out.update(spec)
+    d = out["dir"]
+    if not isinstance(d, str) or not d:
+        raise ValueError(f"flight.dir must be a non-empty path, got {d!r}")
+    seg = out["segment_mb"] = float(out["segment_mb"])
+    if seg <= 0:
+        raise ValueError(f"flight.segment_mb must be > 0, got {seg}")
+    ret = out["retain_mb"] = float(out["retain_mb"])
+    if ret < seg:
+        raise ValueError(f"flight.retain_mb ({ret}) must be >= "
+                         f"segment_mb ({seg}) — retention below one "
+                         f"segment keeps no history at all")
+    hz = out["hz"] = float(out["hz"])
+    if not 0 < hz <= 1000:
+        raise ValueError(f"flight.hz must be in (0, 1000], got {hz}")
+    win = out["incident_window_s"] = float(out["incident_window_s"])
+    if win < 0:
+        raise ValueError(
+            f"flight.incident_window_s must be >= 0, got {win}")
+    node = out["node_id"] = int(out["node_id"])
+    if not 0 <= node <= 0xFFFF:
+        raise ValueError(
+            f"flight.node_id must fit u16 (0..65535), got {node}")
+    srcs = out.get("sources")
+    if srcs is not None:
+        if not isinstance(srcs, (list, tuple)) or \
+                not all(isinstance(s, str) for s in srcs):
+            raise ValueError("flight.sources must be a list of source "
+                             f"names from {list(FLIGHT_SOURCES)}")
+        bad = sorted(set(srcs) - set(FLIGHT_SOURCES))
+        if bad:
+            raise ValueError(
+                f"unknown flight source(s) {bad}"
+                + _suggest(bad[0], FLIGHT_SOURCES))
+        out["sources"] = list(srcs)
+    return out
+
+
+def effective_sources(cfg: dict) -> set:
+    """The drained source families of a normalized [flight] table."""
+    srcs = cfg.get("sources")
+    return set(FLIGHT_SOURCES if srcs is None else srcs)

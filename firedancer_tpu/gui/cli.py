@@ -5,11 +5,16 @@
         [--bench 'BENCH_r*.json']                #  + trend charts
     tools/fdgui --bench 'BENCH_r*.json' --report out.html
                                                  # bench-only report
+    tools/fdgui <topology> --report out.html --archive DIR
+                                                 # shm gone? fall back
+                                                 # to the fdflight dir
 
 Attaches via the plan JSON the runner drops in /dev/shm (the monitor
 CLI's discipline), so the report works POST-MORTEM: the workspace
 outlives the tiles, and a crashed run's final counters, SLO breach
-history and folded stacks all land in the artifact.
+history and folded stacks all land in the artifact. When even the shm
+is gone (reboot, unlink), --archive renders the history tab from the
+fdflight on-disk archive alone.
 """
 from __future__ import annotations
 
@@ -29,12 +34,22 @@ def main(argv=None) -> int:
                     help="write the self-contained HTML artifact")
     ap.add_argument("--bench", metavar="GLOB",
                     help="BENCH_r*.json glob for the trend charts")
+    ap.add_argument("--archive", metavar="DIR",
+                    help="fdflight archive dir: post-mortem history "
+                         "fallback when the topology's shm is gone")
     args = ap.parse_args(argv)
 
     if args.topology is None:
+        if args.report and args.archive:
+            from .report import report_from_archive
+            out = report_from_archive(args.archive, args.report,
+                                      bench_glob=args.bench)
+            print(f"fdgui: wrote {out} (archive-only, "
+                  f"{args.archive})")
+            return 0
         if not (args.report and args.bench):
-            ap.error("without a topology, --report and --bench are "
-                     "both required (bench-only report)")
+            ap.error("without a topology, --report plus --bench or "
+                     "--archive is required")
         from .report import report_from_bench
         paths = sorted(glob.glob(args.bench))
         if not paths:
@@ -51,8 +66,17 @@ def main(argv=None) -> int:
             out = report_from_shm(args.topology, args.report,
                                   bench_glob=args.bench)
         except FileNotFoundError:
+            if args.archive:   # shm gone: render from disk alone
+                from .report import report_from_archive
+                out = report_from_archive(args.archive, args.report,
+                                          bench_glob=args.bench,
+                                          topology=args.topology)
+                print(f"fdgui: shm gone for {args.topology!r}; wrote "
+                      f"{out} from archive {args.archive}")
+                return 0
             print(f"fdgui: no plan for topology {args.topology!r} "
-                  f"(is it running, or was its shm unlinked?)",
+                  f"(is it running, or was its shm unlinked? "
+                  f"--archive DIR renders from the fdflight dir)",
                   file=sys.stderr)
             return 1
         print(f"fdgui: wrote {out}")

@@ -20,8 +20,10 @@ Rendered surfaces: live topology graph (links colored by activity /
 backpressure, the saturating hop highlighted), per-tile occupancy
 sparklines, the tile table (state / heartbeat / metrics / latency),
 an SLO status + breach-history panel, an on-demand flamegraph view
-over fdprof folded stacks, and the bench-trend page over
-BENCH_r*.json rounds.
+over fdprof folded stacks, the bench-trend page over BENCH_r*.json
+rounds, and a history tab backed by the fdflight on-disk archive
+(`/history.json` live, `DATA.history` in reports) so sparklines
+survive shm ring wraps and workspace teardown.
 """
 from __future__ import annotations
 
@@ -79,6 +81,7 @@ border-radius:3px;padding:0 6px;margin:1px 4px 1px 0;font-size:11px}
 <button data-tab="slo">slo</button>
 <button data-tab="flame">flamegraph</button>
 <button data-tab="bench">bench trends</button>
+<button data-tab="history">history</button>
 </nav>
 <section id="tab-topo">
 <svg id="graph" width="960" height="10"></svg>
@@ -99,6 +102,9 @@ border-radius:3px;padding:0 6px;margin:1px 4px 1px 0;font-size:11px}
 </div></section>
 <section id="tab-bench" hidden><div id="bench">
 <small>no bench rounds loaded</small></div></section>
+<section id="tab-history" hidden><div id="history">
+<small>no flight archive loaded (is [flight] enabled?)</small></div>
+</section>
 <!--FDGUI_DATA-->
 <script>
 "use strict";
@@ -115,7 +121,8 @@ for(const b of document.querySelectorAll("nav button")){
   for(const s of document.querySelectorAll("section"))
    s.hidden=s.id!=="tab-"+b.dataset.tab;
   if(b.dataset.tab==="flame")loadFlame();
-  if(b.dataset.tab==="bench")loadBench();};}
+  if(b.dataset.tab==="bench")loadBench();
+  if(b.dataset.tab==="history")loadHistory();};}
 
 /* ---- topology graph: longest-path layering, SVG nodes + edges ---- */
 function layering(s){
@@ -328,7 +335,12 @@ function renderBench(rows){
   "found</small>";return;}
  for(const[key,label]of[["value","kernel verifies/s"],
    ["e2e_tps","e2e pipeline tps"],["e2e_knee_tps","e2e knee tps"],
-   ["e2e_leader_knee_tps","leader-loop knee tps"]]){
+   ["e2e_leader_knee_tps","leader-loop knee tps"],
+   ["exec_scale_tps_1","exec-scale tps (1 shard)"],
+   ["exec_scale_tps_2","exec-scale tps (2 shards)"],
+   ["exec_scale_tps_4","exec-scale tps (4 shards)"],
+   ["replay_tps","replay slots/s"],
+   ["catchup_s","catch-up seconds (lower is better)"]]){
   const pts=rows.map((r,i)=>[i,r[key]]).filter(p=>p[1]!=null);
   const div=document.createElement("div");div.className="chart";
   const max=Math.max(...pts.map(p=>p[1]),1);
@@ -350,6 +362,52 @@ function renderBench(rows){
   div.innerHTML="<h3>"+label+(pts.length?" (max "+fmt(max)+")":
    " (no data)")+"</h3>"+svg;
   root.appendChild(div);}
+}
+
+/* ---- history: flight-archive sparklines (fdflight on-disk) ---- */
+let histLoaded=false;
+function loadHistory(){
+ if(histLoaded)return;histLoaded=true;
+ if(DATA){renderHistory(DATA.history||null);return;}
+ fetch("history.json").then(r=>r.ok?r.json():null).then(renderHistory)
+  .catch(()=>{histLoaded=false;});
+}
+function renderHistory(h){
+ const root=$("history");
+ if(!h||!h.series||!Object.keys(h.series).length){root.innerHTML=
+  "<small>no flight archive loaded (is [flight] enabled?)</small>";
+  return;}
+ root.innerHTML="";
+ const span=(h.t1_ns-h.t0_ns)/1e9;
+ const hd=document.createElement("div");
+ hd.innerHTML="<small>archive window "+span.toFixed(1)+"s · "+
+  Object.keys(h.series).length+" series"+
+  (h.dropped?" · <span class='FAIL'>"+h.dropped+
+   " torn frames dropped</span>":"")+"</small>";
+ root.appendChild(hd);
+ const W=680,H=70,t0=h.t0_ns,tn=Math.max(1,h.t1_ns-h.t0_ns);
+ const X=ts=>30+(ts-t0)*(W-60)/tn;
+ for(const key of Object.keys(h.series).sort()){
+  const pts=h.series[key];if(!pts.length)continue;
+  const max=Math.max(...pts.map(p=>p[1]),1);
+  const div=document.createElement("div");div.className="chart";
+  let svg="<svg width='"+W+"' height='"+H+"'>";
+  /* SLO transitions as vertical markers: red=breach, green=clear */
+  for(const e of h.slo||[]){
+   const x=X(e.ts),col=e.kind==="breach"?"#f7768e":"#9ece6a";
+   svg+="<line x1='"+x+"' y1='6' x2='"+x+"' y2='"+(H-14)+
+    "' stroke='"+col+"' stroke-dasharray='2,2'>"+
+    "<title>"+e.kind+" "+e.target+"</title></line>";}
+  svg+="<polyline fill='none' stroke='#7aa2f7' stroke-width='1.5' "+
+   "points='"+pts.map(p=>X(p[0])+","+
+   (H-14-(p[1]/max)*(H-26))).join(" ")+"'/></svg>";
+  div.innerHTML="<h3>"+key+" (max "+fmt(max)+")</h3>"+svg;
+  root.appendChild(div);}
+ if((h.marks||[]).length){
+  const mk=document.createElement("div");
+  mk.innerHTML="<small>marks: "+h.marks.map(m=>m.name).join(", ")+
+   "</small>";
+  root.appendChild(mk);}
 }
 
 /* ---- provenance / witness header (fdwitness chain summary) ---- */
@@ -394,7 +452,7 @@ if(DATA){
  boot(DATA.snapshot);
  renderProv(DATA.witness||null);
  for(const d of DATA.deltas||[])applyDelta(d);
- loadFlame();loadBench();
+ loadFlame();loadBench();loadHistory();
 }else{
  (function connect(){
   const ws=new WebSocket((location.protocol==="https:"?"wss://":

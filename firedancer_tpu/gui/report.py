@@ -60,9 +60,61 @@ def bench_series(paths) -> list[dict]:
             # knee + its saturating hop ride every round's trend row
             "e2e_leader_knee_tps": _num("e2e_leader_knee_tps"),
             "e2e_leader_hop": rec.get("e2e_leader_hop"),
+            # exec-scaling (r16) + follower catch-up (r17) trends
+            "exec_scale_tps_1": _num("exec_scale_tps_1"),
+            "exec_scale_tps_2": _num("exec_scale_tps_2"),
+            "exec_scale_tps_4": _num("exec_scale_tps_4"),
+            "replay_tps": _num("replay_tps"),
+            "catchup_s": _num("catchup_s"),
             "platform": rec.get("platform"),
         })
     return rows
+
+
+def history_series(flight_dir: str, max_series: int = 12,
+                   max_points: int = 400) -> dict:
+    """Flight-archive -> the history-panel payload: cumulative series
+    for the busiest counters, level series for moving gauges, SLO
+    transitions and run seams — sparklines backed by DISK, so the
+    panel (and the post-mortem report) shows what happened before the
+    shm rings wrapped or the workspace died."""
+    from ..flight.archive import read_frames
+    from ..flight.codec import KIND_MARK, KIND_METRIC, KIND_SLO
+    frames, dropped = read_frames(flight_dir)
+    series: dict[str, list] = {}
+    totals: dict[str, int] = {}
+    cum: dict[str, int] = {}
+    for fr in frames:
+        if fr["kind"] != KIND_METRIC:
+            continue
+        key = f"{fr['source']}.{fr['name']}"
+        if fr["aux"] & 1:
+            v = fr["value"]
+        else:
+            v = cum.get(key, 0) + fr["value"]
+            cum[key] = v
+            totals[key] = totals.get(key, 0) + fr["value"]
+        series.setdefault(key, []).append([fr["ts"], v])
+    keep = sorted(totals, key=lambda k: totals[k],
+                  reverse=True)[:max_series]
+    gauges = [k for k in series if k not in totals
+              and len({v for _, v in series[k]}) > 1]
+    out_series = {}
+    for k in [*keep, *gauges[:max_series]]:
+        pts = series[k]
+        if len(pts) > max_points:
+            step = len(pts) / max_points
+            pts = [pts[int(i * step)] for i in range(max_points)]
+        out_series[k] = pts
+    slo = [{"ts": fr["ts"], "target": fr["source"],
+            "kind": fr["name"], "value": fr["value"]}
+           for fr in frames if fr["kind"] == KIND_SLO]
+    marks = [{"ts": fr["ts"], "name": fr["name"]}
+             for fr in frames if fr["kind"] == KIND_MARK]
+    return {"t0_ns": frames[0]["ts"] if frames else 0,
+            "t1_ns": frames[-1]["ts"] if frames else 0,
+            "dropped": dropped, "series": out_series,
+            "slo": slo[-64:], "marks": marks[-64:]}
 
 
 def _gui_tile_args(plan: dict) -> dict:
@@ -99,8 +151,15 @@ def collect(plan: dict, wksp, deltas: int = 2,
         flame = read_folded(plan, wksp)
     except Exception:   # noqa: BLE001 — a torn prof region loses the
         flame = {}      # flame tab, never the whole artifact
+    history = None
+    flight_dir = (plan.get("flight") or {}).get("dir")
+    if flight_dir:
+        try:
+            history = history_series(flight_dir)
+        except Exception:   # noqa: BLE001 — an unreadable archive
+            history = None  # loses the history tab, not the artifact
     return {"snapshot": snapshot_doc(plan), "deltas": docs,
-            "flame": flame}
+            "flame": flame, "history": history}
 
 
 def render_html(data: dict) -> str:
@@ -171,6 +230,29 @@ def report_from_shm(topology: str, out_path: str,
     data["bench"] = bench_series(sorted(glob.glob(bench_glob))) \
         if bench_glob else []
     data["witness"] = witness_panel_data(witness)
+    with open(out_path, "w") as f:
+        f.write(render_html(data))
+    return out_path
+
+
+def report_from_archive(flight_dir: str, out_path: str,
+                        bench_glob: str | None = None,
+                        topology: str = "") -> str:
+    """Post-mortem artifact from the fdflight archive ALONE: no shm
+    workspace needed — the history tab (sparklines, SLO transitions,
+    run seams) renders from disk, which is the whole point of the
+    flight recorder when the run is long gone."""
+    history = history_series(flight_dir)
+    data = {
+        "snapshot": {"type": "snapshot", "v": 2,
+                     "topology": topology or f"archive {flight_dir}",
+                     "cfg_digest": "-", "tiles": {}, "links": {},
+                     "slo": {"targets": []}},
+        "deltas": [], "flame": {}, "history": history,
+        "bench": bench_series(sorted(glob.glob(bench_glob)))
+        if bench_glob else [],
+        "witness": witness_panel_data(None),
+    }
     with open(out_path, "w") as f:
         f.write(render_html(data))
     return out_path

@@ -450,6 +450,8 @@ SECTION_MIRRORS = (
      "SNAPSHOT_SECTION_KEYS", ()),
     ("witness", "witness/plan.py", "WITNESS_DEFAULTS",
      "WITNESS_SECTION_KEYS", ("stage",)),
+    ("flight", "flight/__init__.py", "FLIGHT_DEFAULTS",
+     "FLIGHT_SECTION_KEYS", ()),
 )
 
 _ADAPTERS_SUFFIX = "disco/tiles.py"

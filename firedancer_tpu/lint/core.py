@@ -134,6 +134,14 @@ RULES: dict[str, tuple[str, str, str]] = {
         "min_slot, chunk < 64) — the snapshot path/cadence the "
         "snapld/snapin/replay tiles share must validate at review, "
         "not mid-restore"),
+    "bad-flight": (
+        "graph", "error",
+        "[flight] section rejected by the flight/__init__.py schema "
+        "(unknown key with did-you-mean, empty dir, segment_mb <= 0, "
+        "retain_mb < segment_mb, hz out of (0,1000], negative "
+        "incident_window_s, node_id not u16, unknown source family) — "
+        "the telemetry-archive config must validate at review, not "
+        "when the recorder tile boots"),
     # -- tile-contract family (lint/contracts.py) ------------------------
     "reserved-metric": (
         "contract", "error",

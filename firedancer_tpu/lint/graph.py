@@ -86,7 +86,8 @@ def model_from_config(cfg: dict) -> dict:
             "prof": cfg.get("prof"), "shed": cfg.get("shed"),
             "witness": cfg.get("witness"), "funk": cfg.get("funk"),
             "replay": cfg.get("replay"),
-            "snapshot": cfg.get("snapshot")}
+            "snapshot": cfg.get("snapshot"),
+            "flight": cfg.get("flight")}
 
 
 def model_from_topology(topo) -> dict:
@@ -106,7 +107,8 @@ def model_from_topology(topo) -> dict:
             "witness": getattr(topo, "witness", None),
             "funk": getattr(topo, "funk", None),
             "replay": getattr(topo, "replay", None),
-            "snapshot": getattr(topo, "snapshot", None)}
+            "snapshot": getattr(topo, "snapshot", None),
+            "flight": getattr(topo, "flight", None)}
 
 
 # ---------------------------------------------------------------------------
@@ -256,6 +258,7 @@ def _check_model(model: dict, path: str, lines: _Lines) -> list[Finding]:
     out.extend(_check_funk(model, path))
     out.extend(_check_replay(model, path))
     out.extend(_check_snapshot(model, path))
+    out.extend(_check_flight(model, path))
     return out
 
 
@@ -322,6 +325,24 @@ def _check_snapshot(model, path) -> list[Finding]:
         except Exception as e:
             out.append(finding("bad-snapshot", path, 0,
                                f"[snapshot]: {e}"))
+    return out
+
+
+def _check_flight(model, path) -> list[Finding]:
+    """[flight] section: the flight/__init__.py schema gate (one
+    validator, same as config load and topo.build) — unknown keys,
+    empty dir, retention below one segment, out-of-range hz/node_id,
+    unknown source families all land as review-time findings with a
+    did-you-mean."""
+    from ..flight import normalize_flight
+    out: list[Finding] = []
+    spec = model.get("flight")
+    if spec is not None:
+        try:
+            normalize_flight(spec)
+        except Exception as e:
+            out.append(finding("bad-flight", path, 0,
+                               f"[flight]: {e}"))
     return out
 
 

@@ -91,6 +91,13 @@ REPLAY_SECTION_KEYS = ("exec_tile_cnt", "redispatch_s", "verify_poh",
 SNAPSHOT_SECTION_KEYS = ("path", "every_slots", "min_slot", "compress",
                          "chunk")
 
+# [flight] topology-section keys (mirror of flight/__init__.py
+# FLIGHT_DEFAULTS — tests/test_flight.py keeps the mirror honest).
+# Validated by normalize_flight at config load, topo.build, and the
+# graph analyzer's bad-flight rule.
+FLIGHT_SECTION_KEYS = ("dir", "segment_mb", "retain_mb", "hz",
+                       "sources", "incident_window_s", "node_id")
+
 # [witness] topology-section keys (mirror of witness/plan.py
 # WITNESS_DEFAULTS / WITNESS_STAGE_KEYS — tests/test_witness.py keeps
 # the mirror honest). Stage names in `stages` / [witness.stage.<name>]
@@ -178,6 +185,9 @@ TILE_ARGS: dict[str, dict[str, str | None]] = {
     "snapdc": {},
     "snapin": {"format": None, "min_slot": None},
     "metric": {"port": None, "bind_addr": None, "healthz_stale_s": None},
+    # flight recorder tile (r19): all configuration rides the plan's
+    # [flight] section — the adapter reads no args at all
+    "flight": {},
     "bundle": {"engine": None, "path": None, "authority": None},
     "plugin": {"sock_path": None, "data_hex_max": None},
     "netlnk": {},

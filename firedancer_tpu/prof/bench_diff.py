@@ -45,13 +45,23 @@ GATE_METRICS = (
     ("rlc_bulk_vps", "rlc bulk vps"),
     ("rlc_prefilter_vps", "rlc prefilter vps"),
     ("flood_goodput_tps", "flood goodput tps"),
-    # execution scale-out (r16): the exec-family leader loop's
-    # capacity at 2 exec tiles — the tile-count scaling contract
+    # execution scale-out (r16, widened r19): the exec-family leader
+    # loop's capacity at 1/2/4 exec tiles — the full scaling curve,
+    # so a regression that only shows at one shard count still gates
+    ("exec_scale_tps_1", "exec scale tps (1 tile)"),
     ("exec_scale_tps_2", "exec scale tps (2 tiles)"),
+    ("exec_scale_tps_4", "exec scale tps (4 tiles)"),
     # follower catch-up (r17): snapshot-restore + tail replay over the
     # exec family — the "become a follower" throughput contract
     ("replay_tps", "catch-up replay tps"),
 )
+
+# report-only metrics: lower-is-better (or too noisy to gate), so a
+# "drop" is an improvement — diffed and rendered, never gated
+REPORT_METRICS = (
+    ("catchup_s", "catch-up wall s (lower is better)"),
+)
+_REPORT_ONLY = frozenset(k for k, _ in REPORT_METRICS)
 
 # the knee subset: what bench.py's implicit previous-round gate
 # (FDTPU_BENCH_PREV unset -> latest BENCH_r*.json) compares — knee
@@ -146,7 +156,7 @@ def diff_bench(old: dict, new: dict) -> dict:
     """Structured delta document (JSON-able): gated metric moves,
     per-hop link-budget deltas, and profile top-k churn."""
     metrics = {}
-    for key, label in GATE_METRICS:
+    for key, label in (*GATE_METRICS, *REPORT_METRICS):
         (ov, osrc), (nv, nsrc) = (_metric_src(old, key),
                                   _metric_src(new, key))
         rec = {"label": label, "old": ov, "new": nv,
@@ -194,6 +204,8 @@ def gate_regressions(diff: dict, threshold: float = 0.05,
     out = []
     for key, rec in diff["metrics"].items():
         if keys is not None and key not in keys:
+            continue
+        if key in _REPORT_ONLY:
             continue
         frac = rec.get("frac")
         if frac is not None and frac < -threshold:

@@ -436,6 +436,20 @@ class TraceRing:
         idx = [(cur - n + i) & (self.depth - 1) for i in range(n)]
         return cur, recs[idx]
 
+    def snapshot_since(self, since: int) -> tuple[int, np.ndarray, int]:
+        """Incremental snapshot for periodic drainers (the fdflight
+        recorder): only records appended after a prior cursor value.
+        -> (cursor, records (n, 4) u64 oldest-first, lost) where `lost`
+        counts records overwritten before this pass could read them —
+        the drain cadence was slower than the write rate. Same torn-
+        record caveat as snapshot()."""
+        cur, recs = self.snapshot()
+        new = cur - since
+        if new <= 0:
+            return cur, recs[:0], 0
+        lost = max(0, new - len(recs))
+        return cur, recs[max(0, len(recs) - new):], lost
+
 
 FSEQ_STALE = (1 << 64) - 1    # sentinel: consumer excluded from fctl
 

@@ -89,9 +89,14 @@ def test_pcap_tile_replays_into_topology(tmp_path):
     runner = TopologyRunner(topo.build()).start()
     try:
         runner.wait_running(timeout_s=60)
+        # sink rx lands a housekeeping flush BEFORE the pcap tile's
+        # own tx/done counters do — poll both sides of the link to
+        # the same deadline, assert once, after
         deadline = time.time() + 30
         while time.time() < deadline:
-            if runner.metrics("sink")["rx"] >= 2 * len(pkts):
+            p = runner.metrics("pcap")
+            if (runner.metrics("sink")["rx"] >= 2 * len(pkts)
+                    and p["tx"] >= 2 * len(pkts) and p["done"]):
                 break
             time.sleep(0.05)
         assert runner.metrics("sink")["rx"] == 2 * len(pkts)

@@ -1,0 +1,395 @@
+"""fdflight (r19): durable flight-data archive — codec, segment
+rotation/retention, torn-tail recovery, recorder equivalence, incident
+bundles, and the post-mortem query surfaces.
+
+The acceptance spine, pinned live:
+
+* query-vs-live exactness: counters are archived as DELTAS with a zero
+  baseline, so re-integrating the archive reproduces the live /metrics
+  value EXACTLY — `fdflight --series --cumulative` is the same number
+  the scrape showed, just durable.
+* incident survivability: an SLO breach under seeded chaos seals a
+  self-contained bundle (frames around the breach, saturating hop,
+  embedded chrome trace); SIGKILL of every tile afterwards loses
+  nothing — the bundle still exports to Perfetto from disk alone.
+* torn tails are detected and dropped on read, never propagated.
+"""
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from firedancer_tpu.flight import (FLIGHT_DEFAULTS, FLIGHT_SOURCES,
+                                   normalize_flight)
+from firedancer_tpu.flight.archive import (ArchiveWriter, cumulative,
+                                           incident_paths, read_frames,
+                                           series, window_summary,
+                                           write_atomic_json)
+from firedancer_tpu.flight.codec import (FRAME_SZ, KIND_LINK,
+                                         KIND_MARK, KIND_METRIC,
+                                         KIND_SLO, KIND_TRACE,
+                                         decode_frame, decode_frames,
+                                         encode_frame)
+
+pytestmark = pytest.mark.flight
+
+os.environ.setdefault("FDTPU_JAX_PLATFORM", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+# ---------------------------------------------------------------------------
+
+def test_codec_roundtrip_all_fields():
+    buf = encode_frame(KIND_METRIC, 123_456_789, 7, "verify", "rx",
+                       -42, aux=1)
+    assert len(buf) == FRAME_SZ
+    fr = decode_frame(buf)
+    assert fr == {"ts": 123_456_789, "node": 7, "kind": KIND_METRIC,
+                  "kind_name": "metric", "ver": fr["ver"],
+                  "source": "verify", "name": "rx", "value": -42,
+                  "aux": 1}
+
+
+def test_codec_names_truncate_utf8_safe():
+    # a >16-byte name with a multibyte char straddling the cut must
+    # not decode to mojibake or raise
+    fr = decode_frame(encode_frame(KIND_METRIC, 1, 0,
+                                   "tile_with_longéname",
+                                   "m" * 40, 1))
+    assert fr is not None
+    assert len(fr["source"].encode()) <= 16
+    assert fr["name"] == "m" * 16
+
+
+def test_codec_rejects_short_corrupt_and_wrong_magic():
+    buf = encode_frame(KIND_LINK, 5, 0, "a_b", "pub", 9)
+    assert decode_frame(buf) is not None                  # sanity
+    assert decode_frame(buf[:FRAME_SZ - 1]) is None       # torn tail
+    corrupt = buf[:20] + bytes([buf[20] ^ 0x5A]) + buf[21:]
+    assert decode_frame(corrupt) is None                  # bad CRC
+    assert decode_frame(b"\x00" * FRAME_SZ) is None       # bad magic
+
+
+def test_decode_frames_counts_torn_slots():
+    good = encode_frame(KIND_METRIC, 1, 0, "t", "m", 1) \
+        + encode_frame(KIND_METRIC, 2, 0, "t", "m", 2)
+    frames, dropped = decode_frames(good + b"\xde\xad\xbe")
+    assert [f["value"] for f in frames] == [1, 2]
+    assert dropped == 1                                   # the partial
+    frames, dropped = decode_frames(good[:FRAME_SZ] +
+                                    b"\x00" * FRAME_SZ + good[FRAME_SZ:])
+    assert [f["value"] for f in frames] == [1, 2]
+    assert dropped == 1                                   # the bad slot
+
+
+# ---------------------------------------------------------------------------
+# [flight] schema
+# ---------------------------------------------------------------------------
+
+def test_normalize_flight_fills_defaults():
+    cfg = normalize_flight({"dir": "/tmp/x"})
+    assert set(cfg) == set(FLIGHT_DEFAULTS)
+    assert cfg["hz"] == FLIGHT_DEFAULTS["hz"]
+
+
+def test_normalize_flight_rejections():
+    with pytest.raises(ValueError, match="segment_mb"):
+        normalize_flight({"segmnt_mb": 4.0})              # did-you-mean
+    with pytest.raises(ValueError):
+        normalize_flight({"hz": 0})
+    with pytest.raises(ValueError):
+        normalize_flight({"hz": 2000})
+    with pytest.raises(ValueError):
+        normalize_flight({"segment_mb": 8.0, "retain_mb": 1.0})
+    with pytest.raises(ValueError):
+        normalize_flight({"dir": ""})
+    with pytest.raises(ValueError):
+        normalize_flight({"node_id": 1 << 16})
+    with pytest.raises(ValueError, match="links"):
+        normalize_flight({"sources": ["linkz"]})
+    assert normalize_flight({"sources": list(FLIGHT_SOURCES)})
+
+
+# ---------------------------------------------------------------------------
+# archive writer: rotation, retention, atomicity
+# ---------------------------------------------------------------------------
+
+def test_segment_rotation_and_retention(tmp_path):
+    d = str(tmp_path / "arch")
+    # ~16 frames per segment, keep ~2 segments
+    w = ArchiveWriter(d, segment_mb=0.001, retain_mb=0.002)
+    n = 200
+    for i in range(n):
+        w.append(KIND_METRIC, 1000 + i, "t", "m", 1)
+    w.close()
+    assert w.frames == n
+    assert w.rotations > 5
+    assert w.aged_out > 0
+    segs = [p for p in os.listdir(d) if p.endswith(".fdf")]
+    # retention honored (active segment exempt, hence the slack)
+    assert 0 < len(segs) <= 4
+    frames, dropped = read_frames(d)
+    assert dropped == 0
+    # the tail of history survives in order; the head aged out
+    assert [f["ts"] for f in frames] == sorted(f["ts"] for f in frames)
+    assert frames[-1]["ts"] == 1000 + n - 1
+    assert len(frames) < n
+
+
+def test_retention_never_deletes_active_segment(tmp_path):
+    d = str(tmp_path / "arch")
+    w = ArchiveWriter(d, segment_mb=0.001, retain_mb=0.001)
+    for i in range(40):
+        w.append(KIND_METRIC, i, "t", "m", 1)
+    w.flush()
+    # the frame just written is always readable back
+    frames, _ = read_frames(d)
+    assert frames and frames[-1]["ts"] == 39
+    w.close()
+
+
+def test_torn_tail_dropped_on_read_after_kill(tmp_path):
+    """A writer SIGKILLed mid-frame leaves a torn tail; readers must
+    drop exactly the torn slot and keep everything before it."""
+    d = str(tmp_path / "arch")
+    w = ArchiveWriter(d)
+    for i in range(10):
+        w.append(KIND_METRIC, i, "t", "m", 1)
+    w.flush()
+    seg = w._f.name
+    w.close()
+    with open(seg, "ab") as f:     # simulate the torn final write
+        f.write(encode_frame(KIND_METRIC, 99, 0, "t", "m", 1)[:17])
+    frames, dropped = read_frames(d)
+    assert len(frames) == 10 and dropped == 1
+    assert all(f["ts"] != 99 for f in frames)
+
+
+def test_write_atomic_json_no_partial(tmp_path):
+    path = str(tmp_path / "inc.json")
+    write_atomic_json(path, {"ok": 1})
+    with open(path) as f:
+        assert json.load(f) == {"ok": 1}
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
+# ---------------------------------------------------------------------------
+# query helpers + CLI over a synthetic archive
+# ---------------------------------------------------------------------------
+
+def _synthetic_archive(d: str):
+    """Two drain passes of metric deltas + a link + an SLO transition
+    + marks: enough shape for every query surface."""
+    w = ArchiveWriter(d, node_id=3)
+    t0 = 1_000_000_000
+    w.append(KIND_MARK, t0, "demo", "boot", 1)
+    w.append(KIND_METRIC, t0 + 100, "sink", "rx", 5)       # delta
+    w.append(KIND_LINK, t0 + 100, "a_b", "backpressure", 2)
+    w.append(KIND_METRIC, t0 + 200, "sink", "rx", 7)       # delta
+    w.append(KIND_METRIC, t0 + 200, "sink", "depth", 4, aux=1)  # gauge
+    w.append(KIND_SLO, t0 + 250, "lat", "breach", 9, 1)
+    w.append(KIND_MARK, t0 + 300, "demo", "halt", 1)
+    w.close()
+    return t0
+
+
+def test_series_and_cumulative(tmp_path):
+    d = str(tmp_path / "arch")
+    t0 = _synthetic_archive(d)
+    frames, dropped = read_frames(d)
+    assert dropped == 0 and all(f["node"] == 3 for f in frames)
+    pts = series(frames, "sink", "rx")
+    assert pts == [(t0 + 100, 5), (t0 + 200, 7)]
+    assert cumulative(pts) == [(t0 + 100, 5), (t0 + 200, 12)]
+    summ = window_summary(frames)
+    assert summ["metrics"]["sink.rx"]["total"] == 12
+
+
+def test_fdflight_cli_summary_slice_series_diff(tmp_path, capsys):
+    from firedancer_tpu.flight.cli import main
+    d = str(tmp_path / "arch")
+    t0 = _synthetic_archive(d)
+    assert main([d]) == 0
+    out = capsys.readouterr().out
+    assert "7 frames" in out and "incidents: 0" in out
+    # time-range slice to NDJSON: only the second pass
+    assert main([d, "--since", str(t0 + 150), "--ndjson"]) == 0
+    docs = [json.loads(ln) for ln in
+            capsys.readouterr().out.splitlines()]
+    assert {fr["name"] for fr in docs} >= {"rx", "depth"}
+    assert all(fr["ts"] >= t0 + 150 for fr in docs)
+    # series extraction, re-integrated
+    assert main([d, "--series", "sink.rx", "--cumulative"]) == 0
+    lines = capsys.readouterr().out.split()
+    assert lines[-1] == "12"
+    # kind filter + csv
+    assert main([d, "--kind", "slo", "--csv"]) == 0
+    assert "breach" in capsys.readouterr().out
+    # window diff: pass 1 vs pass 2 rates
+    assert main([d, "diff", f"{t0}:{t0 + 150}",
+                 f"{t0 + 150}:{t0 + 300}"]) == 0
+    assert "sink.rx" in capsys.readouterr().out
+
+
+def test_monitor_archive_snapshots_reintegrates(tmp_path):
+    """monitor --archive replays the archive as the same per-pass
+    document shape `monitor --json` emits live — counters re-integrated
+    so each doc equals what /metrics showed at that instant."""
+    from firedancer_tpu.disco.monitor import archive_snapshots
+    d = str(tmp_path / "arch")
+    t0 = _synthetic_archive(d)
+    docs = archive_snapshots(d)
+    assert len(docs) == 2
+    assert docs[0]["tiles"]["sink"]["rx"] == 5
+    assert docs[1]["tiles"]["sink"]["rx"] == 12            # integrated
+    assert docs[1]["tiles"]["sink"]["depth"] == 4          # level
+    assert docs[0]["links"]["a_b"]["backpressure"] == 2
+    # --since resumes after a cursor
+    assert [d2["ts"] for d2 in archive_snapshots(d, since_ns=t0 + 100)] \
+        == [t0 + 200]
+
+
+def test_history_series_payload(tmp_path):
+    from firedancer_tpu.gui.report import history_series
+    d = str(tmp_path / "arch")
+    t0 = _synthetic_archive(d)
+    h = history_series(d)
+    assert h["series"]["sink.rx"] == [[t0 + 100, 5], [t0 + 200, 12]]
+    assert h["slo"] == [{"ts": t0 + 250, "target": "lat",
+                         "kind": "breach", "value": 9}]
+    assert [m["name"] for m in h["marks"]] == ["boot", "halt"]
+    assert h["t0_ns"] == t0 and h["dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# live: recorder equivalence + incident survivability (tier-1, no jax)
+# ---------------------------------------------------------------------------
+
+def test_recorder_archive_equals_live_metrics(tmp_path):
+    """The exactness contract: counters ride as deltas with a zero
+    baseline, so the re-integrated archive == the live /metrics value,
+    not approximately — and the halt-path final drain catches the tail
+    between the last housekeeping pass and shutdown."""
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    d = str(tmp_path / "arch")
+    count = 900
+    topo = (
+        Topology(f"fleq{os.getpid()}", wksp_size=1 << 22,
+                 flight={"dir": d, "hz": 100.0, "node_id": 5,
+                         "incident_window_s": 0.0})
+        .link("a_b", depth=64, mtu=256)
+        .tile("a", "synth", outs=["a_b"], count=count, unique=32,
+              burst=16)
+        .tile("b", "sink", ins=["a_b"])
+        .tile("flight", "flight")
+    )
+    runner = TopologyRunner(topo.build()).start()
+    try:
+        runner.wait_running(timeout_s=120)
+        runner.wait_idle("b", "rx", count, timeout_s=120)
+        live_rx = runner.metrics("b")["rx"]
+        live_tx = runner.metrics("a")["tx"]
+        deadline = time.time() + 30
+        while runner.metrics("flight").get("frames", 0) == 0 \
+                and time.time() < deadline:
+            runner.check_failures()
+            time.sleep(0.02)
+        assert runner.metrics("flight")["drains"] > 0
+    finally:
+        runner.halt(join_timeout_s=10)
+        runner.close()
+    frames, dropped = read_frames(d)
+    assert dropped == 0
+    assert all(f["node"] == 5 for f in frames)
+    got_rx = sum(f["value"] for f in frames
+                 if f["kind"] == KIND_METRIC and f["source"] == "b"
+                 and f["name"] == "rx")
+    got_tx = sum(f["value"] for f in frames
+                 if f["kind"] == KIND_METRIC and f["source"] == "a"
+                 and f["name"] == "tx")
+    assert got_rx == live_rx == count                      # EXACT
+    assert got_tx == live_tx
+    marks = [f["name"] for f in frames if f["kind"] == KIND_MARK]
+    assert marks[0] == "boot" and marks[-1] == "halt"
+
+
+@pytest.mark.chaos
+def test_slo_breach_seals_incident_that_survives_sigkill(tmp_path):
+    """The r19 acceptance drill: seeded stall_fseq chaos drives an SLO
+    breach; the flight tile seals a self-contained incident bundle;
+    then every tile is SIGKILLed — and the bundle still lists, loads,
+    and exports its embedded chrome trace via the fdflight CLI, with
+    the archive's torn tail (if any) detected and dropped."""
+    from firedancer_tpu.disco import Topology, TopologyRunner
+    from firedancer_tpu.disco.slo import slo_dump_path
+    from firedancer_tpu.flight.cli import main as fdflight
+    d = str(tmp_path / "arch")
+    topo = (
+        Topology(f"flinc{os.getpid()}", wksp_size=1 << 22,
+                 trace={"enable": True, "depth": 1024, "sample": 1},
+                 slo={"fast_window_s": 0.5, "slow_window_s": 10.0,
+                      "target": [{
+                          "name": "sink-bp",
+                          "expr": "link.a_b.backpressure rate < 5/s"}]},
+                 flight={"dir": d, "hz": 50.0,
+                         "incident_window_s": 0.5})
+        .link("a_b", depth=32, mtu=256)
+        .tile("a", "synth", outs=["a_b"], count=1_000_000, unique=16,
+              burst=8)
+        .tile("b", "sink", ins=["a_b"],
+              chaos={"events": [{"action": "stall_fseq", "at_rx": 8}]})
+        .tile("metric", "metric", port=0)
+        .tile("flight", "flight")
+    )
+    runner = TopologyRunner(topo.build()).start()
+    sealed = None
+    try:
+        runner.wait_running(timeout_s=540)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            runner.check_failures()
+            incs = incident_paths(d)
+            if incs:
+                sealed = incs[0]
+                break
+            time.sleep(0.05)
+        assert sealed, (runner.metrics("metric"),
+                        runner.metrics("flight"))
+        # chaos half 2: SIGKILL every tile — no clean halt, no final
+        # drain, the disk state is all that survives
+        for p in runner.procs.values():
+            if p.pid and p.is_alive():
+                os.kill(p.pid, signal.SIGKILL)
+        time.sleep(0.2)
+    finally:
+        runner.halt(join_timeout_s=5)
+        runner.close()
+        try:
+            os.unlink(slo_dump_path(f"flinc{os.getpid()}", "sink-bp"))
+        except OSError:
+            pass
+    with open(sealed) as f:
+        doc = json.load(f)
+    assert doc["target"] == "sink-bp" and doc["value"] > 0
+    assert doc["slo_dump"]["kind"] == "breach"
+    assert doc["saturating_hop"] == "a_b"
+    # the ±window frames captured the damage around the breach
+    bp = [f for f in doc["frames"] if f["kind"] == KIND_LINK
+          and f["name"] == "backpressure"]
+    assert bp and sum(f["value"] for f in bp) > 0
+    assert any(f["kind"] == KIND_TRACE for f in doc["frames"])
+    # chrome trace embedded at seal time -> exports with shm long gone
+    out = str(tmp_path / "incident.chrome.json")
+    assert fdflight([d, "--incident", os.path.basename(sealed),
+                     "--out", out]) == 0
+    with open(out) as f:
+        chrome = json.load(f)
+    assert chrome["traceEvents"]
+    # the archive itself reads back post-SIGKILL; torn tails (the
+    # killed writer's last partial frame) are dropped, not propagated
+    frames, _dropped = read_frames(d)
+    assert frames and any(f["kind"] == KIND_SLO and
+                          f["name"] == "breach" for f in frames)

@@ -456,6 +456,42 @@ def test_replay_snapshot_registry_mirrors():
     assert set(SNAPSHOT_SECTION_KEYS) == set(SNAPSHOT_DEFAULTS)
 
 
+def test_bad_flight_schema_and_did_you_mean():
+    # typo'd [flight] key: the flight/__init__.py schema gate
+    findings = lint_config(_cfg(flight={"segmnt_mb": 8.0}),
+                           "<fixture>")
+    fires_once(findings, "bad-flight")
+    assert "did you mean 'segment_mb'" in findings[0].message
+    # out-of-range values
+    fires_once(lint_config(_cfg(flight={"hz": 0}),
+                           "<fixture>"), "bad-flight")
+    fires_once(lint_config(_cfg(flight={"segment_mb": 8.0,
+                                        "retain_mb": 1.0}),
+                           "<fixture>"), "bad-flight")
+    # unknown source with suggestion
+    findings = lint_config(_cfg(flight={"sources": ["metrics",
+                                                    "linkz"]}),
+                           "<fixture>")
+    fires_once(findings, "bad-flight")
+    assert "did you mean 'links'" in findings[0].message
+
+
+def test_flight_section_is_clean_when_valid():
+    cfg = _cfg(flight={"dir": "/tmp/fl", "segment_mb": 4.0,
+                       "retain_mb": 32.0, "hz": 8.0,
+                       "sources": ["metrics", "links", "slo"],
+                       "incident_window_s": 2.0, "node_id": 3})
+    assert lint_config(cfg, "<fixture>") == []
+
+
+def test_flight_registry_mirror():
+    """FLIGHT_SECTION_KEYS mirrors the validator's defaults table —
+    same contract the replay/snapshot mirrors pin."""
+    from firedancer_tpu.flight import FLIGHT_DEFAULTS
+    from firedancer_tpu.lint.registry import FLIGHT_SECTION_KEYS
+    assert set(FLIGHT_SECTION_KEYS) == set(FLIGHT_DEFAULTS)
+
+
 def test_per_shard_ins_entry_expands_not_folds():
     """A sharded-tile per-shard ins entry (all-str list: shard k
     consumes entry[k]) must count every listed link as consumed — the
