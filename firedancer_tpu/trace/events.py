@@ -45,6 +45,10 @@ EV_COMPILE = 18      # instant: jit cache grew — a compile the padding
                      #   count = total compiled variants)
 EV_PROF_CAPTURE = 19  # span: bounded device-trace window (fdprof
                      #   DeviceCapture; count = doorbell req id)
+EV_TUNE = 20         # instant: controller knob decision (fdtune;
+                     #   arg = new knob value, count = knob index into
+                     #   the plan's tune_knobs list, link = the
+                     #   saturating hop that justified the move)
 
 NAMES = {
     EV_BOOT: "boot", EV_HALT: "halt", EV_FAIL: "fail",
@@ -55,7 +59,7 @@ NAMES = {
     EV_CPU_FALLBACK: "cpu_fallback", EV_CHAOS: "chaos",
     EV_WATCHDOG: "watchdog", EV_RESTART: "restart", EV_DOWN: "down",
     EV_SLO: "slo", EV_COMPILE: "compile",
-    EV_PROF_CAPTURE: "prof_capture",
+    EV_PROF_CAPTURE: "prof_capture", EV_TUNE: "tune",
 }
 
 # span events: record.ts is the END, record.arg the duration in ns

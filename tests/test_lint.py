@@ -484,6 +484,49 @@ def test_flight_section_is_clean_when_valid():
     assert lint_config(cfg, "<fixture>") == []
 
 
+def test_bad_tune_schema_and_did_you_mean():
+    # typo'd [tune] key: the tune/__init__.py schema gate
+    findings = lint_config(_cfg(tune={"intervals": 0.5}), "<fixture>")
+    fires_once(findings, "bad-tune")
+    assert "did you mean 'interval_s'" in findings[0].message
+    # out-of-range policy + a bad knob-override bound
+    fires_once(lint_config(_cfg(tune={"hysteresis": 2.0}),
+                           "<fixture>"), "bad-tune")
+    fires_once(lint_config(
+        _cfg(tune={"knob": {"coalesce_us": {"min": 9, "max": 3}}}),
+        "<fixture>"), "bad-tune")
+    # unknown knob with suggestion
+    findings = lint_config(_cfg(tune={"knob": {"coalesce_u": {}}}),
+                           "<fixture>")
+    fires_once(findings, "bad-tune")
+    assert "did you mean 'coalesce_us'" in findings[0].message
+    # a controller tile with no enabled [tune] has nothing to steer
+    cfg = _cfg()
+    cfg["tile"].append({"name": "ctl", "kind": "controller"})
+    fires_once(lint_config(cfg, "<fixture>"), "bad-tune")
+
+
+def test_tune_section_is_clean_when_valid():
+    cfg = _cfg(tune={"enable": True, "interval_s": 0.25,
+                     "cooldown_s": 1.0, "recovery_s": 2.0,
+                     "hysteresis": 0.25, "max_moves": 4,
+                     "window_s": 5.0, "bp_ref": 100.0,
+                     "knob": {"coalesce_us": {"max": 1000,
+                                              "step": 50}}})
+    cfg["tile"].append({"name": "ctl", "kind": "controller"})
+    assert lint_config(cfg, "<fixture>") == []
+
+
+def test_tune_registry_mirror():
+    """TUNE_SECTION_KEYS/TUNE_KNOB_KEYS mirror the validator's tables
+    — same contract the flight/replay/snapshot mirrors pin."""
+    from firedancer_tpu.lint.registry import (TUNE_KNOB_KEYS,
+                                              TUNE_SECTION_KEYS)
+    from firedancer_tpu.tune import KNOB_KEYS, TUNE_DEFAULTS
+    assert set(TUNE_SECTION_KEYS) == set(TUNE_DEFAULTS)
+    assert set(TUNE_KNOB_KEYS) == set(KNOB_KEYS)
+
+
 def test_flight_registry_mirror():
     """FLIGHT_SECTION_KEYS mirrors the validator's defaults table —
     same contract the replay/snapshot mirrors pin."""

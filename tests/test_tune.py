@@ -1,0 +1,719 @@
+"""fdtune: offline knob autotuning + the adaptive controller tile.
+
+The r20 acceptance checklist: [tune] schema triple gate (config load /
+topo.build / fdlint bad-tune) + the lint registry mirror; knob-mailbox
+ABI round-trip + single-writer ownership lint fixture; controller
+hysteresis non-oscillation under a scripted step load AND a flapping
+flood (decision count bounded by the window budget, no limit cycle,
+relief sticky, revert never overshoots); offline sweep resumability
+(kill mid-sweep -> resume skips completed points, tuned_vs_default_tps
+>= 1.0 by construction); tuned-profile provenance round-trip +
+FDTPU_TUNED_PROFILE application; and the live acceptance drill —
+real shm pressure -> controller widens the coalesce window + tightens
+the shed -> EV_TUNE in the trace ring -> fdgui tune panel data ->
+knobs revert after the recovery dwell.
+"""
+import json
+import os
+
+import pytest
+
+from firedancer_tpu.runtime import KnobMailbox, Workspace
+from firedancer_tpu.tune import (KNOB_KEYS, KNOBS, RUNTIME_KNOBS,
+                                 TUNE_DEFAULTS, KnobReader, knob_space,
+                                 normalize_tune, reader_for)
+from firedancer_tpu.tune.controller import Controller
+from firedancer_tpu.tune.search import (axis_candidates, load_state,
+                                        point_key, run_sweep)
+
+pytestmark = pytest.mark.tune
+
+_N = [0]
+
+
+def _wksp(size=1 << 16):
+    _N[0] += 1
+    return Workspace(f"/fdtpu_tune_{os.getpid()}_{_N[0]}", size)
+
+
+# ---------------------------------------------------------------------------
+# [tune] schema: the one validator + the triple gate + registry mirror
+# ---------------------------------------------------------------------------
+
+def test_normalize_tune_defaults():
+    cfg = normalize_tune(None)
+    assert cfg["enable"] is True and cfg["knob"] == {}
+    assert cfg["cooldown_s"] >= cfg["interval_s"]
+    assert 0 < cfg["hysteresis"] < 1
+    # explicit section: defaults fill, overrides land
+    cfg = normalize_tune({"interval_s": 0.5, "cooldown_s": 1.0})
+    assert cfg["interval_s"] == 0.5 and cfg["max_moves"] == 4
+
+
+def test_normalize_tune_rejections():
+    with pytest.raises(ValueError, match="did you mean 'interval_s'"):
+        normalize_tune({"intervals": 1})
+    with pytest.raises(ValueError, match="must be > 0"):
+        normalize_tune({"interval_s": 0})
+    with pytest.raises(ValueError, match="hysteresis"):
+        normalize_tune({"hysteresis": 1.5})
+    with pytest.raises(ValueError, match="cooldown_s must be >="):
+        normalize_tune({"interval_s": 2.0, "cooldown_s": 0.5})
+    with pytest.raises(ValueError, match="max_moves"):
+        normalize_tune({"max_moves": 0})
+    with pytest.raises(ValueError, match="did you mean 'coalesce_us'"):
+        normalize_tune({"knob": {"coalesce_u": {"max": 100}}})
+    with pytest.raises(ValueError, match="did you mean 'default'"):
+        normalize_tune({"knob": {"coalesce_us": {"defalt": 100}}})
+    with pytest.raises(ValueError, match="min.*> max"):
+        normalize_tune({"knob": {"coalesce_us": {"min": 10,
+                                                 "max": 5}}})
+    with pytest.raises(ValueError, match="outside"):
+        normalize_tune({"knob": {"pack_wave": {"default": 99}}})
+    with pytest.raises(ValueError, match="step must be > 0"):
+        normalize_tune({"knob": {"pack_wave": {"step": 0}}})
+
+
+def test_knob_space_merges_overrides():
+    sp = knob_space(normalize_tune(
+        {"knob": {"coalesce_us": {"max": 800, "step": 50}}}))
+    assert sp["coalesce_us"]["max"] == 800
+    assert sp["coalesce_us"]["step"] == 50
+    assert sp["coalesce_us"]["default"] == KNOBS["coalesce_us"]["default"]
+    assert sp["pack_wave"]["max"] == KNOBS["pack_wave"]["max"]
+    # runtime subset = the mailbox slot ABI, catalog order
+    assert RUNTIME_KNOBS == tuple(n for n, s in KNOBS.items()
+                                  if s["runtime"])
+    assert "verify_batch" not in RUNTIME_KNOBS     # offline-only
+
+
+def test_registry_mirrors_tune_keys():
+    """The fdlint key registry's [tune] mirror must track the one
+    validator's schema (the [trace]/[slo]/[witness] honesty rule)."""
+    from firedancer_tpu.lint import registry as reg
+    assert set(reg.TUNE_SECTION_KEYS) == set(TUNE_DEFAULTS)
+    assert set(reg.TUNE_KNOB_KEYS) == set(KNOB_KEYS)
+
+
+def test_config_load_gate():
+    """Gate 1 of the triple: a bad [tune] fails build_topology before
+    any topology exists."""
+    from firedancer_tpu.app.config import build_topology
+    base = {"tile": [{"name": "s", "kind": "synth", "outs": ["a_b"]},
+                     {"name": "d", "kind": "sink", "ins": ["a_b"]}],
+            "link": [{"name": "a_b", "depth": 64, "mtu": 256}]}
+    with pytest.raises(ValueError, match="did you mean 'interval_s'"):
+        build_topology({**base, "tune": {"intervals": 1}})
+    topo = build_topology({**base, "tune": {"enable": True}})
+    assert topo.tune == {"enable": True}
+
+
+def _build(tune=None, controller=False, trace=None, metric=False,
+           slo=None):
+    from firedancer_tpu.disco import Topology
+    topo = Topology(f"tnb{os.getpid()}_{_N[0]}", wksp_size=1 << 21,
+                    tune=tune, trace=trace, slo=slo)
+    _N[0] += 1
+    topo.link("a_b", depth=32, mtu=256)
+    topo.tile("src", "synth", outs=["a_b"], count=8, unique=4)
+    topo.tile("dst", "sink", ins=["a_b"])
+    if metric:
+        topo.tile("metric", "metric", port=0)
+    if controller:
+        topo.tile("ctl", "controller")
+    return topo.build()
+
+
+def test_build_carves_mailbox_only_when_enabled():
+    """Gate 2: topo.build. Enabled -> mailbox carved + the runtime
+    knob order frozen as plan ABI; disabled/absent -> NO plan keys
+    (the fdtrace disabled-path contract)."""
+    plan = _build()
+    try:
+        assert plan["tune"] is None
+        assert "tune_mailbox_off" not in plan
+        assert "tune_knobs" not in plan
+    finally:
+        Workspace.unlink_name(plan["wksp"]["name"])
+    plan = _build(tune={"enable": True})
+    try:
+        assert plan["tune"]["enable"] is True
+        assert plan["tune_knobs"] == list(RUNTIME_KNOBS)
+        assert plan["tune_mailbox_off"] % 8 == 0
+    finally:
+        Workspace.unlink_name(plan["wksp"]["name"])
+
+
+def test_build_rejects_controller_without_tune():
+    with pytest.raises(ValueError, match="no knob mailbox"):
+        _build(controller=True)
+    with pytest.raises(ValueError, match="no knob mailbox"):
+        _build(tune={"enable": False}, controller=True)
+
+
+def test_lint_bad_tune():
+    """Gate 3: the fdlint graph rule — typo'd key with did-you-mean,
+    bad bounds, controller-without-tune, and clean when valid."""
+    from firedancer_tpu.lint.graph import lint_config
+
+    def cfg(**extra):
+        c = {"link": [{"name": "a_b", "depth": 64, "mtu": 1280}],
+             "tile": [{"name": "src", "kind": "synth",
+                       "outs": ["a_b"]},
+                      {"name": "dst", "kind": "sink", "ins": ["a_b"]}]}
+        c.update(extra)
+        return c
+
+    def fires_once(findings, rule):
+        hits = [f for f in findings if f.rule == rule]
+        assert len(hits) == 1, findings
+        return hits[0]
+
+    f = fires_once(lint_config(cfg(tune={"intervals": 1}),
+                               "<fixture>"), "bad-tune")
+    assert "did you mean 'interval_s'" in f.message
+    fires_once(lint_config(cfg(tune={"hysteresis": 2.0}),
+                           "<fixture>"), "bad-tune")
+    fires_once(lint_config(
+        cfg(tune={"knob": {"coalesce_us": {"min": 9, "max": 3}}}),
+        "<fixture>"), "bad-tune")
+    # a controller tile with no (or disabled) [tune] has nothing to
+    # steer — same message as the build-time gate
+    c = cfg()
+    c["tile"].append({"name": "ctl", "kind": "controller"})
+    f = fires_once(lint_config(c, "<fixture>"), "bad-tune")
+    assert "no knob mailbox" in f.message
+    c2 = cfg(tune={"enable": False})
+    c2["tile"].append({"name": "ctl", "kind": "controller"})
+    fires_once(lint_config(c2, "<fixture>"), "bad-tune")
+    c3 = cfg(tune={"enable": True,
+                   "knob": {"coalesce_us": {"max": 1000}}})
+    c3["tile"].append({"name": "ctl", "kind": "controller"})
+    assert lint_config(c3, "<fixture>") == []
+
+
+def test_tune_demo_config_is_lint_clean():
+    from firedancer_tpu.lint.graph import lint_config_file
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "cfg", "tune-demo.toml")
+    assert lint_config_file(path) == []
+
+
+# ---------------------------------------------------------------------------
+# knob mailbox: ABI round-trip + reader side + ownership catalog
+# ---------------------------------------------------------------------------
+
+def test_mailbox_roundtrip():
+    w = _wksp()
+    try:
+        mb = KnobMailbox.create(w, 4)
+        assert mb.generation == 0
+        assert mb.read(2) == (0, 0)              # never posted
+        mb.post(2, 500, ts_ns=123)
+        assert mb.read(2) == (500, 1)
+        assert mb.generation == 1
+        mb.post(2, 600)
+        assert mb.read(2) == (600, 2)
+        mb.post(0, 7)
+        assert mb.generation == 3
+        gen, slots = mb.snapshot()
+        assert gen == 3 and slots.shape == (4, 4)
+        assert int(slots[2][0]) == 600 and int(slots[2][1]) == 2
+        # a second attach over the same offsets sees the same state
+        # (the inter-process ABI)
+        mb2 = KnobMailbox(w, mb.off, 4)
+        assert mb2.read(2) == (600, 2)
+        with pytest.raises(IndexError):
+            mb.post(4, 1)
+        with pytest.raises(ValueError):
+            KnobMailbox.create(w, 0)
+    finally:
+        w.close()
+        w.unlink()
+
+
+def test_reader_for_resolves_by_tile_kind():
+    """TileCtx.knobs contract: None without a mailbox, None for kinds
+    with no runtime knob, a slot-resolved KnobReader otherwise —
+    values None until the controller has ever posted."""
+    plan = _build(tune={"enable": True})
+    w = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                  create=False)
+    try:
+        assert reader_for(plan, w, "dst") is None        # sink: none
+        # a disabled plan has no keys at all -> None fast path
+        assert reader_for({"tiles": plan["tiles"]}, w, "src") is None
+        # synth has no runtime knob either
+        assert reader_for(plan, w, "src") is None
+        # fabricate a verify-kind tile entry to exercise resolution
+        plan["tiles"]["v"] = {"kind": "verify"}
+        rd = reader_for(plan, w, "v")
+        assert isinstance(rd, KnobReader)
+        assert set(rd.knobs) == {"coalesce_us", "bulk_prefilter"}
+        assert rd.get("coalesce_us") is None             # seq 0
+        assert rd.get("pack_wave") is None               # not his knob
+        mb = KnobMailbox(w, plan["tune_mailbox_off"],
+                         len(plan["tune_knobs"]))
+        mb.post(plan["tune_knobs"].index("coalesce_us"), 400)
+        assert rd.get("coalesce_us") == 400
+    finally:
+        w.close()
+        Workspace.unlink_name(plan["wksp"]["name"])
+
+
+def test_mailbox_ownership_lint():
+    """The knob mailbox is a cataloged single-writer region: a post
+    from anywhere but the controller's decision loop is a dual-writer
+    finding; the cataloged writer is clean."""
+    import textwrap
+    from firedancer_tpu.lint.ownership import lint_ownership_source
+    body = textwrap.dedent("""
+        def hijack(self, idx, value):
+            self.mailbox.post(idx, value)
+    """)
+    findings = lint_ownership_source(body, "tiles/evil.py")
+    hits = [f for f in findings if f.rule == "dual-writer"]
+    assert len(hits) == 1 and "knob-mailbox" in hits[0].message
+    assert lint_ownership_source(body, "tune/controller.py") == []
+    # the shipped controller passes its own catalog
+    from firedancer_tpu.lint.abi import pkg_root
+    with open(os.path.join(pkg_root(), "tune", "controller.py")) as f:
+        src = f.read()
+    assert lint_ownership_source(src, "tune/controller.py") == []
+
+
+# ---------------------------------------------------------------------------
+# controller hysteresis: the non-oscillation proofs (scripted clock)
+# ---------------------------------------------------------------------------
+
+CALM = {"breached": 0, "burn": 0.0, "bp_delta": 0, "worst_link": None,
+        "overloaded": False}
+SATURATED = {"breached": 1, "burn": 1.0, "bp_delta": 500,
+             "worst_link": "a_b", "overloaded": True}
+
+
+class FakeProbe:
+    def __init__(self):
+        self.sample = dict(CALM)
+
+    def poll(self):
+        return dict(self.sample)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+CFG = {"enable": True, "interval_s": 0.25, "cooldown_s": 1.0,
+       "recovery_s": 2.0, "hysteresis": 0.5, "max_moves": 3,
+       "window_s": 4.0, "bp_ref": 100.0}
+
+
+def _controller(cfg=None):
+    w = _wksp()
+    mb = KnobMailbox.create(w, len(RUNTIME_KNOBS))
+    plan = {"tune_knobs": list(RUNTIME_KNOBS),
+            "tune_mailbox_off": mb.off, "tiles": {}, "links": {}}
+    clock, probe = FakeClock(), FakeProbe()
+    c = Controller(plan, w, cfg=dict(cfg or CFG), clock=clock,
+                   probe=probe)
+    return c, clock, probe, w
+
+
+def test_controller_requires_mailbox():
+    w = _wksp()
+    try:
+        with pytest.raises(ValueError, match="no knob mailbox"):
+            Controller({"tiles": {}}, w, cfg=dict(CFG))
+    finally:
+        w.close()
+        w.unlink()
+
+
+def test_step_load_bounded_and_reverts_after_recovery():
+    """Scripted step load: saturation escalates one cooldown-paced
+    step at a time under the shared window budget; calm holds for
+    recovery_s before ONE revert step at a time walks every knob back
+    to its default; then the loop goes quiet (no limit cycle)."""
+    c, clock, probe, w = _controller()
+    try:
+        probe.sample = dict(SATURATED)
+        decisions = []
+        while clock.t < 10.0:
+            decisions.append(c.poll())
+            clock.t += 0.25
+        moved = [d for d in decisions if d]
+        n_moves = sum(len(d) for d in moved)
+        # hard budget: max_moves per rolling window_s
+        windows = 10.0 / CFG["window_s"] + 1
+        assert 0 < n_moves <= CFG["max_moves"] * windows
+        # every accepted move is relief, paced by per-knob cooldown
+        per_knob = {}
+        for batch in moved:
+            for d in batch:
+                assert d["why"] == "relief"
+                assert d["worst_link"] == "a_b"
+                per_knob.setdefault(d["knob"], []).append(d["t"])
+        for knob, ts in per_knob.items():
+            for a, b in zip(ts, ts[1:]):
+                assert b - a >= CFG["cooldown_s"], knob
+        # the mailbox saw the steering (seq > 0, escalated values)
+        sp = knob_space(c.cfg)
+        steered = [n for n in c.names
+                   if c.mailbox.read(c._slot[n])[1] > 0]
+        assert steered
+        for n in steered:
+            v, _ = c.mailbox.read(c._slot[n])
+            assert v > sp[n]["default"] or sp[n]["default"] == \
+                sp[n]["max"]
+        # step ends: calm must persist recovery_s before ANY revert
+        probe.sample = dict(CALM)
+        t0 = clock.t
+        reverted = []
+        while clock.t < t0 + 15.0:
+            reverted.extend(c.poll())
+            clock.t += 0.25
+        assert all(d["why"] == "revert" for d in reverted)
+        assert min(d["t"] for d in reverted) >= t0 + CFG["recovery_s"]
+        # fully recovered: every knob back at its default, and the
+        # controller is QUIET (the no-limit-cycle assertion)
+        assert c.value == {n: sp[n]["default"] for n in c.names}
+        t1 = clock.t
+        while clock.t < t1 + 5.0:
+            assert c.poll() == []
+            clock.t += 0.25
+    finally:
+        w.close()
+        w.unlink()
+
+
+def test_dead_band_holds_everything():
+    """Pressure inside the hysteresis band moves nothing — no
+    escalation, no revert, no calm reset (the anti-flap core)."""
+    c, clock, probe, w = _controller()
+    try:
+        # bp folds to 0.5: exactly the band center (act_lo=0.25,
+        # act_hi=0.75 at hysteresis 0.5)
+        probe.sample = {**CALM, "bp_delta": 50}
+        while clock.t < 8.0:
+            assert c.poll() == []
+            clock.t += 0.25
+        assert c.decisions == 0
+    finally:
+        w.close()
+        w.unlink()
+
+
+def test_flapping_flood_no_oscillation():
+    """Pressure flapping 1.0/0.0 every interval: relief stays sticky
+    (a blip resets the recovery dwell, so there are NO reverts), the
+    escalations pace at per-knob cooldown, and total decisions stay
+    inside the rolling window budget — the limit-cycle killer."""
+    c, clock, probe, w = _controller()
+    try:
+        decisions = []
+        times = []
+        flip = False
+        while clock.t < 12.0:
+            probe.sample = dict(SATURATED if flip else CALM)
+            flip = not flip
+            for d in c.poll():
+                decisions.append(d)
+                times.append(clock.t)
+            clock.t += 0.25
+        assert decisions, "flapping saturation must still escalate"
+        assert all(d["why"] == "relief" for d in decisions), \
+            "a revert during a flap means the dwell is broken"
+        # rolling window budget holds at every instant
+        for t in times:
+            in_win = [x for x in times
+                      if t - CFG["window_s"] < x <= t]
+            assert len(in_win) <= CFG["max_moves"]
+        # and once the flood genuinely ends, it recovers + goes quiet
+        probe.sample = dict(CALM)
+        t0 = clock.t
+        while clock.t < t0 + 20.0:
+            c.poll()
+            clock.t += 0.25
+        sp = knob_space(c.cfg)
+        assert c.value == {n: sp[n]["default"] for n in c.names}
+    finally:
+        w.close()
+        w.unlink()
+
+
+def test_revert_never_overshoots_default():
+    c, clock, probe, w = _controller(
+        {**CFG, "knob": {"coalesce_us": {"step": 300}}})
+    try:
+        probe.sample = dict(SATURATED)
+        c.poll()                                  # one relief step
+        assert c.value["coalesce_us"] == 200 + 300
+        probe.sample = dict(CALM)
+        clock.t = 100.0                           # long past recovery
+        c.poll()
+        clock.t += CFG["recovery_s"] + 0.1
+        moved = c.poll()
+        assert any(d["knob"] == "coalesce_us" and d["value"] == 200
+                   for d in moved)
+        assert c.value["coalesce_us"] == 200      # not 200 - 100
+    finally:
+        w.close()
+        w.unlink()
+
+
+def test_controller_status_document():
+    c, clock, probe, w = _controller()
+    try:
+        probe.sample = dict(SATURATED)
+        c.poll()
+        st = c.status()
+        assert st["pressure"] == 1.0
+        assert st["decisions"] >= 1
+        assert st["max_moves"] == CFG["max_moves"]
+        assert st["last"]["worst_link"] == "a_b"
+        steered = [n for n, k in st["knobs"].items() if k["steered"]]
+        assert steered
+        for n in steered:
+            assert st["knobs"][n]["value"] != st["knobs"][n]["default"]
+    finally:
+        w.close()
+        w.unlink()
+
+
+# ---------------------------------------------------------------------------
+# offline sweep: checkpointed search, resumable by construction
+# ---------------------------------------------------------------------------
+
+def _score(pt):
+    # interior optimum at coalesce_us=400: the coarse grid can't land
+    # on it, the refinement step gets closer — and every score beats
+    # nothing (the default point is always in the argmax set)
+    return 1000.0 - abs(pt["coalesce_us"] - 400) * 0.1 \
+        - abs(pt["verify_batch"] - 32)
+
+
+def test_axis_candidates_are_bounded_and_deduped():
+    sp = knob_space(None)
+    for name in ("coalesce_us", "verify_batch"):
+        vals = axis_candidates(sp[name], points=5)
+        assert len(vals) <= 5 and len(set(vals)) == len(vals)
+        assert all(sp[name]["min"] <= v <= sp[name]["max"]
+                   for v in vals)
+        assert vals[0] == sp[name]["default"]
+
+
+def test_sweep_finds_knee_and_ratio_floor(tmp_path):
+    calls = []
+
+    def bench(pt):
+        calls.append(dict(pt))
+        return _score(pt)
+
+    res = run_sweep(bench, str(tmp_path / "s.json"), points=3)
+    assert res["measured"] == len(calls) == res["points"]
+    # default point measured FIRST: the ratio floor by construction
+    assert calls[0] == {"coalesce_us": 200, "verify_batch": 32}
+    assert res["tuned_vs_default_tps"] >= 1.0
+    assert res["default_tps"] == _score(calls[0])
+    # the refinement walked one step toward the interior optimum
+    assert res["knobs"]["coalesce_us"] == 300
+    assert res["tuned_tps"] == _score(res["knobs"])
+
+
+def test_sweep_kill_and_resume(tmp_path):
+    """A sweep killed mid-flight resumes from its checkpoint: every
+    completed point is skipped (never re-measured), the final result
+    matches an uninterrupted run."""
+    state = str(tmp_path / "s.json")
+    first = []
+
+    def dying_bench(pt):
+        if len(first) == 3:
+            raise RuntimeError("SIGKILL stand-in")
+        first.append(dict(pt))
+        return _score(pt)
+
+    with pytest.raises(RuntimeError):
+        run_sweep(dying_bench, state, points=3)
+    assert len(load_state(state)["points"]) == 3     # landed pre-kill
+    second = []
+
+    def resumed_bench(pt):
+        second.append(dict(pt))
+        return _score(pt)
+
+    res = run_sweep(resumed_bench, state, points=3)
+    done = {point_key(p) for p in first}
+    assert all(point_key(p) not in done for p in second), \
+        "resume re-measured a completed point"
+    assert res["measured"] == len(second)
+    assert res["points"] == len(first) + len(second)
+    assert res["knobs"]["coalesce_us"] == 300        # same knee
+    assert res["tuned_vs_default_tps"] >= 1.0
+    # a corrupt checkpoint degrades to a fresh sweep, never a crash
+    with open(state, "w") as f:
+        f.write("not json")
+    assert load_state(state)["points"] == {}
+
+
+def test_sweep_rejects_unknown_axis(tmp_path):
+    with pytest.raises(ValueError, match="unknown knob axis"):
+        run_sweep(lambda pt: 1.0, str(tmp_path / "s.json"),
+                  axes=("coalesce_us", "warp_factor"))
+
+
+# ---------------------------------------------------------------------------
+# tuned profiles: provenance round-trip + application
+# ---------------------------------------------------------------------------
+
+def test_profile_roundtrip_and_validation(tmp_path):
+    from firedancer_tpu.tune.profile import (diff_profiles,
+                                             load_profile,
+                                             make_profile,
+                                             save_profile)
+    doc = make_profile({"coalesce_us": 400, "verify_batch": 64},
+                       tuned_tps=1200.0, default_tps=1000.0,
+                       sweep={"count": 2048})
+    assert doc["measured"]["tuned_vs_default_tps"] == 1.2
+    assert doc["host"]["hostname"] and doc["host"]["cpus"]
+    path = str(tmp_path / "p.json")
+    save_profile(doc, path)
+    back = load_profile(path)
+    assert back == doc
+    with pytest.raises(ValueError, match="unknown knob"):
+        make_profile({"warp_factor": 9}, 1.0, 1.0)
+    bad = dict(doc)
+    bad["fdtune_profile"] = 99
+    p2 = str(tmp_path / "bad.json")
+    with open(p2, "w") as f:
+        json.dump(bad, f)
+    with pytest.raises(ValueError, match="not an fdtune profile"):
+        load_profile(p2)
+    d = diff_profiles(doc, make_profile({"coalesce_us": 200},
+                                        1.0, 1.0))
+    assert d == {"coalesce_us": (400, 200), "verify_batch": (64, 32)}
+
+
+def test_profile_applies_to_unbuilt_topology(tmp_path, monkeypatch):
+    from firedancer_tpu.app.config import build_topology
+    from firedancer_tpu.tune.profile import (apply_profile,
+                                             make_profile,
+                                             save_profile)
+    doc = make_profile({"coalesce_us": 700, "verify_batch": 64,
+                        "shed_tighten": 2}, 1100.0, 1000.0)
+    cfg = {"link": [{"name": "a_b", "depth": 64, "mtu": 1280},
+                    {"name": "b_c", "depth": 64, "mtu": 1280}],
+           "tile": [{"name": "src", "kind": "synth", "outs": ["a_b"]},
+                    {"name": "v", "kind": "verify", "ins": ["a_b"],
+                     "outs": ["b_c"], "batch": 32},
+                    {"name": "dst", "kind": "sink", "ins": ["b_c"]}]}
+    topo = build_topology(cfg)
+    applied = apply_profile(topo, doc)
+    assert topo.tiles["v"].args["coalesce_us"] == 700
+    assert topo.tiles["v"].args["batch"] == 64
+    # shed_tighten is runtime-only: no boot-time arg to seed
+    assert sorted(a for _, a, _ in applied) == ["batch", "coalesce_us"]
+    # the FDTPU_TUNED_PROFILE hook does the same through the env
+    path = str(tmp_path / "p.json")
+    save_profile(doc, path)
+    monkeypatch.setenv("FDTPU_TUNED_PROFILE", path)
+    topo2 = build_topology(cfg)
+    assert topo2.tiles["v"].args["coalesce_us"] == 700
+    assert topo2.tiles["v"].args["batch"] == 64
+
+
+# ---------------------------------------------------------------------------
+# the live acceptance drill: shm pressure -> decisions -> EV_TUNE ->
+# fdgui panel -> recovery
+# ---------------------------------------------------------------------------
+
+def test_live_acceptance_drill():
+    """Real plan + wksp (metric tile, [slo], [tune], [trace], a
+    controller tile): inject an SLO breach + link backpressure
+    straight into shm, drive the controller on a scripted clock, and
+    assert the whole reporting chain — mailbox posts, EV_TUNE in the
+    trace ring with the saturating hop, the fdgui delta's tune
+    document — then recovery walks the knobs back to defaults."""
+    import numpy as np
+    from firedancer_tpu.disco.metrics import LINK_PROD_COUNTERS
+    from firedancer_tpu.disco.slo import PressureProbe
+    from firedancer_tpu.disco.topo import METRICS_SLOTS
+    from firedancer_tpu.gui.schema import DeltaSource
+    from firedancer_tpu.trace import export
+    from firedancer_tpu.trace.events import EV_TUNE
+    plan = _build(
+        tune={"enable": True, "interval_s": 0.25, "cooldown_s": 1.0,
+              "recovery_s": 2.0, "hysteresis": 0.5, "max_moves": 3,
+              "window_s": 4.0, "bp_ref": 100.0},
+        controller=True, metric=True,
+        trace={"enable": True, "depth": 256},
+        slo={"fast_window_s": 2.0,
+             "target": [{"name": "bp",
+                         "expr": "link.a_b.backpressure rate "
+                                 "< 100/s"}]})
+    w = Workspace(plan["wksp"]["name"], plan["wksp"]["size"],
+                  create=False)
+    try:
+        # the controller tile's trace writer (what TileCtx would give
+        # the adapter)
+        from firedancer_tpu.trace import writer_for
+        tw = writer_for(plan, w, "ctl")
+        assert tw is not None
+        clock = FakeClock()
+        c = Controller(plan, w, cfg=plan["tune"], clock=clock,
+                       trace=tw, probe=PressureProbe(plan, w))
+        # calm baseline poll (seeds the probe's bp counters)
+        assert c.poll() == []
+        # inject pressure: flip the metric tile's slo_breach gauge and
+        # burn backpressure ticks on a_b's producer counters
+        moff = plan["tiles"]["metric"]["metrics_off"]
+        mview = w.view(moff, METRICS_SLOTS * 8).view(np.uint64)
+        names = plan["tiles"]["metric"]["metrics_names"]
+        mview[names.index("slo_breach")] = 1
+        mview[names.index("slo_breaches")] = 1
+        bp_i = LINK_PROD_COUNTERS.index("backpressure")
+        lview = w.view(plan["links"]["a_b"]["prod_metrics_off"],
+                       len(LINK_PROD_COUNTERS) * 8).view(np.uint64)
+        lview[bp_i] = 500
+        clock.t = 0.5
+        moved = c.poll()
+        assert moved and all(d["why"] == "relief" for d in moved)
+        assert moved[0]["worst_link"] == "a_b"
+        # the mailbox carries the steering for every adapter to read
+        assert any(c.mailbox.read(i)[1] > 0
+                   for i in range(len(c.names)))
+        # EV_TUNE landed in the ring with the saturating hop
+        evs = export.read_rings(plan, w, tiles=["ctl"])["ctl"]
+        tunes = [e for e in evs if e["etype"] == EV_TUNE]
+        assert len(tunes) == len(moved)
+        assert tunes[0]["link"] == "a_b"
+        knob = plan["tune_knobs"][tunes[0]["count"]]
+        assert tunes[0]["arg"] == c.value[knob]
+        # the fdgui delta exposes the whole tuning panel
+        ds = DeltaSource(plan, w, tps_tile="dst", tps_metric="rx")
+        d = ds.delta()
+        tu = d["tune"]
+        assert tu is not None
+        assert [k for k, v in tu["knobs"].items() if v["steered"]]
+        assert tu["recent"] and tu["recent"][0]["hop"] == "a_b"
+        assert tu["recent"][0]["knob"] in plan["tune_knobs"]
+        # recovery: clear the pressure, dwell, revert to defaults
+        mview[names.index("slo_breach")] = 0
+        sp = knob_space(plan["tune"])
+        t = clock.t
+        while clock.t < t + 30.0:
+            clock.t += 0.25
+            c.poll()
+        assert c.value == {n: sp[n]["default"] for n in c.names}
+        assert c.reverts > 0
+        # and the drill's decisions are all in the flight keep-list
+        from firedancer_tpu.flight.recorder import _TRACE_KEEP
+        assert "tune" in _TRACE_KEEP
+    finally:
+        w.close()
+        Workspace.unlink_name(plan["wksp"]["name"])
